@@ -1,0 +1,31 @@
+"""jepsen_tpu — a TPU-native distributed-systems testing framework.
+
+A ground-up rebuild of the capabilities of Jepsen (reference:
+/root/reference, Clojure/JVM): drive a real distributed system with
+concurrent client processes, inject faults with a nemesis, record a history,
+and check that history against formal models — with the checker subsystem
+redesigned as a first-class TPU workload (batched linearizability search over
+bit-packed histories in JAX, sharded across chips).
+
+Layer map (mirrors SURVEY.md §1, TPU-first):
+
+- jepsen_tpu.history / jepsen_tpu.ops      — op & history substrate + the
+  bit-packed device encoding
+- jepsen_tpu.models                        — stepped datatype models + integer
+  transition kernels
+- jepsen_tpu.generator                     — op-scheduling DSL (~30 combinators)
+- jepsen_tpu.checker                       — history validators; CPU WGL oracle
+  and the batched JAX/TPU linearizability backend
+- jepsen_tpu.core                          — test-lifecycle orchestrator
+- jepsen_tpu.client / db / os / net / nemesis — system-under-test protocols
+- jepsen_tpu.control                       — SSH control plane (+ dummy mode)
+- jepsen_tpu.independent                   — keyed data-parallel lifting (the
+  axis the TPU checker shards across chips)
+- jepsen_tpu.store / cli / web             — persistence, runner, browser
+"""
+
+__version__ = "0.1.0"
+
+# Keep package import light: JAX is only imported when the TPU checker
+# backend is actually used.
+from jepsen_tpu.history import History, Op, NEMESIS  # noqa: F401

@@ -1,0 +1,127 @@
+"""History validators.
+
+Rebuild of jepsen.checker (jepsen/src/jepsen/checker.clj): a Checker examines
+a completed history and returns a result map with a ``valid`` key that is
+True, False, or "unknown". Checkers compose; composed validity merges with
+severity False > "unknown" > True (checker.clj:23-44).
+
+The linearizability checker family lives in :mod:`jepsen_tpu.checker.wgl`
+(CPU oracle) and :mod:`jepsen_tpu.checker.tpu` (batched JAX search — the
+north-star TPU workload); fold-style checkers (set/counter/queue/...) in
+:mod:`jepsen_tpu.checker.basic`.
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Any, Dict, Optional
+
+from jepsen_tpu.history import History
+from jepsen_tpu.util import real_pmap
+
+UNKNOWN = "unknown"
+
+#: Severity order for merging composed validity (checker.clj:23-44):
+#: false dominates, then unknown, then true.
+_PRIORITY = {False: 0, UNKNOWN: 1, True: 2}
+
+
+def merge_valid(valids) -> Any:
+    """Merge a collection of validity values, most severe wins."""
+    out = True
+    for v in valids:
+        if _PRIORITY.get(v, 1) < _PRIORITY.get(out, 1):
+            out = v
+    return out
+
+
+class Checker:
+    """Base checker protocol (checker.clj:46-61)."""
+
+    def check(self, test: dict, history: History,
+              opts: Optional[dict] = None) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def __call__(self, test, history, opts=None):
+        return self.check(test, history, opts)
+
+
+class FnChecker(Checker):
+    """Adapt a plain function (test, history, opts) -> result."""
+
+    def __init__(self, fn, name=None):
+        self.fn = fn
+        self.name = name or getattr(fn, "__name__", "fn-checker")
+
+    def check(self, test, history, opts=None):
+        return self.fn(test, history, opts)
+
+
+def check_safe(checker: Checker, test: dict, history: History,
+               opts: Optional[dict] = None) -> Dict[str, Any]:
+    """Like check, but exceptions yield {'valid': 'unknown'} with the trace
+    (checker.clj:63-74)."""
+    try:
+        return checker.check(test, history, opts or {})
+    except Exception:  # noqa: BLE001
+        return {"valid": UNKNOWN, "error": traceback.format_exc()}
+
+
+class Compose(Checker):
+    """Map of name -> checker, all run (in parallel threads, mirroring the
+    reference's pmap at checker.clj:376-388), results keyed by name."""
+
+    def __init__(self, checkers: Dict[str, Checker]):
+        self.checkers = checkers
+
+    def check(self, test, history, opts=None):
+        names = list(self.checkers)
+        results = real_pmap(
+            lambda n: check_safe(self.checkers[n], test, history, opts),
+            names)
+        by_name = dict(zip(names, results))
+        return {
+            "valid": merge_valid(r.get("valid", UNKNOWN)
+                                 for r in results),
+            **by_name,
+        }
+
+
+def compose(checkers: Dict[str, Checker]) -> Compose:
+    return Compose(checkers)
+
+
+class Unbridled(Checker):
+    """A checker which is always happy (checker.clj 'unbridled-optimism')."""
+
+    def check(self, test, history, opts=None):
+        return {"valid": True}
+
+
+def noop_checker() -> Checker:
+    return Unbridled()
+
+
+# Re-exports of the concrete checkers for a flat API surface, matching how
+# the reference exposes everything through the jepsen.checker namespace.
+from jepsen_tpu.checker.basic import (  # noqa: E402,F401
+    set_checker,
+    counter,
+    queue,
+    total_queue,
+    unique_ids,
+    SetChecker,
+    Counter,
+    QueueChecker,
+    TotalQueue,
+    UniqueIds,
+)
+from jepsen_tpu.checker.wgl import (  # noqa: E402,F401
+    linearizable,
+    LinearizableChecker,
+)
+from jepsen_tpu.checker.perf import (  # noqa: E402,F401
+    latency_graph,
+    rate_graph,
+    perf,
+)

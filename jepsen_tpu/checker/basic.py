@@ -1,0 +1,259 @@
+"""Fold-style checkers: set, counter, queue, total-queue, unique-ids.
+
+Rebuild of the linear-scan checkers in jepsen/src/jepsen/checker.clj:109-374.
+These are single-pass folds over the history — cheap on host, so they run in
+plain Python/numpy; the search-based linearizable checker is the TPU workload.
+"""
+
+from __future__ import annotations
+
+from collections import Counter as Multiset
+from typing import Any, Dict, Optional
+
+from jepsen_tpu.checker import Checker
+from jepsen_tpu.history import History
+from jepsen_tpu.models.core import Model, is_inconsistent
+from jepsen_tpu.util import integer_interval_set_str
+
+
+def _hashable(v):
+    try:
+        hash(v)
+        return v
+    except TypeError:
+        return repr(v)
+
+
+class SetChecker(Checker):
+    """Set full of unique elements: 'add's then a final 'read'
+    (checker.clj:131-178).
+
+    - lost: elements we definitely added (ok) but the final read misses —
+      always illegal.
+    - unexpected: elements present that were never even attempted — illegal.
+    - recovered: elements whose add was indeterminate but which showed up —
+      fine, informative.
+    """
+
+    def check(self, test, history: History, opts=None) -> Dict[str, Any]:
+        attempts = set()
+        adds = set()
+        final_read = None
+        for o in history:
+            if o.f == "add" and o.is_invoke:
+                attempts.add(_hashable(o.value))
+            elif o.f == "add" and o.is_ok:
+                adds.add(_hashable(o.value))
+            elif o.f == "read" and o.is_ok:
+                final_read = set(map(_hashable, o.value))
+        if final_read is None:
+            return {"valid": "unknown",
+                    "error": "Set was never read"}
+        lost = adds - final_read
+        unexpected = final_read - attempts
+        recovered = (final_read & attempts) - adds
+        return {
+            "valid": not lost and not unexpected,
+            "lost": _render(lost),
+            "recovered": _render(recovered),
+            "ok": _render(final_read & adds),
+            "unexpected": _render(unexpected),
+            "attempt-count": len(attempts),
+            "ok-count": len(final_read & adds),
+            "lost-count": len(lost),
+            "unexpected-count": len(unexpected),
+            "recovered-count": len(recovered),
+        }
+
+
+def _render(s):
+    """Render an element set compactly, using interval notation for ints
+    (util.clj:487-512 integer-interval-set-str, used by checker.clj:160)."""
+    if s and all(isinstance(x, int) and not isinstance(x, bool) for x in s):
+        return integer_interval_set_str(s)
+    return sorted(s, key=repr)
+
+
+class QueueChecker(Checker):
+    """Every dequeue must come from somewhere (checker.clj:109-129):
+    assume every attempted enqueue (invoke) may have succeeded, require every
+    ok dequeue to be explainable by the model (typically an UnorderedQueue)."""
+
+    def __init__(self, model: Model):
+        self.model = model
+
+    def check(self, test, history: History, opts=None) -> Dict[str, Any]:
+        m = self.model
+        for o in history:
+            step_op = None
+            if o.f == "enqueue" and o.is_invoke:
+                step_op = o
+            elif o.f == "dequeue" and o.is_ok:
+                step_op = o
+            if step_op is not None:
+                m2 = m.step(step_op)
+                if is_inconsistent(m2):
+                    return {"valid": False,
+                            "error": m2.msg,
+                            "final-queue": repr(m)}
+                m = m2
+        return {"valid": True, "final-queue": repr(m)}
+
+
+class TotalQueue(Checker):
+    """What goes in *must* come out — multiset matching of enqueues and
+    dequeues (checker.clj:214-271).
+
+    - lost: ok-enqueued but never dequeued — always illegal.
+    - unexpected: dequeued but never even attempted — illegal.
+    - duplicated: dequeued more times than enqueued — illegal.
+    - recovered: attempted (indeterminate) enqueue that was dequeued — fine.
+    """
+
+    def check(self, test, history: History, opts=None) -> Dict[str, Any]:
+        attempts: Multiset = Multiset()
+        enqueues: Multiset = Multiset()
+        dequeues: Multiset = Multiset()
+        for o in history:
+            if o.f == "enqueue" and o.is_invoke:
+                attempts[_hashable(o.value)] += 1
+            elif o.f == "enqueue" and o.is_ok:
+                enqueues[_hashable(o.value)] += 1
+            elif o.f == "dequeue" and o.is_ok:
+                dequeues[_hashable(o.value)] += 1
+        lost = enqueues - dequeues
+        # unexpected = dequeued values never attempted at all;
+        # duplicated = attempted values dequeued more often than attempted.
+        unexpected = Multiset({k: v for k, v in dequeues.items()
+                               if k not in attempts})
+        duplicated = Multiset({k: v for k, v in
+                               (dequeues - attempts).items()
+                               if k in attempts})
+        recovered = dequeues & (attempts - enqueues)
+        return {
+            "valid": not lost and not unexpected and not duplicated,
+            "lost": _render(set(lost)),
+            "unexpected": _render(set(unexpected)),
+            "duplicated": _render(set(duplicated)),
+            "recovered": _render(set(recovered)),
+            "attempt-count": sum(attempts.values()),
+            "acknowledged-count": sum(enqueues.values()),
+            "ok-count": sum((dequeues & enqueues).values()),
+            "lost-count": sum(lost.values()),
+            "unexpected-count": sum(unexpected.values()),
+            "duplicated-count": sum(duplicated.values()),
+            "recovered-count": sum(recovered.values()),
+        }
+
+
+class UniqueIds(Checker):
+    """All ok-returned values must be distinct (checker.clj:273-318)."""
+
+    def check(self, test, history: History, opts=None) -> Dict[str, Any]:
+        counts: Multiset = Multiset()
+        attempted = 0
+        for o in history:
+            if o.is_invoke:
+                attempted += 1
+            elif o.is_ok:
+                counts[_hashable(o.value)] += 1
+        dups = {k: v for k, v in counts.items() if v > 1}
+        return {
+            "valid": not dups,
+            "attempted-count": attempted,
+            "acknowledged-count": sum(counts.values()),
+            "duplicated-count": len(dups),
+            "duplicated": dups,
+            "range": _value_range(counts),
+        }
+
+
+def _value_range(counts):
+    """Numeric [min, max] when all ids are numbers (the reference reports the
+    numeric range, checker.clj:273-318); falls back to repr ordering."""
+    if not counts:
+        return None
+    try:
+        return [min(counts), max(counts)]
+    except TypeError:
+        return [min(counts, key=repr), max(counts, key=repr)]
+
+
+class Counter(Checker):
+    """A counter of increments/decrements; reads must land inside the window
+    of possible values given which adds are known vs merely possible
+    (checker.clj:321-374).
+
+    Fold maintains [lower, upper] possible-counter bounds:
+      invoke add v: possible side grows (upper += v if v>0 else lower += v)
+      ok add v:     definite side catches up (lower += v if v>0 else upper)
+      fail add v:   known not applied — undo the possible growth
+    An ok read of value x is valid iff x was inside [lower, upper] at some
+    instant while the read was open.
+    """
+
+    def check(self, test, history: History, opts=None) -> Dict[str, Any]:
+        lower = 0
+        upper = 0
+        open_reads: Dict[Any, list] = {}  # process -> [min_lower, max_upper]
+        reads = []  # (value, lo, hi, ok?)
+        errors = []
+        for o in history:
+            if o.f == "add":
+                v = o.value or 0
+                if o.is_invoke:
+                    if v > 0:
+                        upper += v
+                    else:
+                        lower += v
+                elif o.is_ok:
+                    if v > 0:
+                        lower += v
+                    else:
+                        upper += v
+                elif o.is_fail:
+                    if v > 0:
+                        upper -= v
+                    else:
+                        lower -= v
+                for w in open_reads.values():
+                    w[0] = min(w[0], lower)
+                    w[1] = max(w[1], upper)
+            elif o.f == "read":
+                if o.is_invoke:
+                    open_reads[o.process] = [lower, upper]
+                elif o.is_ok:
+                    w = open_reads.pop(o.process, [lower, upper])
+                    lo = min(w[0], lower)
+                    hi = max(w[1], upper)
+                    ok = lo <= o.value <= hi
+                    reads.append((lo, o.value, hi))
+                    if not ok:
+                        errors.append((lo, o.value, hi))
+                else:
+                    open_reads.pop(o.process, None)
+        return {
+            "valid": not errors,
+            "reads": reads,
+            "errors": errors,
+        }
+
+
+def set_checker() -> SetChecker:
+    return SetChecker()
+
+
+def counter() -> Counter:
+    return Counter()
+
+
+def queue(model: Model) -> QueueChecker:
+    return QueueChecker(model)
+
+
+def total_queue() -> TotalQueue:
+    return TotalQueue()
+
+
+def unique_ids() -> UniqueIds:
+    return UniqueIds()

@@ -1,0 +1,267 @@
+"""CPU linearizability checking: Wing-Gong-Lowe search with memoized
+configurations and just-in-time candidate windows.
+
+This replaces the reference's external knossos dependency
+(jepsen/project.clj:9; algorithms selected at checker.clj:85-94). The
+algorithm is WGL as refined by Lowe ("Testing for linearizability", and Horn &
+Kroening 1504.00204 for P-compositionality — see PAPERS.md):
+
+The history's paired operations are sorted by *return* index. A search
+configuration is then fully described by
+
+    (k, mask, state)
+
+where ops[0..k) (in return order) are all linearized, ``mask`` marks
+additionally-linearized ops at offsets >= k, and ``state`` is the model
+state. Candidates to linearize next are unlinearized ops invoked before the
+return of op k — precisely the ops concurrent with the frontier. This
+canonical form is what makes the search a *batched, fixed-width* workload:
+the TPU backend (jepsen_tpu.checker.tpu) packs the same triple into machine
+words and explores frontiers with vmapped kernels; this module is the exact
+reference semantics it is tested against.
+
+Two layers:
+- :func:`check_packed` — integer fast path over a PackedHistory for models
+  with word-sized kernels (CASRegister, Mutex).
+- :func:`check_model` — generic path stepping arbitrary Model objects
+  (queues, sets), hash-consed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from jepsen_tpu.checker import Checker, UNKNOWN
+from jepsen_tpu.history import History, Op
+from jepsen_tpu.models.core import (
+    KernelSpec, Model, is_inconsistent, kernel_spec_for)
+from jepsen_tpu.ops.encode import PackedHistory, RET_INF, pack_history
+
+
+def check_packed(p: PackedHistory,
+                 kernel: KernelSpec,
+                 max_configs: Optional[int] = None) -> Dict[str, Any]:
+    """WGL over a packed single-key history using integer model kernels.
+
+    Returns {'valid': bool, ...}; if max_configs is exceeded, {'valid':
+    'unknown'}. DFS with a visited set over (k, mask, state) triples; mask is
+    an arbitrary-precision Python int relative to k (bit i == op k+i
+    linearized), so no window-width limit applies on CPU.
+    """
+    n = p.n
+    n_req = p.n_required
+    if n_req == 0:
+        return {"valid": True, "configs-explored": 0}
+
+    f, v1, v2, inv, ret = (p.f.tolist(), p.v1.tolist(), p.v2.tolist(),
+                           p.inv.tolist(), p.ret.tolist())
+    step = kernel.step
+
+    # Precompute candidate offset lists per frontier k: all j >= k with
+    # inv[j] < ret[k] (ops concurrent with the frontier op), lazily.
+    cand_cache: Dict[int, List[int]] = {}
+
+    def candidates(k: int) -> List[int]:
+        c = cand_cache.get(k)
+        if c is None:
+            rk = ret[k]
+            c = [j for j in range(k, n) if inv[j] < rk]
+            cand_cache[k] = c
+        return c
+
+    init = (0, 0, int(p.init_state))
+    stack = [init]
+    seen = {init}
+    explored = 0
+    best_k = 0
+
+    while stack:
+        k, mask, state = stack.pop()
+        explored += 1
+        if max_configs is not None and explored > max_configs:
+            return {"valid": UNKNOWN,
+                    "error": f"config budget {max_configs} exhausted",
+                    "configs-explored": explored,
+                    "max-linearized-prefix": best_k}
+        for j in candidates(k):
+            if (mask >> (j - k)) & 1:
+                continue  # already linearized
+            s2, ok = step(state, f[j], v1[j], v2[j])
+            if not ok:
+                continue
+            if j == k:
+                # advance frontier past consecutively-linearized ops
+                m = mask >> 1
+                k2 = k + 1
+                while m & 1:
+                    m >>= 1
+                    k2 += 1
+                cfg = (k2, m, int(s2))
+            else:
+                cfg = (k, mask | (1 << (j - k)), int(s2))
+            if cfg[0] > best_k:
+                best_k = cfg[0]
+            if cfg[0] >= n_req:
+                return {"valid": True, "configs-explored": explored}
+            if cfg not in seen:
+                seen.add(cfg)
+                stack.append(cfg)
+
+    return {
+        "valid": False,
+        "configs-explored": explored,
+        "max-linearized-prefix": best_k,
+        "frontier-op": _describe_op(p, best_k) if best_k < n else None,
+    }
+
+
+def _describe_op(p: PackedHistory, j: int) -> Optional[dict]:
+    if j >= len(p.ops):
+        return None
+    inv_op, _ = p.ops[j]
+    return inv_op.to_dict() if inv_op is not None else None
+
+
+# ---------------------------------------------------------------------------
+# Generic model-object path
+# ---------------------------------------------------------------------------
+
+def _pair_sorted(history: History) -> List[Tuple[int, int, Op]]:
+    """Pair invocations/completions, drop failed pairs, back-fill ok values
+    into the op used for stepping, sort by (ret, inv). Returns
+    [(inv_ev, ret_ev, op_to_step)]; crashed ops get ret == RET_INF."""
+    pending: Dict[Any, Tuple[int, Op]] = {}
+    rows: List[Tuple[int, int, Op]] = []
+    for ev, o in enumerate(history):
+        if o.is_invoke:
+            pending[o.process] = (ev, o)
+        elif o.process in pending:
+            inv_ev, inv_op = pending.pop(o.process)
+            if o.is_fail:
+                continue
+            if o.is_ok:
+                val = o.value if o.value is not None else inv_op.value
+                rows.append((inv_ev, ev, inv_op.replace(value=val)))
+            else:  # info: pending forever
+                rows.append((inv_ev, int(RET_INF), inv_op))
+    for inv_ev, inv_op in pending.values():
+        rows.append((inv_ev, int(RET_INF), inv_op))
+    rows.sort(key=lambda r: (r[1], r[0]))
+    return rows
+
+
+def check_model(history: History, model: Model,
+                max_configs: Optional[int] = None) -> Dict[str, Any]:
+    """Generic WGL over arbitrary Model objects."""
+    rows = _pair_sorted(history)
+    n = len(rows)
+    n_req = sum(1 for r in rows if r[1] != int(RET_INF))
+    if n_req == 0:
+        return {"valid": True, "configs-explored": 0}
+    inv = [r[0] for r in rows]
+    ret = [r[1] for r in rows]
+    ops = [r[2] for r in rows]
+
+    cand_cache: Dict[int, List[int]] = {}
+
+    def candidates(k: int) -> List[int]:
+        c = cand_cache.get(k)
+        if c is None:
+            rk = ret[k]
+            c = [j for j in range(k, n) if inv[j] < rk]
+            cand_cache[k] = c
+        return c
+
+    init = (0, 0, model)
+    stack = [init]
+    seen = {init}
+    explored = 0
+    best_k = 0
+    while stack:
+        k, mask, m = stack.pop()
+        explored += 1
+        if max_configs is not None and explored > max_configs:
+            return {"valid": UNKNOWN,
+                    "error": f"config budget {max_configs} exhausted",
+                    "configs-explored": explored}
+        for j in candidates(k):
+            if (mask >> (j - k)) & 1:
+                continue
+            m2 = m.step(ops[j])
+            if is_inconsistent(m2):
+                continue
+            if j == k:
+                mm = mask >> 1
+                k2 = k + 1
+                while mm & 1:
+                    mm >>= 1
+                    k2 += 1
+                cfg = (k2, mm, m2)
+            else:
+                cfg = (k, mask | (1 << (j - k)), m2)
+            best_k = max(best_k, cfg[0])
+            if cfg[0] >= n_req:
+                return {"valid": True, "configs-explored": explored}
+            if cfg not in seen:
+                seen.add(cfg)
+                stack.append(cfg)
+    return {
+        "valid": False,
+        "configs-explored": explored,
+        "max-linearized-prefix": best_k,
+        "frontier-op": ops[best_k].to_dict() if best_k < n else None,
+    }
+
+
+class LinearizableChecker(Checker):
+    """Checker facade (reference checker.clj:82-107 'linearizable').
+
+    backend:
+      'cpu'  — this module's WGL (default; knossos-equivalent)
+      'tpu'  — batched JAX search on the default backend (TPU if present);
+               see jepsen_tpu.checker.tpu. Falls back to CPU search when the
+               model has no integer kernel.
+    """
+
+    def __init__(self, model: Optional[Model] = None, backend: str = "cpu",
+                 max_configs: Optional[int] = None):
+        self.model = model
+        self.backend = backend
+        self.max_configs = max_configs
+
+    def check(self, test, history: History, opts=None):
+        model = self.model or test.get("model")
+        if model is None:
+            raise ValueError("linearizable checker needs a model")
+        if self.backend == "tpu":
+            res = None
+            try:
+                from jepsen_tpu.checker.tpu import check_history_tpu
+                res = check_history_tpu(history, model)
+            except ImportError:
+                pass
+            if res is not None and res.get("valid") is not UNKNOWN:
+                return res
+            # fall through to exact CPU search on unknown (e.g. window
+            # overflow or model without an integer kernel)
+        kernel = kernel_spec_for(model)
+        if kernel is not None:
+            from jepsen_tpu.ops.encode import _Interner
+            intern = _Interner()
+            # Non-nil initial register value: intern it first so it becomes
+            # the packed init state.
+            init_value = getattr(model, "value", None)
+            init_id = intern.id(init_value) if init_value is not None else None
+            try:
+                packed = pack_history(history, kernel, intern)
+            except ValueError:
+                return check_model(history, model, self.max_configs)
+            if init_id is not None:
+                packed.init_state = init_id
+            return check_packed(packed, kernel, self.max_configs)
+        return check_model(history, model, self.max_configs)
+
+
+def linearizable(model: Optional[Model] = None, backend: str = "cpu",
+                 max_configs: Optional[int] = None) -> LinearizableChecker:
+    return LinearizableChecker(model, backend, max_configs)

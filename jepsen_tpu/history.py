@@ -1,0 +1,287 @@
+"""Operations and histories: the core data substrate.
+
+A test run produces a *history*: an ordered list of operations. An operation
+is an invocation (``type='invoke'``) or a completion (``'ok'``, ``'fail'`` or
+``'info'``) performed by a logical *process* against the system under test.
+
+This module is the rebuild of the reference's op/history layer: op maps and
+invariants (jepsen/src/jepsen/core.clj:157-163), history indexing and
+invocation/completion pairing (knossos.history, used at core.clj:481 and
+checker.clj:342), and latency extraction (util.clj:557-591).
+
+Design difference from the reference (which uses persistent Clojure maps):
+ops are a slotted dataclass for speed and structure, and histories have a
+columnar, device-ready view in :mod:`jepsen_tpu.ops.encode` — the bit-packed
+encoding every TPU checker consumes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, Iterator, List, Optional, Union
+
+# Process id of the nemesis pseudo-process. The reference uses the keyword
+# :nemesis (core.clj:267-309); we use a negative sentinel so process columns
+# stay integral, with NEMESIS exposed symbolically at the API level.
+NEMESIS = "nemesis"
+
+INVOKE = "invoke"
+OK = "ok"
+FAIL = "fail"
+INFO = "info"
+
+VALID_TYPES = (INVOKE, OK, FAIL, INFO)
+
+
+@dataclass(slots=True)
+class Op:
+    """One operation event.
+
+    Fields mirror the reference's op map {:type :f :value :process :time
+    :index :error} (core.clj:382-402 and knossos.op):
+
+    - type:    'invoke' | 'ok' | 'fail' | 'info'
+    - f:       the function applied, e.g. 'read' / 'write' / 'cas'
+    - value:   argument and/or result (for 'cas', a (old, new) pair)
+    - process: logical process id (int) or 'nemesis'
+    - time:    nanoseconds since test start
+    - index:   position in the history (assigned by History.index())
+    - error:   short failure description for fail/info ops
+    - extra:   open slot for workload-specific keys (like Clojure's open maps)
+    """
+
+    type: str
+    f: Any = None
+    value: Any = None
+    process: Union[int, str, None] = None
+    time: int = 0
+    index: int = -1
+    error: Any = None
+    extra: Optional[dict] = None
+
+    def replace(self, **kw) -> "Op":
+        return dataclasses.replace(self, **kw)
+
+    # -- predicates (knossos.op equivalents) --------------------------------
+    @property
+    def is_invoke(self) -> bool:
+        return self.type == INVOKE
+
+    @property
+    def is_ok(self) -> bool:
+        return self.type == OK
+
+    @property
+    def is_fail(self) -> bool:
+        return self.type == FAIL
+
+    @property
+    def is_info(self) -> bool:
+        return self.type == INFO
+
+    def to_dict(self) -> dict:
+        d = {
+            "type": self.type,
+            "f": self.f,
+            "value": self.value,
+            "process": self.process,
+            "time": self.time,
+            "index": self.index,
+        }
+        if self.error is not None:
+            d["error"] = self.error
+        if self.extra:
+            d.update(self.extra)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Op":
+        known = {"type", "f", "value", "process", "time", "index", "error"}
+        extra = {k: v for k, v in d.items() if k not in known}
+        return cls(
+            type=d["type"],
+            f=d.get("f"),
+            value=d.get("value"),
+            process=d.get("process"),
+            time=d.get("time", 0),
+            index=d.get("index", -1),
+            error=d.get("error"),
+            extra=extra or None,
+        )
+
+    def __str__(self) -> str:
+        err = f"\t{self.error}" if self.error is not None else ""
+        return f"{self.process}\t{self.type}\t{self.f}\t{self.value}{err}"
+
+
+def op(type: str, f: Any = None, value: Any = None, **kw) -> Op:
+    """Convenience constructor."""
+    return Op(type=type, f=f, value=value, **kw)
+
+
+def invoke(f: Any = None, value: Any = None, **kw) -> Op:
+    return Op(type=INVOKE, f=f, value=value, **kw)
+
+
+# Predicate helpers usable on Op or dict (knossos.op/invoke? ok? etc).
+def _ty(o) -> str:
+    return o.type if isinstance(o, Op) else o["type"]
+
+
+def is_invoke(o) -> bool:
+    return _ty(o) == INVOKE
+
+
+def is_ok(o) -> bool:
+    return _ty(o) == OK
+
+
+def is_fail(o) -> bool:
+    return _ty(o) == FAIL
+
+
+def is_info(o) -> bool:
+    return _ty(o) == INFO
+
+
+class History(List[Op]):
+    """A history is a list of Ops with analysis helpers.
+
+    Subclasses list so checkers can treat it as a plain sequence, mirroring
+    the reference where a history is a vector of op maps.
+    """
+
+    # -- construction -------------------------------------------------------
+    @classmethod
+    def of(cls, ops: Iterable[Union[Op, dict]]) -> "History":
+        h = cls()
+        for o in ops:
+            h.append(o if isinstance(o, Op) else Op.from_dict(o))
+        return h
+
+    def index(self) -> "History":
+        """Assign sequential :index to each op in place and return self
+        (knossos.history/index; invoked at core.clj:481)."""
+        for i, o in enumerate(self):
+            o.index = i
+        return self
+
+    # -- views --------------------------------------------------------------
+    def invocations(self) -> Iterator[Op]:
+        return (o for o in self if o.is_invoke)
+
+    def completions(self) -> Iterator[Op]:
+        return (o for o in self if not o.is_invoke)
+
+    def oks(self) -> Iterator[Op]:
+        return (o for o in self if o.is_ok)
+
+    def processes(self) -> list:
+        """Distinct processes in order of first appearance
+        (knossos.history/processes)."""
+        seen = {}
+        for o in self:
+            if o.process not in seen:
+                seen[o.process] = True
+        return list(seen)
+
+    def complete(self) -> "History":
+        """Pair invocations with their completions (knossos.history/complete):
+
+        - an 'invoke' followed by an 'ok' from the same process gets the
+          completion's value filled back into the invocation (so models can
+          see reads' results at invocation time);
+        - an invoke whose process crashes ('info') stays an invoke with the
+          completion appended; a 'fail'ed invoke is known not to have happened.
+
+        Returns a new History; does not mutate self.
+        """
+        out = History()
+        pending: dict = {}
+        for o in self:
+            if o.is_invoke:
+                c = o.replace()
+                pending[o.process] = c
+                out.append(c)
+            else:
+                inv = pending.pop(o.process, None)
+                if inv is not None and o.is_ok and inv.value is None:
+                    inv.value = o.value
+                out.append(o.replace())
+        return out
+
+    def pairs(self) -> Iterator[tuple]:
+        """Yield (invocation, completion-or-None) pairs in invocation order
+        (the pairing rule of util.clj:557-591: completion is the next op by
+        the same process)."""
+        pending: dict = {}
+        order: list = []
+        for o in self:
+            if o.is_invoke:
+                pending[o.process] = [o, None]
+                order.append(pending[o.process])
+            else:
+                slot = pending.pop(o.process, None)
+                if slot is not None:
+                    slot[1] = o
+                else:
+                    # Completion with no invocation (e.g. nemesis info pairs
+                    # are matched the same way; unmatched ones yield (None, o))
+                    order.append([None, o])
+        for inv, comp in order:
+            yield inv, comp
+
+    def latencies(self) -> list:
+        """[(invoke_op, latency_nanos)] for each completed operation
+        (util.clj:557-591)."""
+        out = []
+        for inv, comp in self.pairs():
+            if inv is not None and comp is not None:
+                out.append((inv, comp.time - inv.time))
+        return out
+
+    # -- filtering ----------------------------------------------------------
+    def filter(self, pred: Callable[[Op], bool]) -> "History":
+        return History(o for o in self if pred(o))
+
+    def remove_failures(self) -> "History":
+        """Drop failed invocations and their 'fail' completions: a failed op
+        is known not to have taken place (knossos semantics; see
+        checker.clj:119-123 usage of op predicates)."""
+        # A 'fail' completion marks the process's open invocation as failed.
+        failed_invocation_ids = set()
+        open_by_proc: dict = {}
+        for i, o in enumerate(self):
+            if o.is_invoke:
+                open_by_proc[o.process] = i
+            elif o.is_fail:
+                j = open_by_proc.pop(o.process, None)
+                failed_invocation_ids.add(i)
+                if j is not None:
+                    failed_invocation_ids.add(j)
+            else:
+                open_by_proc.pop(o.process, None)
+        return History(o for i, o in enumerate(self)
+                       if i not in failed_invocation_ids)
+
+    # -- serialization ------------------------------------------------------
+    def to_jsonl(self) -> str:
+        return "\n".join(json.dumps(o.to_dict(), default=_json_default)
+                         for o in self)
+
+    @classmethod
+    def from_jsonl(cls, text: str) -> "History":
+        h = cls()
+        for line in text.splitlines():
+            line = line.strip()
+            if line:
+                h.append(Op.from_dict(json.loads(line)))
+        return h
+
+
+def _json_default(x):
+    if isinstance(x, (set, frozenset, tuple)):
+        return list(x)
+    return str(x)

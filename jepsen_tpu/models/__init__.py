@@ -1,0 +1,33 @@
+"""Pure functional models of datatypes for linearizability checking.
+
+Rebuild of jepsen.model (jepsen/src/jepsen/model.clj) + the knossos.model
+protocol it re-exports. See :mod:`jepsen_tpu.models.core`.
+"""
+
+from jepsen_tpu.models.core import (  # noqa: F401
+    Model,
+    Inconsistent,
+    inconsistent,
+    is_inconsistent,
+    NoOp,
+    CASRegister,
+    Register,
+    Mutex,
+    SetModel,
+    UnorderedQueue,
+    FIFOQueue,
+    cas_register,
+    mutex,
+    noop,
+    fifo_queue,
+    unordered_queue,
+    set_model,
+    KernelSpec,
+    kernel_spec_for,
+    F_READ,
+    F_WRITE,
+    F_CAS,
+    F_ACQUIRE,
+    F_RELEASE,
+    NIL_ID,
+)

@@ -1,0 +1,373 @@
+"""Stepped-datatype models.
+
+A model is an immutable value with a ``step(op) -> model'`` function; stepping
+with an operation the datatype cannot have performed yields an
+:class:`Inconsistent` result. This is the knossos ``Model`` interface
+(re-exported by the reference at jepsen/src/jepsen/model.clj:4,11 and
+documented verbatim in doc/checker.md:43-56), with the reference's model zoo:
+CASRegister (model.clj:21-35), Mutex (42-51), Set (58-66), UnorderedQueue
+(73-80), FIFOQueue (87-100), NoOp (13-15).
+
+TPU-first addition: models whose state fits in a machine word also carry a
+:class:`KernelSpec` — a *branchless integer transition function*
+``step(state, f, v1, v2) -> (state', ok)`` written against the numpy
+operator surface so it runs identically under numpy, ``jax.numpy`` and
+``jax.vmap``. The batched WGL checker (jepsen_tpu.checker.tpu) explores
+thousands of model configurations per TPU vector lane through these kernels.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Tuple
+
+from jepsen_tpu.history import Op
+
+# ---------------------------------------------------------------------------
+# Core protocol
+# ---------------------------------------------------------------------------
+
+
+class Model:
+    """Immutable stepped model. Subclasses implement step()."""
+
+    def step(self, op: Op) -> "Model":
+        raise NotImplementedError
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.__dict__ == other.__dict__
+
+    def __hash__(self):
+        return hash((type(self), tuple(sorted(self.__dict__.items(),
+                                              key=lambda kv: kv[0]))))
+
+
+class Inconsistent(Model):
+    """Terminal model state: the op sequence is not consistent with the
+    datatype (knossos.model/inconsistent)."""
+
+    def __init__(self, msg: str):
+        self.msg = msg
+
+    def step(self, op: Op) -> "Model":
+        return self
+
+    def __repr__(self):
+        return f"Inconsistent({self.msg!r})"
+
+    def __eq__(self, other):
+        return isinstance(other, Inconsistent)
+
+    def __hash__(self):
+        return hash(Inconsistent)
+
+
+def inconsistent(msg: str) -> Inconsistent:
+    return Inconsistent(msg)
+
+
+def is_inconsistent(m: Any) -> bool:
+    return isinstance(m, Inconsistent)
+
+
+class NoOp(Model):
+    """A model which considers any operation valid (model.clj:13-15)."""
+
+    def step(self, op: Op) -> Model:
+        return self
+
+    def __repr__(self):
+        return "NoOp"
+
+
+class CASRegister(Model):
+    """A register supporting read / write / cas (model.clj:21-35).
+
+    - write v     -> value := v
+    - cas (o, n)  -> if value == o then value := n else inconsistent
+    - read v      -> consistent iff v is None (don't-care) or v == value
+    """
+
+    __slots__ = ("value",)
+
+    def __init__(self, value: Any = None):
+        self.value = value
+
+    def step(self, op: Op) -> Model:
+        f, v = op.f, op.value
+        if f == "write":
+            return CASRegister(v)
+        if f == "cas":
+            if v is None:
+                return inconsistent("cas with nil value")
+            old, new = v
+            if self.value == old:
+                return CASRegister(new)
+            return inconsistent(f"can't CAS {self.value} from {old} to {new}")
+        if f == "read":
+            if v is None or v == self.value:
+                return self
+            return inconsistent(f"can't read {v} from register {self.value}")
+        return inconsistent(f"unknown op f={f}")
+
+    def __eq__(self, other):
+        return isinstance(other, CASRegister) and self.value == other.value
+
+    def __hash__(self):
+        return hash(("CASRegister", self.value))
+
+    def __repr__(self):
+        return f"CASRegister({self.value!r})"
+
+
+#: Alias: a plain read/write register is a CASRegister that never sees cas.
+Register = CASRegister
+
+
+class Mutex(Model):
+    """A single mutex (model.clj:42-51): acquire/release."""
+
+    __slots__ = ("locked",)
+
+    def __init__(self, locked: bool = False):
+        self.locked = locked
+
+    def step(self, op: Op) -> Model:
+        if op.f == "acquire":
+            if self.locked:
+                return inconsistent("cannot acquire a locked mutex")
+            return Mutex(True)
+        if op.f == "release":
+            if not self.locked:
+                return inconsistent("cannot release a free mutex")
+            return Mutex(False)
+        return inconsistent(f"unknown op f={op.f}")
+
+    def __eq__(self, other):
+        return isinstance(other, Mutex) and self.locked == other.locked
+
+    def __hash__(self):
+        return hash(("Mutex", self.locked))
+
+    def __repr__(self):
+        return f"Mutex(locked={self.locked})"
+
+
+class SetModel(Model):
+    """A grow-only set with add / read (model.clj:58-66)."""
+
+    __slots__ = ("items",)
+
+    def __init__(self, items: frozenset = frozenset()):
+        self.items = frozenset(items)
+
+    def step(self, op: Op) -> Model:
+        if op.f == "add":
+            return SetModel(self.items | {op.value})
+        if op.f == "read":
+            if op.value is None or set(op.value) == set(self.items):
+                return self
+            return inconsistent(
+                f"can't read {op.value} from set {sorted(self.items)}")
+        return inconsistent(f"unknown op f={op.f}")
+
+    def __eq__(self, other):
+        return isinstance(other, SetModel) and self.items == other.items
+
+    def __hash__(self):
+        return hash(("SetModel", self.items))
+
+    def __repr__(self):
+        return f"SetModel({sorted(self.items)!r})"
+
+
+class UnorderedQueue(Model):
+    """A queue which does not order its pending elements (model.clj:73-80):
+    dequeue may return any enqueued-but-not-dequeued element."""
+
+    __slots__ = ("pending",)
+
+    def __init__(self, pending: Tuple = ()):
+        # multiset as sorted tuple of (repr-key, value) is overkill; use tuple
+        # with counting semantics.
+        self.pending = tuple(pending)
+
+    def step(self, op: Op) -> Model:
+        if op.f == "enqueue":
+            return UnorderedQueue(self.pending + (op.value,))
+        if op.f == "dequeue":
+            if op.value in self.pending:
+                p = list(self.pending)
+                p.remove(op.value)
+                return UnorderedQueue(tuple(p))
+            return inconsistent(f"can't dequeue {op.value}")
+        return inconsistent(f"unknown op f={op.f}")
+
+    def __eq__(self, other):
+        return (isinstance(other, UnorderedQueue)
+                and sorted(map(repr, self.pending))
+                == sorted(map(repr, other.pending)))
+
+    def __hash__(self):
+        return hash(("UnorderedQueue", tuple(sorted(map(repr, self.pending)))))
+
+    def __repr__(self):
+        return f"UnorderedQueue({list(self.pending)!r})"
+
+
+class FIFOQueue(Model):
+    """A strictly-ordered queue (model.clj:87-100)."""
+
+    __slots__ = ("queue",)
+
+    def __init__(self, queue: Tuple = ()):
+        self.queue = tuple(queue)
+
+    def step(self, op: Op) -> Model:
+        if op.f == "enqueue":
+            return FIFOQueue(self.queue + (op.value,))
+        if op.f == "dequeue":
+            if not self.queue:
+                return inconsistent("can't dequeue from empty queue")
+            head, rest = self.queue[0], self.queue[1:]
+            if head == op.value:
+                return FIFOQueue(rest)
+            return inconsistent(f"expected {head}, dequeued {op.value}")
+        return inconsistent(f"unknown op f={op.f}")
+
+    def __eq__(self, other):
+        return isinstance(other, FIFOQueue) and self.queue == other.queue
+
+    def __hash__(self):
+        return hash(("FIFOQueue", self.queue))
+
+    def __repr__(self):
+        return f"FIFOQueue({list(self.queue)!r})"
+
+
+# Constructor helpers matching the reference's lower-case factories.
+def noop() -> NoOp:
+    return NoOp()
+
+
+def cas_register(value: Any = None) -> CASRegister:
+    return CASRegister(value)
+
+
+def register(value: Any = None) -> CASRegister:
+    return CASRegister(value)
+
+
+def mutex() -> Mutex:
+    return Mutex()
+
+
+def set_model() -> SetModel:
+    return SetModel()
+
+
+def unordered_queue() -> UnorderedQueue:
+    return UnorderedQueue()
+
+
+def fifo_queue() -> FIFOQueue:
+    return FIFOQueue()
+
+
+# ---------------------------------------------------------------------------
+# Integer transition kernels (TPU surface)
+# ---------------------------------------------------------------------------
+#
+# The batched linearizability checker encodes each op as (f, v1, v2) integer
+# columns (see jepsen_tpu.ops.encode) and each model configuration as a single
+# int32 state. A KernelSpec supplies the initial state and a branchless step
+# function over those integers. ok is returned as a boolean array; state' is
+# unspecified where ok is False (the caller discards those configurations).
+
+# f-codes shared by encoder and kernels.
+F_READ = 0
+F_WRITE = 1
+F_CAS = 2
+F_ACQUIRE = 3
+F_RELEASE = 4
+
+#: Interned id for None / "don't care" values.
+NIL_ID = -1
+
+
+@dataclass(frozen=True)
+class KernelSpec:
+    """Branchless integer semantics of a model.
+
+    step(state, f, v1, v2) -> (state', ok). All arguments may be scalars or
+    arrays (numpy or jax.numpy); only ufunc-style operations are used, so the
+    same function runs on host for the CPU checker and under vmap/jit for the
+    TPU checker.
+    """
+
+    name: str
+    init_state: int
+    step: Callable  # (state, f, v1, v2) -> (state', ok)
+    f_codes: dict   # op.f -> int code
+
+
+def _cas_register_step(state, f, v1, v2):
+    is_read = f == F_READ
+    is_write = f == F_WRITE
+    is_cas = f == F_CAS
+    read_ok = (v1 == NIL_ID) | (state == v1)
+    cas_ok = state == v1
+    ok = (is_read & read_ok) | is_write | (is_cas & cas_ok)
+    # next state: write -> v1; cas-ok -> v2; else unchanged
+    state1 = state * (1 - is_write) + v1 * is_write
+    take_cas = is_cas & cas_ok
+    state2 = state1 * (1 - take_cas) + v2 * take_cas
+    return state2, ok
+
+
+def _mutex_step(state, f, v1, v2):
+    is_acq = f == F_ACQUIRE
+    is_rel = f == F_RELEASE
+    ok = (is_acq & (state == 0)) | (is_rel & (state == 1))
+    state1 = state * (1 - is_acq) + is_acq  # acquire -> 1
+    state2 = state1 * (1 - is_rel)          # release -> 0
+    return state2, ok
+
+
+def _noop_step(state, f, v1, v2):
+    return state, (f == f)  # always ok, shape-matching
+
+
+CAS_REGISTER_KERNEL = KernelSpec(
+    name="cas-register",
+    init_state=NIL_ID,
+    step=_cas_register_step,
+    f_codes={"read": F_READ, "write": F_WRITE, "cas": F_CAS},
+)
+
+MUTEX_KERNEL = KernelSpec(
+    name="mutex",
+    init_state=0,
+    step=_mutex_step,
+    f_codes={"acquire": F_ACQUIRE, "release": F_RELEASE},
+)
+
+NOOP_KERNEL = KernelSpec(
+    name="noop",
+    init_state=0,
+    step=_noop_step,
+    f_codes={},
+)
+
+
+def kernel_spec_for(model: Model) -> Optional[KernelSpec]:
+    """Return the integer KernelSpec for a model instance, or None if the
+    model's state does not fit the single-word encoding (sets/queues use the
+    dedicated fold checkers instead of linearization search)."""
+    if isinstance(model, CASRegister):
+        return CAS_REGISTER_KERNEL
+    if isinstance(model, Mutex):
+        return MUTEX_KERNEL
+    if isinstance(model, NoOp):
+        return NOOP_KERNEL
+    return None

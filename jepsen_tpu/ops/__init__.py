@@ -1,0 +1,8 @@
+"""Device-ready op encodings (see jepsen_tpu.ops.encode)."""
+
+from jepsen_tpu.ops.encode import (  # noqa: F401
+    PackedHistory,
+    pack_history,
+    pack_keyed_histories,
+    RET_INF,
+)

@@ -1,0 +1,242 @@
+"""Bit-packed, columnar history encoding — the TPU device format.
+
+The reference keeps histories as vectors of Clojure maps and hands them to
+knossos, which searches over them with JVM objects (SURVEY §2.3). Here the
+history is *compiled* once, host-side, into fixed-width integer columns that
+ship to the device:
+
+- per operation: f-code (int32), v1/v2 (interned value ids, int32),
+  inv/ret (event indices, int32; RET_INF for crashed ops), process (int32)
+- operations sorted by return index, so the WGL frontier rule "ops returning
+  before the first unlinearized op are all linearized" becomes a prefix
+  property and a configuration compresses to (prefix length k, window bitmask,
+  model state) — one packed uint64 per configuration.
+
+Pairing semantics mirror knossos.history/complete (reference
+checker.clj:342): an ok completion's value back-fills the invocation (reads);
+'fail' pairs are dropped (the op is known not to have happened); 'info' pairs
+are pending forever (RET_INF) and may be linearized optionally.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from jepsen_tpu.history import History, Op
+from jepsen_tpu.models.core import KernelSpec, NIL_ID, F_READ
+
+#: Sentinel return index for operations that never returned (crashed 'info'
+#: ops): effectively +infinity, still well inside int32.
+RET_INF = np.int32(2**31 - 1)
+
+
+@dataclass
+class PackedHistory:
+    """Columnar encoding of one (single-key) history, sorted by return index.
+
+    n ops; n_required = number of ops that MUST be linearized (finite ret,
+    i.e. 'ok' completions). Ops with ret == RET_INF are crashed ('info') ops
+    that MAY be linearized. value_table maps interned ids back to Python
+    values for counterexample reporting.
+    """
+
+    f: np.ndarray        # int32[n] f-codes
+    v1: np.ndarray       # int32[n]
+    v2: np.ndarray       # int32[n]
+    inv: np.ndarray      # int32[n] invocation event index
+    ret: np.ndarray      # int32[n] return event index or RET_INF
+    process: np.ndarray  # int32[n]
+    n_required: int
+    init_state: int
+    value_table: List[Any] = field(default_factory=list)
+    ops: List[Tuple[Optional[Op], Optional[Op]]] = field(default_factory=list)
+
+    @property
+    def n(self) -> int:
+        return int(self.f.shape[0])
+
+    def max_concurrency(self) -> int:
+        """Max number of ops pending at any event time — bounds the WGL
+        window size the device search needs."""
+        if self.n == 0:
+            return 0
+        events = []
+        for i in range(self.n):
+            events.append((int(self.inv[i]), 1))
+            if int(self.ret[i]) != int(RET_INF):
+                events.append((int(self.ret[i]), -1))
+        events.sort()
+        cur = peak = 0
+        for _, d in events:
+            cur += d
+            peak = max(peak, cur)
+        # crashed ops stay pending forever
+        return peak
+
+    def pad_to(self, n: int) -> "PackedHistory":
+        """Right-pad columns to length n with never-linearizable filler ops
+        (inv = RET_INF so they are never candidates)."""
+        k = n - self.n
+        if k < 0:
+            raise ValueError(f"cannot pad {self.n} down to {n}")
+        if k == 0:
+            return self
+
+        def pad(a, fill):
+            return np.concatenate(
+                [a, np.full(k, fill, dtype=a.dtype)])
+
+        return PackedHistory(
+            f=pad(self.f, 0),
+            v1=pad(self.v1, NIL_ID),
+            v2=pad(self.v2, NIL_ID),
+            inv=pad(self.inv, RET_INF),
+            ret=pad(self.ret, RET_INF),
+            process=pad(self.process, -1),
+            n_required=self.n_required,
+            init_state=self.init_state,
+            value_table=self.value_table,
+            ops=self.ops,
+        )
+
+
+class _Interner:
+    def __init__(self):
+        self.table: Dict[Any, int] = {}
+        self.values: List[Any] = []
+
+    def id(self, v: Any) -> int:
+        if v is None:
+            return int(NIL_ID)
+        key = v if isinstance(v, (int, str, bool, float, tuple)) else repr(v)
+        i = self.table.get(key)
+        if i is None:
+            i = len(self.values)
+            self.table[key] = i
+            self.values.append(v)
+        return i
+
+
+def _op_values(f_code: int, f: Any, inv_value: Any, ok_value: Any,
+               intern: _Interner) -> Tuple[int, int]:
+    """Split an op's value into (v1, v2) interned ids.
+
+    cas carries (old, new); reads use the *completion* value (knossos
+    complete-fills reads); writes use the invocation value.
+    """
+    if f == "cas":
+        v = inv_value
+        if v is None:
+            return int(NIL_ID), int(NIL_ID)
+        old, new = v
+        return intern.id(old), intern.id(new)
+    if f_code == F_READ or f == "read":
+        return intern.id(ok_value if ok_value is not None else inv_value), int(NIL_ID)
+    return intern.id(inv_value), int(NIL_ID)
+
+
+def pack_history(history: Sequence[Op], kernel: KernelSpec,
+                 intern: Optional[_Interner] = None) -> PackedHistory:
+    """Compile a raw single-key history into a PackedHistory.
+
+    Steps: (1) walk events assigning event indices; (2) pair invocations with
+    completions per process; (3) drop failed pairs and crashed reads (a
+    crashed read constrains nothing); (4) intern values; (5) sort ops by
+    return index (RET_INF last, tie-broken by invocation index).
+    """
+    intern = intern or _Interner()
+    pending: Dict[Any, Tuple[int, Op]] = {}
+    rows = []  # (inv_idx, ret_idx, f, v1, v2, process, inv_op, comp_op)
+
+    for ev, o in enumerate(history):
+        if o.is_invoke:
+            pending[o.process] = (ev, o)
+        elif o.process in pending:
+            inv_ev, inv_op = pending.pop(o.process)
+            if o.is_fail:
+                continue  # known not to have happened
+            fc = kernel.f_codes.get(inv_op.f)
+            if fc is None:
+                raise ValueError(
+                    f"op f={inv_op.f!r} not supported by model "
+                    f"{kernel.name!r} (codes: {sorted(kernel.f_codes)})")
+            if o.is_info:
+                if fc == F_READ:
+                    continue  # crashed read constrains nothing
+                v1, v2 = _op_values(fc, inv_op.f, inv_op.value, None, intern)
+                rows.append((inv_ev, int(RET_INF), fc, v1, v2,
+                             inv_op.process, inv_op, o))
+            else:  # ok
+                v1, v2 = _op_values(fc, inv_op.f, inv_op.value, o.value,
+                                    intern)
+                rows.append((inv_ev, ev, fc, v1, v2, inv_op.process,
+                             inv_op, o))
+    # invocations with no completion at all == crashed (same as info)
+    for inv_ev, inv_op in pending.values():
+        fc = kernel.f_codes.get(inv_op.f)
+        if fc is None or fc == F_READ:
+            continue
+        v1, v2 = _op_values(fc, inv_op.f, inv_op.value, None, intern)
+        rows.append((inv_ev, int(RET_INF), fc, v1, v2, inv_op.process,
+                     inv_op, None))
+
+    # sort by (ret, inv)
+    rows.sort(key=lambda r: (r[1], r[0]))
+    n = len(rows)
+    n_required = sum(1 for r in rows if r[1] != int(RET_INF))
+
+    def col(i, dtype=np.int32):
+        return np.asarray([r[i] for r in rows], dtype=dtype)
+
+    procs = {}
+    proc_col = []
+    for r in rows:
+        p = r[5]
+        if p not in procs:
+            procs[p] = len(procs)
+        proc_col.append(procs[p])
+
+    return PackedHistory(
+        f=col(2), v1=col(3), v2=col(4), inv=col(0), ret=col(1),
+        process=np.asarray(proc_col, dtype=np.int32) if n else
+        np.zeros(0, np.int32),
+        n_required=n_required,
+        init_state=kernel.init_state,
+        value_table=intern.values,
+        ops=[(r[6], r[7]) for r in rows],
+    )
+
+
+def pack_keyed_histories(keyed: Dict[Any, Sequence[Op]],
+                         kernel: KernelSpec) -> Tuple[list, dict]:
+    """Pack a {key: history} map (the independent-key axis, reference
+    independent.clj:65-219) into a list of equal-length PackedHistories plus
+    batched arrays ready for vmap/sharding.
+
+    Returns (packed_list, batch) where batch is a dict of stacked np arrays:
+    f, v1, v2, inv, ret: int32[K, n_max]; n_required: int32[K];
+    init_state: int32[K].
+    """
+    keys = list(keyed.keys())
+    packed = [pack_history(keyed[k], kernel) for k in keys]
+    n_max = max((p.n for p in packed), default=0)
+    padded = [p.pad_to(n_max) for p in packed]
+    batch = {
+        "f": np.stack([p.f for p in padded]) if padded else
+        np.zeros((0, 0), np.int32),
+        "v1": np.stack([p.v1 for p in padded]) if padded else
+        np.zeros((0, 0), np.int32),
+        "v2": np.stack([p.v2 for p in padded]) if padded else
+        np.zeros((0, 0), np.int32),
+        "inv": np.stack([p.inv for p in padded]) if padded else
+        np.zeros((0, 0), np.int32),
+        "ret": np.stack([p.ret for p in padded]) if padded else
+        np.zeros((0, 0), np.int32),
+        "n_required": np.asarray([p.n_required for p in padded], np.int32),
+        "init_state": np.asarray([p.init_state for p in padded], np.int32),
+        "keys": keys,
+    }
+    return packed, batch

@@ -1,0 +1,256 @@
+"""Kitchen-sink utilities for the jepsen_tpu framework.
+
+TPU-native rebuild of the reference's ``jepsen.util`` namespace
+(reference: jepsen/src/jepsen/util.clj). Host-side pure Python: timing with
+nanosecond resolution, unbounded parallel map, retries, majority math,
+interval-set rendering, and early-return helpers.
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _time
+from concurrent.futures import ThreadPoolExecutor
+from contextlib import contextmanager
+from typing import Any, Callable, Iterable, Sequence
+
+
+def majority(n: int) -> int:
+    """Smallest integer strictly greater than half of n.
+
+    Reference semantics: util.clj:57-60 ("what number of nodes does a majority
+    quorum require").
+    """
+    return n // 2 + 1
+
+
+def minority(n: int) -> int:
+    """Largest number of nodes that is NOT a majority of n."""
+    return (n - 1) // 2
+
+
+def real_pmap(f: Callable, coll: Iterable) -> list:
+    """Unbounded parallel map over ``coll`` using real threads.
+
+    Mirrors util.clj:44-50: one thread per element (the reference uses this
+    for per-node SSH fan-out where elements are few and I/O-bound). Exceptions
+    propagate to the caller (first one raised wins).
+    """
+    items = list(coll)
+    if not items:
+        return []
+    if len(items) == 1:
+        return [f(items[0])]
+    with ThreadPoolExecutor(max_workers=len(items)) as pool:
+        return list(pool.map(f, items))
+
+
+def fcatch(f: Callable) -> Callable:
+    """Wrap f so thrown exceptions are returned instead (util.clj:62-68)."""
+
+    def wrapper(*args, **kwargs):
+        try:
+            return f(*args, **kwargs)
+        except Exception as e:  # noqa: BLE001 - by design
+            return e
+
+    return wrapper
+
+
+# ---------------------------------------------------------------------------
+# Time. The reference records op times as nanoseconds relative to a per-test
+# origin (util.clj:235-260). time.monotonic_ns is the Python equivalent of
+# System/nanoTime.
+# ---------------------------------------------------------------------------
+
+_GLOBAL_ORIGIN: list = [None]  # origin shared across threads
+
+
+def linear_time_nanos() -> int:
+    """A linear time source in nanoseconds (util.clj:235-238)."""
+    return _time.monotonic_ns()
+
+
+@contextmanager
+def with_relative_time():
+    """Bind a new origin for relative-time-nanos within this block
+    (util.clj:240-252). The origin is global (shared by worker threads spawned
+    inside the block), matching the reference's root binding via ``binding``
+    around the whole run."""
+    prev = _GLOBAL_ORIGIN[0]
+    _GLOBAL_ORIGIN[0] = linear_time_nanos()
+    try:
+        yield
+    finally:
+        _GLOBAL_ORIGIN[0] = prev
+
+
+def relative_time_nanos() -> int:
+    """Nanoseconds since the most recent with_relative_time origin."""
+    origin = _GLOBAL_ORIGIN[0]
+    if origin is None:
+        origin = _GLOBAL_ORIGIN[0] = linear_time_nanos()
+    return linear_time_nanos() - origin
+
+
+def sleep(dt_seconds: float) -> None:
+    """High-resolution sleep (util.clj:254-260)."""
+    if dt_seconds > 0:
+        _time.sleep(dt_seconds)
+
+
+def sleep_nanos(dt: int) -> None:
+    if dt > 0:
+        _time.sleep(dt / 1e9)
+
+
+class Timeout(Exception):
+    pass
+
+
+def timeout(ms: float, timeout_val: Any, f: Callable, *args):
+    """Run f in a separate thread; if it does not finish within ms
+    milliseconds, return timeout_val (util.clj:275-286).
+
+    Like the reference (future-cancel), the underlying thread is abandoned,
+    not killed -- callers must make f itself interruptible for hard cleanup.
+    """
+    result: list = []
+    error: list = []
+
+    def run():
+        try:
+            result.append(f(*args))
+        except Exception as e:  # noqa: BLE001
+            error.append(e)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(ms / 1000.0)
+    if t.is_alive():
+        return timeout_val
+    if error:
+        raise error[0]
+    return result[0]
+
+
+def retry(dt_seconds: float, f: Callable, *args, retries: int | None = None):
+    """Call f; on exception sleep dt seconds and retry (util.clj:288-297).
+
+    retries=None retries forever like the reference; pass a bound for tests.
+    """
+    attempt = 0
+    while True:
+        try:
+            return f(*args)
+        except Exception:  # noqa: BLE001
+            attempt += 1
+            if retries is not None and attempt > retries:
+                raise
+            sleep(dt_seconds)
+
+
+# ---------------------------------------------------------------------------
+# Formatting helpers
+# ---------------------------------------------------------------------------
+
+def name_or_str(x: Any) -> str:
+    return getattr(x, "__name__", None) or str(x)
+
+
+def integer_interval_set_str(xs: Iterable[int]) -> str:
+    """Render a set of integers as compact intervals: #{1..3 5} —
+    util.clj:487-512."""
+    xs = sorted(set(xs))
+    parts = []
+    i = 0
+    while i < len(xs):
+        j = i
+        while j + 1 < len(xs) and xs[j + 1] == xs[j] + 1:
+            j += 1
+        if j == i:
+            parts.append(str(xs[i]))
+        else:
+            parts.append(f"{xs[i]}..{xs[j]}")
+        i = j + 1
+    return "#{" + " ".join(parts) + "}"
+
+
+def longest_common_prefix(strings: Sequence[Sequence]) -> Sequence:
+    """Longest common prefix of a collection of sequences (util.clj:612-626)."""
+    if not strings:
+        return []
+    first = strings[0]
+    n = min(len(s) for s in strings)
+    out = 0
+    for i in range(n):
+        if all(s[i] == first[i] for s in strings):
+            out = i + 1
+        else:
+            break
+    return first[:out]
+
+
+def drop_common_proper_prefix(strings: Sequence[Sequence]) -> list:
+    """Drop the longest common proper prefix (keeps at least one element of
+    each) — util.clj:628-634."""
+    p = len(longest_common_prefix(strings))
+    if strings and p and p == min(len(s) for s in strings):
+        p -= 1
+    return [s[p:] for s in strings]
+
+
+def chunk_vec(n: int, v: Sequence) -> list:
+    """Partition v into chunks of size n (util.clj:82-91)."""
+    return [v[i:i + n] for i in range(0, len(v), n)]
+
+
+class LazyAtom:
+    """An atom whose initial value is computed lazily on first access, at most
+    once (util.clj:636-686)."""
+
+    def __init__(self, init_fn: Callable[[], Any]):
+        self._init_fn = init_fn
+        self._lock = threading.RLock()
+        self._set = False
+        self._value = None
+
+    def _ensure(self):
+        if not self._set:
+            with self._lock:
+                if not self._set:
+                    self._value = self._init_fn()
+                    self._set = True
+
+    def deref(self):
+        self._ensure()
+        return self._value
+
+    def swap(self, f: Callable, *args):
+        with self._lock:
+            self._ensure()
+            self._value = f(self._value, *args)
+            return self._value
+
+    def reset(self, v):
+        with self._lock:
+            self._set = True
+            self._value = v
+            return v
+
+
+class Atom(LazyAtom):
+    """Thread-safe mutable reference with swap/reset/deref semantics."""
+
+    def __init__(self, value: Any = None):
+        super().__init__(lambda: value)
+
+
+def rand_exp(mean: float, rng=None) -> float:
+    """Exponentially-distributed random value with given mean; used for
+    stagger-style pacing (generator.clj:137-141 uses uniform; exponential
+    matches later jepsen versions and gives nicer Poisson arrivals)."""
+    import math
+    import random as _random
+    r = (rng or _random).random()
+    return -mean * math.log(1.0 - r)

@@ -1,0 +1,196 @@
+"""Fold checker truth tables — mirrors reference checker_test.clj."""
+
+from jepsen_tpu.checker import (
+    compose, check_safe, merge_valid, noop_checker,
+    set_checker, counter, queue, total_queue, unique_ids, UNKNOWN)
+from jepsen_tpu.history import History, Op
+from jepsen_tpu.models import unordered_queue, fifo_queue
+
+
+def H(*rows):
+    return History.of([
+        Op(type=t, f=f, value=v, process=p, time=i)
+        for i, (p, t, f, v) in enumerate(rows)
+    ])
+
+
+class TestMergeValid:
+    def test_priorities(self):
+        assert merge_valid([True, True]) is True
+        assert merge_valid([True, UNKNOWN]) == UNKNOWN
+        assert merge_valid([UNKNOWN, False]) is False
+        assert merge_valid([True, False, UNKNOWN]) is False
+        assert merge_valid([]) is True
+
+
+class TestSetChecker:
+    def test_all_there(self):
+        h = H((0, "invoke", "add", 0), (0, "ok", "add", 0),
+              (1, "invoke", "add", 1), (1, "ok", "add", 1),
+              (2, "invoke", "read", None), (2, "ok", "read", [0, 1]))
+        r = set_checker().check({}, h)
+        assert r["valid"] is True
+        assert r["ok-count"] == 2
+
+    def test_lost(self):
+        h = H((0, "invoke", "add", 0), (0, "ok", "add", 0),
+              (2, "invoke", "read", None), (2, "ok", "read", []))
+        r = set_checker().check({}, h)
+        assert r["valid"] is False
+        assert r["lost-count"] == 1
+
+    def test_recovered_ok(self):
+        # indeterminate add that shows up: fine
+        h = H((0, "invoke", "add", 0), (0, "info", "add", 0),
+              (2, "invoke", "read", None), (2, "ok", "read", [0]))
+        r = set_checker().check({}, h)
+        assert r["valid"] is True
+        assert r["recovered-count"] == 1
+
+    def test_unexpected(self):
+        h = H((2, "invoke", "read", None), (2, "ok", "read", [99]))
+        r = set_checker().check({}, h)
+        assert r["valid"] is False
+        assert r["unexpected-count"] == 1
+
+    def test_never_read(self):
+        h = H((0, "invoke", "add", 0), (0, "ok", "add", 0))
+        assert set_checker().check({}, h)["valid"] == UNKNOWN
+
+
+class TestQueueChecker:
+    # checker_test.clj:10-30
+    def test_empty(self):
+        assert queue(unordered_queue()).check({}, H())["valid"] is True
+
+    def test_dequeue_from_nowhere(self):
+        h = H((0, "invoke", "dequeue", None), (0, "ok", "dequeue", 1))
+        assert queue(unordered_queue()).check({}, h)["valid"] is False
+
+    def test_enqueue_dequeue(self):
+        h = H((0, "invoke", "enqueue", 1), (0, "ok", "enqueue", 1),
+              (1, "invoke", "dequeue", None), (1, "ok", "dequeue", 1))
+        assert queue(unordered_queue()).check({}, h)["valid"] is True
+
+    def test_indeterminate_enqueue_counts(self):
+        # an invoked-but-crashed enqueue may still be dequeued
+        h = H((0, "invoke", "enqueue", 1), (0, "info", "enqueue", 1),
+              (1, "invoke", "dequeue", None), (1, "ok", "dequeue", 1))
+        assert queue(unordered_queue()).check({}, h)["valid"] is True
+
+
+class TestTotalQueue:
+    # checker_test.clj:32-81
+    def test_lost(self):
+        h = H((0, "invoke", "enqueue", 1), (0, "ok", "enqueue", 1))
+        r = total_queue().check({}, h)
+        assert r["valid"] is False
+        assert r["lost-count"] == 1
+
+    def test_unexpected(self):
+        h = H((0, "invoke", "dequeue", None), (0, "ok", "dequeue", 7))
+        r = total_queue().check({}, h)
+        assert r["valid"] is False
+        assert r["unexpected-count"] == 1
+
+    def test_duplicated(self):
+        h = H((0, "invoke", "enqueue", 1), (0, "ok", "enqueue", 1),
+              (1, "invoke", "dequeue", None), (1, "ok", "dequeue", 1),
+              (2, "invoke", "dequeue", None), (2, "ok", "dequeue", 1))
+        r = total_queue().check({}, h)
+        assert r["valid"] is False
+        assert r["duplicated-count"] == 1
+
+    def test_recovered(self):
+        h = H((0, "invoke", "enqueue", 1), (0, "info", "enqueue", 1),
+              (1, "invoke", "dequeue", None), (1, "ok", "dequeue", 1))
+        r = total_queue().check({}, h)
+        assert r["valid"] is True
+        assert r["recovered-count"] == 1
+
+    def test_ok(self):
+        h = H((0, "invoke", "enqueue", 1), (0, "ok", "enqueue", 1),
+              (1, "invoke", "dequeue", None), (1, "ok", "dequeue", 1))
+        r = total_queue().check({}, h)
+        assert r["valid"] is True
+        assert r["ok-count"] == 1
+
+
+class TestCounter:
+    # checker_test.clj:83-147
+    def test_simple_valid(self):
+        h = H((0, "invoke", "add", 1), (0, "ok", "add", 1),
+              (1, "invoke", "read", None), (1, "ok", "read", 1))
+        assert counter().check({}, h)["valid"] is True
+
+    def test_read_too_high(self):
+        h = H((0, "invoke", "add", 1), (0, "ok", "add", 1),
+              (1, "invoke", "read", None), (1, "ok", "read", 5))
+        r = counter().check({}, h)
+        assert r["valid"] is False
+        assert r["errors"]
+
+    def test_pending_add_widen_bounds(self):
+        # read overlapping an in-flight add may see either value
+        h = H((0, "invoke", "add", 2),
+              (1, "invoke", "read", None), (1, "ok", "read", 2),
+              (0, "ok", "add", 2),
+              (2, "invoke", "read", None), (2, "ok", "read", 2))
+        assert counter().check({}, h)["valid"] is True
+
+    def test_indeterminate_add_forever_possible(self):
+        h = H((0, "invoke", "add", 10), (0, "info", "add", 10),
+              (1, "invoke", "read", None), (1, "ok", "read", 10),
+              (2, "invoke", "read", None), (2, "ok", "read", 0))
+        assert counter().check({}, h)["valid"] is True
+
+    def test_failed_add_undone(self):
+        h = H((0, "invoke", "add", 5), (0, "fail", "add", 5),
+              (1, "invoke", "read", None), (1, "ok", "read", 5))
+        assert counter().check({}, h)["valid"] is False
+
+    def test_negative_adds(self):
+        h = H((0, "invoke", "add", -3), (0, "ok", "add", -3),
+              (1, "invoke", "read", None), (1, "ok", "read", -3))
+        assert counter().check({}, h)["valid"] is True
+
+
+class TestUniqueIds:
+    def test_unique(self):
+        h = H((0, "invoke", "generate", None), (0, "ok", "generate", 1),
+              (1, "invoke", "generate", None), (1, "ok", "generate", 2))
+        r = unique_ids().check({}, h)
+        assert r["valid"] is True
+        assert r["acknowledged-count"] == 2
+
+    def test_duplicated(self):
+        h = H((0, "invoke", "generate", None), (0, "ok", "generate", 1),
+              (1, "invoke", "generate", None), (1, "ok", "generate", 1))
+        r = unique_ids().check({}, h)
+        assert r["valid"] is False
+        assert r["duplicated-count"] == 1
+
+
+class TestCompose:
+    # checker_test.clj:149-154
+    def test_compose(self):
+        h = H((0, "invoke", "generate", None), (0, "ok", "generate", 1))
+        c = compose({"uniq": unique_ids(), "noop": noop_checker()})
+        r = c.check({}, h)
+        assert r["valid"] is True
+        assert r["uniq"]["valid"] is True
+        assert r["noop"]["valid"] is True
+
+    def test_compose_severity(self):
+        h = H((0, "invoke", "generate", None), (0, "ok", "generate", 1),
+              (1, "invoke", "generate", None), (1, "ok", "generate", 1))
+        c = compose({"uniq": unique_ids(), "noop": noop_checker()})
+        assert c.check({}, h)["valid"] is False
+
+    def test_check_safe_catches(self):
+        class Boom:
+            def check(self, *a):
+                raise RuntimeError("boom")
+        r = check_safe(Boom(), {}, H())
+        assert r["valid"] == UNKNOWN
+        assert "boom" in r["error"]

@@ -1,0 +1,125 @@
+"""Tests for the op/history substrate and the packed encoding."""
+
+import numpy as np
+
+from jepsen_tpu.history import History, Op
+from jepsen_tpu.models.core import CAS_REGISTER_KERNEL, NIL_ID, F_WRITE, F_READ
+from jepsen_tpu.ops import pack_history, pack_keyed_histories, RET_INF
+
+
+def H(*rows):
+    """rows: (process, type, f, value)"""
+    return History.of([
+        Op(type=t, f=f, value=v, process=p, time=i)
+        for i, (p, t, f, v) in enumerate(rows)
+    ])
+
+
+def test_index():
+    h = H((0, "invoke", "read", None), (0, "ok", "read", 1))
+    h.index()
+    assert [o.index for o in h] == [0, 1]
+
+
+def test_pairs_and_latencies():
+    h = H((0, "invoke", "read", None),
+          (1, "invoke", "write", 3),
+          (0, "ok", "read", 1),
+          (1, "ok", "write", 3))
+    pairs = list(h.pairs())
+    assert len(pairs) == 2
+    assert pairs[0][0].process == 0 and pairs[0][1].type == "ok"
+    lats = h.latencies()
+    assert [lat for _, lat in lats] == [2, 2]
+
+
+def test_complete_backfills_reads():
+    h = H((0, "invoke", "read", None), (0, "ok", "read", 42))
+    c = h.complete()
+    assert c[0].value == 42
+
+
+def test_remove_failures():
+    h = H((0, "invoke", "write", 1),
+          (1, "invoke", "write", 2),
+          (0, "fail", "write", 1),
+          (1, "ok", "write", 2))
+    out = h.remove_failures()
+    assert len(out) == 2
+    assert all(o.process == 1 for o in out)
+
+
+def test_jsonl_roundtrip():
+    h = H((0, "invoke", "cas", (1, 2)), (0, "ok", "cas", (1, 2)))
+    h2 = History.from_jsonl(h.to_jsonl())
+    assert h2[0].f == "cas"
+    assert tuple(h2[0].value) == (1, 2)
+
+
+class TestPackHistory:
+    def test_basic_pack(self):
+        h = H((0, "invoke", "write", 5),
+              (0, "ok", "write", 5),
+              (1, "invoke", "read", None),
+              (1, "ok", "read", 5))
+        p = pack_history(h, CAS_REGISTER_KERNEL)
+        assert p.n == 2
+        assert p.n_required == 2
+        # sorted by return: write first
+        assert p.f[0] == F_WRITE and p.f[1] == F_READ
+        # read back-filled with completion value, same interned id as write
+        assert p.v1[0] == p.v1[1]
+        assert p.init_state == NIL_ID
+
+    def test_failed_ops_dropped(self):
+        h = H((0, "invoke", "write", 5),
+              (0, "fail", "write", 5))
+        p = pack_history(h, CAS_REGISTER_KERNEL)
+        assert p.n == 0
+
+    def test_info_ops_pend_forever(self):
+        h = H((0, "invoke", "write", 5),
+              (0, "info", "write", 5),
+              (1, "invoke", "read", None),
+              (1, "ok", "read", 5))
+        p = pack_history(h, CAS_REGISTER_KERNEL)
+        assert p.n == 2
+        assert p.n_required == 1  # only the read must linearize
+        assert p.ret[1] == RET_INF  # crashed write sorts last
+
+    def test_crashed_read_dropped(self):
+        h = H((0, "invoke", "read", None),
+              (0, "info", "read", None))
+        p = pack_history(h, CAS_REGISTER_KERNEL)
+        assert p.n == 0
+
+    def test_unterminated_invoke_is_crashed(self):
+        h = H((0, "invoke", "write", 1))
+        p = pack_history(h, CAS_REGISTER_KERNEL)
+        assert p.n == 1
+        assert p.n_required == 0
+
+    def test_max_concurrency(self):
+        h = H((0, "invoke", "write", 1),
+              (1, "invoke", "write", 2),
+              (0, "ok", "write", 1),
+              (1, "ok", "write", 2))
+        p = pack_history(h, CAS_REGISTER_KERNEL)
+        assert p.max_concurrency() == 2
+
+    def test_pad(self):
+        h = H((0, "invoke", "write", 5), (0, "ok", "write", 5))
+        p = pack_history(h, CAS_REGISTER_KERNEL).pad_to(4)
+        assert p.n == 4
+        assert p.inv[2] == RET_INF  # filler never a candidate
+
+    def test_keyed_batch(self):
+        keyed = {
+            "k1": H((0, "invoke", "write", 1), (0, "ok", "write", 1)),
+            "k2": H((0, "invoke", "write", 2), (0, "ok", "write", 2),
+                    (1, "invoke", "read", None), (1, "ok", "read", 2)),
+        }
+        packed, batch = pack_keyed_histories(keyed, CAS_REGISTER_KERNEL)
+        assert batch["f"].shape == (2, 2)
+        assert list(batch["n_required"]) == [1, 2]
+        assert batch["keys"] == ["k1", "k2"]

@@ -1,0 +1,250 @@
+"""Linearizability checker tests: golden histories + a brute-force oracle.
+
+The brute-force oracle enumerates every permutation of the paired ops that
+respects real-time order and asks whether any is a legal sequential run —
+exponential but exact, used to validate WGL on small random histories.
+"""
+
+import itertools
+import random
+
+from jepsen_tpu.checker.wgl import (
+    check_model, check_packed, linearizable)
+from jepsen_tpu.history import History, Op
+from jepsen_tpu.models import CASRegister, Mutex, FIFOQueue
+from jepsen_tpu.models.core import CAS_REGISTER_KERNEL, MUTEX_KERNEL
+from jepsen_tpu.models.core import is_inconsistent
+from jepsen_tpu.ops import pack_history, RET_INF
+
+
+def H(*rows):
+    return History.of([
+        Op(type=t, f=f, value=v, process=p, time=i)
+        for i, (p, t, f, v) in enumerate(rows)
+    ])
+
+
+# ---------------------------------------------------------------------------
+# Brute-force oracle
+# ---------------------------------------------------------------------------
+
+def _pairs(history):
+    pending = {}
+    rows = []
+    for ev, o in enumerate(history):
+        if o.is_invoke:
+            pending[o.process] = (ev, o)
+        elif o.process in pending:
+            inv_ev, inv_op = pending.pop(o.process)
+            if o.is_fail:
+                continue
+            val = o.value if (o.is_ok and o.value is not None) else inv_op.value
+            rows.append((inv_ev, ev if o.is_ok else 10**9,
+                         inv_op.replace(value=val), o.is_ok))
+    for inv_ev, inv_op in pending.values():
+        rows.append((inv_ev, 10**9, inv_op, False))
+    return rows
+
+
+def brute_force_linearizable(history, model):
+    rows = _pairs(history)
+    required = [i for i, r in enumerate(rows) if r[3]]
+    optional = [i for i, r in enumerate(rows) if not r[3]]
+    n = len(rows)
+    # try all subsets of optional (crashed) ops, all permutations
+    for r in range(len(optional) + 1):
+        for subset in itertools.combinations(optional, r):
+            chosen = sorted(required + list(subset))
+            for perm in itertools.permutations(chosen):
+                # real-time order: if ret[a] < inv[b], a must precede b
+                ok = True
+                for idx_a in range(len(perm)):
+                    for idx_b in range(idx_a + 1, len(perm)):
+                        a, b = perm[idx_a], perm[idx_b]
+                        if rows[b][1] < rows[a][0]:
+                            ok = False
+                            break
+                    if not ok:
+                        break
+                if not ok:
+                    continue
+                m = model
+                good = True
+                for i in perm:
+                    m = m.step(rows[i][2])
+                    if is_inconsistent(m):
+                        good = False
+                        break
+                if good:
+                    return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Golden histories
+# ---------------------------------------------------------------------------
+
+class TestGolden:
+    def test_empty_valid(self):
+        assert check_model(H(), CASRegister())["valid"] is True
+
+    def test_sequential_valid(self):
+        h = H((0, "invoke", "write", 0), (0, "ok", "write", 0),
+              (1, "invoke", "read", None), (1, "ok", "read", 0))
+        assert check_model(h, CASRegister())["valid"] is True
+
+    def test_sequential_invalid_read(self):
+        h = H((0, "invoke", "write", 0), (0, "ok", "write", 0),
+              (1, "invoke", "read", None), (1, "ok", "read", 1))
+        assert check_model(h, CASRegister())["valid"] is False
+
+    def test_concurrent_write_read_valid(self):
+        # read overlaps write; may see it
+        h = H((0, "invoke", "write", 1),
+              (1, "invoke", "read", None),
+              (0, "ok", "write", 1),
+              (1, "ok", "read", 1))
+        assert check_model(h, CASRegister())["valid"] is True
+
+    def test_read_after_cas_invalid(self):
+        # w0 completes; cas 0->1 completes; read 0 strictly after -> invalid
+        h = H((0, "invoke", "write", 0), (0, "ok", "write", 0),
+              (1, "invoke", "cas", (0, 1)), (1, "ok", "cas", (0, 1)),
+              (2, "invoke", "read", None), (2, "ok", "read", 0))
+        assert check_model(h, CASRegister())["valid"] is False
+
+    def test_crashed_write_may_apply(self):
+        h = H((0, "invoke", "write", 1),
+              (0, "info", "write", 1),
+              (1, "invoke", "read", None), (1, "ok", "read", 1))
+        assert check_model(h, CASRegister())["valid"] is True
+
+    def test_crashed_write_may_not_apply(self):
+        h = H((0, "invoke", "write", 1),
+              (0, "info", "write", 1),
+              (1, "invoke", "read", None), (1, "ok", "read", None))
+        # read nil is don't-care; trivially fine
+        assert check_model(h, CASRegister())["valid"] is True
+
+    def test_crashed_write_applies_late(self):
+        # crashed write may linearize AFTER the read of the old value
+        h = H((0, "invoke", "write", 0), (0, "ok", "write", 0),
+              (1, "invoke", "write", 9),
+              (2, "invoke", "read", None), (2, "ok", "read", 0),
+              (3, "invoke", "read", None), (3, "ok", "read", 9))
+        assert check_model(h, CASRegister())["valid"] is True
+
+    def test_double_acquire_invalid(self):
+        h = H((0, "invoke", "acquire", None), (0, "ok", "acquire", None),
+              (1, "invoke", "acquire", None), (1, "ok", "acquire", None))
+        assert check_model(h, Mutex())["valid"] is False
+
+    def test_mutex_valid(self):
+        h = H((0, "invoke", "acquire", None), (0, "ok", "acquire", None),
+              (0, "invoke", "release", None), (0, "ok", "release", None),
+              (1, "invoke", "acquire", None), (1, "ok", "acquire", None))
+        assert check_model(h, Mutex())["valid"] is True
+
+    def test_fifo_queue(self):
+        h = H((0, "invoke", "enqueue", "a"), (0, "ok", "enqueue", "a"),
+              (1, "invoke", "enqueue", "b"), (1, "ok", "enqueue", "b"),
+              (0, "invoke", "dequeue", None), (0, "ok", "dequeue", "a"))
+        assert check_model(h, FIFOQueue())["valid"] is True
+        h2 = H((0, "invoke", "enqueue", "a"), (0, "ok", "enqueue", "a"),
+               (1, "invoke", "enqueue", "b"), (1, "ok", "enqueue", "b"),
+               (0, "invoke", "dequeue", None), (0, "ok", "dequeue", "b"))
+        assert check_model(h2, FIFOQueue())["valid"] is False
+
+
+class TestPackedAgreesWithModel:
+    def test_packed_golden(self):
+        cases = [
+            H((0, "invoke", "write", 0), (0, "ok", "write", 0),
+              (1, "invoke", "read", None), (1, "ok", "read", 0)),
+            H((0, "invoke", "write", 0), (0, "ok", "write", 0),
+              (1, "invoke", "read", None), (1, "ok", "read", 1)),
+            H((0, "invoke", "write", 0), (0, "ok", "write", 0),
+              (1, "invoke", "cas", (0, 1)), (1, "ok", "cas", (0, 1)),
+              (2, "invoke", "read", None), (2, "ok", "read", 0)),
+        ]
+        for h in cases:
+            got = check_packed(pack_history(h, CAS_REGISTER_KERNEL),
+                               CAS_REGISTER_KERNEL)["valid"]
+            want = check_model(h, CASRegister())["valid"]
+            assert got == want
+
+
+def random_register_history(rng, n_procs=3, n_ops=5, n_vals=3,
+                            crash_p=0.2):
+    """Generate a random concurrent register history."""
+    h = History()
+    free = list(range(n_procs))
+    open_ops = {}
+    ops_left = n_ops
+    t = 0
+    while (ops_left > 0 and (free or open_ops)) or open_ops:
+        # choose to invoke or complete
+        if free and ops_left > 0 and (not open_ops or rng.random() < 0.5):
+            p = rng.choice(free)
+            free.remove(p)
+            ops_left -= 1
+            f = rng.choice(["read", "write", "cas"])
+            if f == "read":
+                v = None
+            elif f == "write":
+                v = rng.randrange(n_vals)
+            else:
+                v = (rng.randrange(n_vals), rng.randrange(n_vals))
+            op = Op(type="invoke", f=f, value=v, process=p, time=t)
+            h.append(op)
+            open_ops[p] = op
+        else:
+            p = rng.choice(list(open_ops))
+            inv = open_ops.pop(p)
+            r = rng.random()
+            if r < crash_p:
+                h.append(Op(type="info", f=inv.f, value=inv.value,
+                            process=p, time=t))
+                # crashed process never returns; don't free it
+            else:
+                val = inv.value
+                if inv.f == "read":
+                    val = rng.randrange(n_vals) if rng.random() < 0.9 else None
+                typ = "ok" if r < 0.9 else "fail"
+                h.append(Op(type=typ, f=inv.f, value=val, process=p, time=t))
+                free.append(p)
+        t += 1
+    return h
+
+
+class TestAgainstBruteForce:
+    def test_random_histories(self):
+        rng = random.Random(42)
+        n_checked = 0
+        n_valid = 0
+        for _ in range(300):
+            h = random_register_history(rng)
+            want = brute_force_linearizable(h, CASRegister())
+            got_model = check_model(h, CASRegister())["valid"]
+            got_packed = check_packed(
+                pack_history(h, CAS_REGISTER_KERNEL),
+                CAS_REGISTER_KERNEL)["valid"]
+            assert got_model == want, f"check_model wrong on:\n{h.to_jsonl()}"
+            assert got_packed == want, f"check_packed wrong on:\n{h.to_jsonl()}"
+            n_checked += 1
+            n_valid += bool(want)
+        # sanity: the generator produces a healthy mix
+        assert 20 < n_valid < 280, (n_valid, n_checked)
+
+
+class TestCheckerFacade:
+    def test_linearizable_checker(self):
+        h = H((0, "invoke", "write", 0), (0, "ok", "write", 0),
+              (1, "invoke", "read", None), (1, "ok", "read", 0))
+        c = linearizable(CASRegister())
+        assert c.check({}, h)["valid"] is True
+
+    def test_model_from_test_map(self):
+        h = H((0, "invoke", "write", 0), (0, "ok", "write", 0))
+        c = linearizable()
+        assert c.check({"model": CASRegister()}, h)["valid"] is True

@@ -1,0 +1,135 @@
+"""Tests for jepsen_tpu.models — model semantics per reference model.clj,
+plus equivalence of the integer kernels with the object models."""
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.history import Op
+from jepsen_tpu.models import (
+    CASRegister, FIFOQueue, Mutex, NoOp, SetModel, UnorderedQueue,
+    is_inconsistent, kernel_spec_for, NIL_ID,
+)
+from jepsen_tpu.models.core import (
+    CAS_REGISTER_KERNEL, MUTEX_KERNEL, F_READ, F_WRITE, F_CAS,
+    F_ACQUIRE, F_RELEASE)
+
+
+def inv(f, value=None):
+    return Op(type="invoke", f=f, value=value)
+
+
+class TestCASRegister:
+    def test_write_read(self):
+        m = CASRegister()
+        m = m.step(inv("write", 3))
+        assert m == CASRegister(3)
+        assert m.step(inv("read", 3)) == m
+        assert is_inconsistent(m.step(inv("read", 4)))
+
+    def test_read_nil_matches_anything(self):
+        m = CASRegister(7)
+        assert m.step(inv("read", None)) == m
+
+    def test_cas(self):
+        m = CASRegister(1)
+        m2 = m.step(inv("cas", (1, 2)))
+        assert m2 == CASRegister(2)
+        assert is_inconsistent(m.step(inv("cas", (5, 6))))
+
+    def test_initial_nil(self):
+        m = CASRegister()
+        assert is_inconsistent(m.step(inv("read", 0)))
+        assert m.step(inv("read", None)) == m
+
+
+class TestMutex:
+    def test_acquire_release(self):
+        m = Mutex()
+        m2 = m.step(inv("acquire"))
+        assert m2 == Mutex(True)
+        assert is_inconsistent(m2.step(inv("acquire")))
+        assert m2.step(inv("release")) == Mutex(False)
+        assert is_inconsistent(m.step(inv("release")))
+
+
+class TestSetModel:
+    def test_add_read(self):
+        m = SetModel()
+        m = m.step(inv("add", 1)).step(inv("add", 2))
+        assert m.step(inv("read", [1, 2])) == m
+        assert is_inconsistent(m.step(inv("read", [1])))
+
+
+class TestQueues:
+    def test_fifo(self):
+        m = FIFOQueue()
+        m = m.step(inv("enqueue", "a")).step(inv("enqueue", "b"))
+        m2 = m.step(inv("dequeue", "a"))
+        assert not is_inconsistent(m2)
+        assert is_inconsistent(m.step(inv("dequeue", "b")))
+        assert is_inconsistent(FIFOQueue().step(inv("dequeue", "x")))
+
+    def test_unordered(self):
+        m = UnorderedQueue()
+        m = m.step(inv("enqueue", "a")).step(inv("enqueue", "b"))
+        assert not is_inconsistent(m.step(inv("dequeue", "b")))
+        assert is_inconsistent(m.step(inv("dequeue", "c")))
+
+
+class TestNoOp:
+    def test_anything_goes(self):
+        m = NoOp()
+        assert m.step(inv("whatever", 9)) is m
+
+
+class TestKernels:
+    """Integer kernels must agree with the object models."""
+
+    def test_cas_register_kernel_scalar(self):
+        step = CAS_REGISTER_KERNEL.step
+        s = CAS_REGISTER_KERNEL.init_state
+        # write 5
+        s, ok = step(s, F_WRITE, 5, NIL_ID)
+        assert ok and s == 5
+        # read 5 ok
+        s2, ok = step(s, F_READ, 5, NIL_ID)
+        assert ok and s2 == 5
+        # read nil ok
+        _, ok = step(s, F_READ, NIL_ID, NIL_ID)
+        assert ok
+        # read 6 bad
+        _, ok = step(s, F_READ, 6, NIL_ID)
+        assert not ok
+        # cas 5->7 ok
+        s3, ok = step(s, F_CAS, 5, 7)
+        assert ok and s3 == 7
+        # cas 9->1 bad
+        _, ok = step(s, F_CAS, 9, 1)
+        assert not ok
+
+    def test_mutex_kernel(self):
+        step = MUTEX_KERNEL.step
+        s = MUTEX_KERNEL.init_state
+        s, ok = step(s, F_ACQUIRE, NIL_ID, NIL_ID)
+        assert ok and s == 1
+        _, ok = step(s, F_ACQUIRE, NIL_ID, NIL_ID)
+        assert not ok
+        s, ok = step(s, F_RELEASE, NIL_ID, NIL_ID)
+        assert ok and s == 0
+        _, ok = step(s, F_RELEASE, NIL_ID, NIL_ID)
+        assert not ok
+
+    def test_cas_register_kernel_vectorized(self):
+        step = CAS_REGISTER_KERNEL.step
+        state = np.array([0, 0, 1, 2], dtype=np.int32)
+        f = np.array([F_READ, F_WRITE, F_CAS, F_READ], dtype=np.int32)
+        v1 = np.array([0, 9, 1, 5], dtype=np.int32)
+        v2 = np.array([NIL_ID, NIL_ID, 3, NIL_ID], dtype=np.int32)
+        s2, ok = step(state, f, v1, v2)
+        assert list(ok) == [True, True, True, False]
+        assert list(s2[:3]) == [0, 9, 3]
+
+    def test_kernel_spec_for(self):
+        assert kernel_spec_for(CASRegister()) is CAS_REGISTER_KERNEL
+        assert kernel_spec_for(Mutex()) is MUTEX_KERNEL
+        assert kernel_spec_for(FIFOQueue()) is None

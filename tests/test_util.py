@@ -1,0 +1,100 @@
+"""Tests for jepsen_tpu.util — mirrors reference util_test.clj."""
+
+import time
+
+from jepsen_tpu import util
+
+
+def test_majority():
+    # util_test.clj:6-12
+    assert util.majority(1) == 1
+    assert util.majority(2) == 2
+    assert util.majority(3) == 2
+    assert util.majority(4) == 3
+    assert util.majority(5) == 3
+
+
+def test_minority():
+    assert util.minority(1) == 0
+    assert util.minority(2) == 0
+    assert util.minority(3) == 1
+    assert util.minority(5) == 2
+
+
+def test_integer_interval_set_str():
+    # util_test.clj:14-31
+    assert util.integer_interval_set_str([]) == "#{}"
+    assert util.integer_interval_set_str([1]) == "#{1}"
+    assert util.integer_interval_set_str([1, 2]) == "#{1..2}"
+    assert util.integer_interval_set_str([1, 2, 3]) == "#{1..3}"
+    assert util.integer_interval_set_str([1, 3, 5]) == "#{1 3 5}"
+    assert util.integer_interval_set_str([1, 2, 3, 5, 7, 8]) == \
+        "#{1..3 5 7..8}"
+
+
+def test_real_pmap():
+    t0 = time.monotonic()
+    out = util.real_pmap(lambda x: (time.sleep(0.1), x * 2)[1], range(8))
+    assert out == [x * 2 for x in range(8)]
+    assert time.monotonic() - t0 < 0.5  # actually parallel
+
+
+def test_real_pmap_propagates_errors():
+    import pytest
+    with pytest.raises(ZeroDivisionError):
+        util.real_pmap(lambda x: 1 // x, [1, 0, 2])
+
+
+def test_timeout():
+    assert util.timeout(50, "timed-out", lambda: time.sleep(1)) == "timed-out"
+    assert util.timeout(1000, "timed-out", lambda: 42) == 42
+
+
+def test_retry():
+    attempts = []
+
+    def flaky():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise RuntimeError("nope")
+        return "ok"
+
+    assert util.retry(0.001, flaky) == "ok"
+    assert len(attempts) == 3
+
+
+def test_relative_time():
+    with util.with_relative_time():
+        t1 = util.relative_time_nanos()
+        time.sleep(0.01)
+        t2 = util.relative_time_nanos()
+        assert 0 <= t1 < t2
+        assert t2 - t1 >= 5_000_000
+
+
+def test_longest_common_prefix():
+    assert util.longest_common_prefix(["abcd", "abce"]) == "abc"
+    assert util.longest_common_prefix([]) == []
+    assert util.drop_common_proper_prefix(["ab", "ab"]) == ["b", "b"]
+
+
+def test_chunk_vec():
+    assert util.chunk_vec(2, [1, 2, 3, 4, 5]) == [[1, 2], [3, 4], [5]]
+
+
+def test_atom():
+    a = util.Atom(0)
+    assert a.deref() == 0
+    assert a.swap(lambda x: x + 5) == 5
+    assert a.deref() == 5
+    a.reset(9)
+    assert a.deref() == 9
+
+
+def test_lazy_atom():
+    calls = []
+    a = util.LazyAtom(lambda: calls.append(1) or 10)
+    assert not calls
+    assert a.deref() == 10
+    assert a.deref() == 10
+    assert len(calls) == 1
