@@ -81,13 +81,19 @@ def rate_series(history: History, dt: float = RATE_DT) -> Dict[str, list]:
 
 
 def nemesis_intervals(history: History) -> List[list]:
-    """[[start_s, end_s], ...] spans between nemesis start/stop pairs
-    (util.clj:593-610) for shading graphs."""
+    """[[start_s, end_s], ...] spans between nemesis action completions
+    (util.clj:593-610) for shading graphs.
+
+    Nemesis ops are recorded as :info for both invocation and completion
+    (core.clj:292), so we reconstruct pairs by alternation: the nemesis is a
+    single thread, so its ops arrive strictly as inv, comp, inv, comp...
+    A span opens at the completion of one action (e.g. start) and closes at
+    the completion of the next (e.g. stop)."""
+    nem_ops = [o for o in history if o.process == "nemesis"]
+    completions = nem_ops[1::2]
     out = []
     start: Optional[float] = None
-    for o in history:
-        if o.process != "nemesis" or o.is_invoke:
-            continue
+    for o in completions:
         if start is None:
             start = o.time / 1e9
         else:

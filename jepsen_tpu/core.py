@@ -1,0 +1,361 @@
+"""Test lifecycle orchestrator.
+
+Rebuild of jepsen.core (jepsen/src/jepsen/core.clj). ``run(test)`` is the
+entry point: set up OS and DB on every node, spawn one worker thread per
+logical process plus a nemesis thread, pull operations from the generator,
+apply them through clients, record everything into a history, then run the
+checker over the indexed history and persist results.
+
+A *test* is a plain dict (core.clj:382-402) with keys:
+
+  name, nodes, concurrency, os, db, client, nemesis, generator, model,
+  checker, ssh/control, store-dir, ...
+
+Key invariants preserved from the reference:
+- op completion must keep type ∈ {ok, fail, info}, same f and process
+  (core.clj:157-163);
+- a worker whose op is indeterminate (info or thrown) abandons its logical
+  process and reincarnates as ``p + concurrency`` on the same thread with a
+  fresh client (core.clj:168-217);
+- nemesis ops are interleaved into every active history
+  (core.clj:281-283,296-299), which is what makes independent/keyed runs
+  see fault windows;
+- the history list append under a single lock is the serialization point
+  (core.clj:43-47).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import traceback
+from typing import Any, Dict, List, Optional
+
+from jepsen_tpu import client as client_ns
+from jepsen_tpu import control
+from jepsen_tpu import db as db_ns
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker import check_safe
+from jepsen_tpu.history import History, INFO, NEMESIS, Op
+from jepsen_tpu.util import (real_pmap, relative_time_nanos,
+                             with_relative_time)
+
+log = logging.getLogger("jepsen")
+
+
+def synchronize(test: dict) -> None:
+    """Block this thread until all nodes' setup threads reach this point
+    (core.clj:36-41; the CyclicBarrier in :barrier)."""
+    b = test.get("barrier")
+    if b is not None:
+        b.wait()
+
+
+def primary(test: dict):
+    """The conventional primary node: the first one (core.clj:49-52)."""
+    nodes = test.get("nodes") or []
+    return nodes[0] if nodes else None
+
+
+def conj_op(test: dict, op: Op) -> Op:
+    """Append an op to every active history under the lock — THE
+    serialization point (core.clj:43-47)."""
+    with test["_history_lock"]:
+        for h in test["_active_histories"]:
+            h.append(op)
+    return op
+
+
+def _fill_op(test: dict, op: Op, process) -> Op:
+    return op.replace(process=process, time=relative_time_nanos())
+
+
+class Worker:
+    """One logical-process worker (core.clj:219-265). The node is pinned to
+    the *thread* at spawn (core.clj:349-355) — reincarnated processes stay
+    on the same node."""
+
+    def __init__(self, test: dict, barrier: threading.Barrier,
+                 thread_id: int):
+        self.test = test
+        self.barrier = barrier
+        self.thread = thread_id
+        self.process = thread_id
+        nodes = test.get("nodes") or [None]
+        self._node = nodes[thread_id % len(nodes)]
+        self.error: Optional[BaseException] = None
+
+    def node(self):
+        return self._node
+
+    def run(self):
+        test = self.test
+        try:
+            with gen.threads_bound(gen.all_threads(test)):
+                client = test["client"].open(test, self.node())
+                try:
+                    self.barrier.wait()  # all clients ready (core.clj:231)
+                    g = test["generator"]
+                    while True:
+                        op = gen.op_and_validate(g, test, self.process)
+                        if op is None:
+                            break
+                        op = _fill_op(test, op, self.process)
+                        conj_op(test, op)
+                        client = self._invoke_and_complete(client, op)
+                finally:
+                    try:
+                        client.close(test)
+                    except Exception:  # noqa: BLE001
+                        pass
+                    # wait for everyone before teardown (core.clj:259)
+                    try:
+                        self.barrier.wait()
+                    except threading.BrokenBarrierError:
+                        pass
+        except Exception as e:  # noqa: BLE001 (core.clj:255-256)
+            self.error = e
+            self.barrier.abort()
+            log.error("Worker %s crashed: %s", self.thread,
+                      traceback.format_exc())
+
+    def _invoke_and_complete(self, client, op: Op):
+        """Apply op via the client; handle ok/fail/info/throw
+        (core.clj:143-217). Returns the client to use next (a fresh one if
+        the process crashed)."""
+        test = self.test
+        try:
+            completion = client.invoke(test, op)
+            if (completion is None
+                    or completion.type not in ("ok", "fail", "info")
+                    or completion.f != op.f
+                    or completion.process != op.process):
+                raise RuntimeError(
+                    f"invalid completion {completion!r} for op {op!r}")
+            completion = completion.replace(time=relative_time_nanos())
+            conj_op(test, completion)
+            if completion.type in ("ok", "fail"):
+                return client  # determinate: process continues
+            crashed_err = None
+        except Exception as e:  # noqa: BLE001
+            # indeterminate: we don't know if the op took place
+            crashed_err = e
+            info = op.replace(type=INFO, time=relative_time_nanos(),
+                              error=f"{type(e).__name__}: {e}")
+            conj_op(test, info)
+            log.warning("Process %s crashed in %s: %s", self.process,
+                        op.f, e)
+        # info path: abandon this process, reincarnate as p + concurrency
+        # with a fresh client (core.clj:174-217)
+        try:
+            client.close(test)
+        except Exception:  # noqa: BLE001
+            pass
+        self.process += test["concurrency"]
+        return test["client"].open(test, self.node())
+
+
+def _nemesis_worker(test: dict, stop: threading.Event):
+    """The privileged nemesis process (core.clj:267-309)."""
+    nemesis = test.get("nemesis")
+    g = test["generator"]
+    with gen.threads_bound(gen.all_threads(test)):
+        while not stop.is_set():
+            try:
+                op = gen.op_and_validate(g, test, NEMESIS)
+            except Exception:  # noqa: BLE001
+                log.error("Nemesis generator crashed: %s",
+                          traceback.format_exc())
+                break
+            if op is None:
+                break
+            # nemesis ops are recorded as :info both ways (core.clj:292) —
+            # they never pair as invoke/ok, so checkers and the packed
+            # encoder skip them structurally
+            op = _fill_op(test, op, NEMESIS).replace(type=INFO)
+            conj_op(test, op)
+            try:
+                completion = nemesis.invoke(test, op) if nemesis else op
+                completion = completion.replace(
+                    type=INFO, process=NEMESIS, time=relative_time_nanos())
+                conj_op(test, completion)
+            except Exception as e:  # noqa: BLE001 (core.clj:301-306)
+                conj_op(test, op.replace(
+                    type=INFO, time=relative_time_nanos(),
+                    error=f"{type(e).__name__}: {e}"))
+                log.warning("Nemesis crashed invoking %s: %s", op.f, e)
+
+
+def run_case(test: dict) -> History:
+    """Run the workload phase: nemesis + workers over the generator;
+    returns the raw history (core.clj:331-365)."""
+    history = History()
+    test.setdefault("_history_lock", threading.Lock())
+    test.setdefault("_active_histories", [])
+    test["_active_histories"].append(history)
+
+    nemesis_obj = test.get("nemesis")
+    if nemesis_obj is not None:
+        nemesis_obj.setup(test)
+    stop = threading.Event()
+    nemesis_thread = threading.Thread(
+        target=_nemesis_worker, args=(test, stop), daemon=True,
+        name="jepsen-nemesis")
+    nemesis_thread.start()
+
+    try:
+        n = test["concurrency"]
+        barrier = threading.Barrier(n)
+        workers = [Worker(test, barrier, i) for i in range(n)]
+        threads = [threading.Thread(target=w.run, daemon=True,
+                                    name=f"jepsen-worker-{i}")
+                   for i, w in enumerate(workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for w in workers:
+            if w.error is not None:
+                raise w.error
+    finally:
+        stop.set()
+        nemesis_thread.join(timeout=test.get("nemesis-join-timeout", 30))
+        if nemesis_obj is not None:
+            try:
+                nemesis_obj.teardown(test)
+            except Exception:  # noqa: BLE001
+                log.warning("Nemesis teardown failed: %s",
+                            traceback.format_exc())
+        test["_active_histories"].remove(history)
+    return history
+
+
+def with_os(test: dict):
+    """Context: OS setup before, teardown after (core.clj:77-84)."""
+    class _Ctx:
+        def __enter__(self_):
+            os_ = test.get("os")
+            if os_ is not None:
+                control.on_nodes(test, os_.setup)
+            return self_
+
+        def __exit__(self_, *exc):
+            os_ = test.get("os")
+            if os_ is not None and not test.get("leave-db-running"):
+                control.on_nodes(test, os_.teardown)
+            return False
+    return _Ctx()
+
+
+def with_db(test: dict):
+    """Context: DB cycled (teardown+setup) before, torn down after; primary
+    setup on the first node (core.clj:127-141, 86-92). On entry failure,
+    logs are snarfed (core.clj:135-139)."""
+    class _Ctx:
+        def __enter__(self_):
+            db = test.get("db")
+            if db is not None:
+                try:
+                    control.on_nodes(test, lambda t, n: db_ns.cycle(db, t, n))
+                    if isinstance(db, db_ns.Primary):
+                        db.setup_primary(test, primary(test))
+                except Exception:
+                    snarf_logs(test)
+                    raise
+            return self_
+
+        def __exit__(self_, *exc):
+            db = test.get("db")
+            if db is not None:
+                snarf_logs(test)
+                if not test.get("leave-db-running"):
+                    control.on_nodes(test, db.teardown)
+            return False
+    return _Ctx()
+
+
+def snarf_logs(test: dict) -> None:
+    """Download DB log files from every node into the store directory
+    (core.clj:94-125). No-op without a store dir or LogFiles impl."""
+    db = test.get("db")
+    store_dir = test.get("store-dir")
+    if not (store_dir and isinstance(db, db_ns.LogFiles)):
+        return
+    import os as _os
+
+    def snarf(t, node):
+        files = db.log_files(t, node) or []
+        dest_dir = _os.path.join(store_dir, str(node))
+        _os.makedirs(dest_dir, exist_ok=True)
+        for f in files:
+            try:
+                control.download(test, node, f,
+                                 _os.path.join(dest_dir,
+                                               _os.path.basename(f)))
+            except Exception:  # noqa: BLE001
+                log.warning("couldn't snarf %s from %s", f, node)
+
+    try:
+        control.on_nodes(test, snarf)
+    except Exception:  # noqa: BLE001
+        log.warning("log snarfing failed: %s", traceback.format_exc())
+
+
+def prepare_test(test: dict) -> dict:
+    """Fill in defaults (tests.clj noop-test / core.clj:435-450)."""
+    t = dict(test)
+    t.setdefault("name", "noop")
+    t.setdefault("nodes", ["n1", "n2", "n3", "n4", "n5"])
+    t.setdefault("concurrency", len(t["nodes"]))
+    t.setdefault("client", client_ns.noop())
+    t.setdefault("generator", gen.Void())
+    if not isinstance(t["generator"], gen.Generator):
+        t["generator"] = gen.gen(t["generator"])
+    t["_history_lock"] = threading.Lock()
+    t["_active_histories"] = []
+    t["barrier"] = (threading.Barrier(len(t["nodes"]))
+                    if t["nodes"] else None)
+    return t
+
+
+def run(test: dict) -> dict:
+    """Run a complete test; returns the test dict augmented with :history
+    and :results (core.clj:381-491)."""
+    import time as _time
+    test = prepare_test(test)
+    test["start-time"] = _time.time()
+
+    store = None
+    if test.get("store-dir", "__auto__") is not None:
+        try:
+            from jepsen_tpu import store as store_ns
+            store = store_ns
+            store_ns.prepare_dir(test)
+            store_ns.start_logging(test)
+        except ImportError:
+            store = None
+
+    with control.session_pool(test):
+        client = test["client"]
+        with with_os(test), with_db(test):
+            with with_relative_time():
+                client.setup(test)
+                try:
+                    history = run_case(test)
+                finally:
+                    client.teardown(test)
+        history.index()
+        test["history"] = history
+        if store:
+            store.save_1(test)
+        checker = test.get("checker")
+        if checker is not None:
+            test["results"] = check_safe(checker, test, history)
+        else:
+            test["results"] = {"valid": True}
+        if store:
+            store.save_2(test)
+            store.stop_logging(test)
+    log.info("Test %s: valid=%s", test.get("name"),
+             test["results"].get("valid"))
+    return test

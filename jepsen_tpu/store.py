@@ -1,0 +1,250 @@
+"""Persistence: test artifacts on disk.
+
+Rebuild of jepsen.store (jepsen/src/jepsen/store.clj). Layout mirrors the
+reference's ``store/<name>/<timestamp>/`` scheme with ``latest`` symlinks
+(store.clj:113-142, 235-247):
+
+    store/
+      <test-name>/
+        <YYYYMMDDTHHMMSS.mmm>/
+          jepsen.log        — framework log for this run (store.clj:304-326)
+          history.txt       — human-readable op log
+          history.jsonl     — machine-readable history (reference: .edn)
+          test.json         — serializable test map (store.clj:155-163 drops
+                              functions/protocol impls)
+          results.json      — checker output (store.clj:259-263)
+        latest -> <timestamp>
+      latest -> <test-name>/<timestamp>
+
+Two-phase saving preserved: save_1 after the run (history snapshot,
+store.clj:279-290), save_2 after analysis (results, 292-302) — so analysis
+can be re-run offline on a saved history, the seam the TPU checker plugs
+into (SURVEY §5 checkpoint/resume).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+import threading
+from datetime import datetime
+from typing import Any, Dict, List, Optional
+
+from jepsen_tpu.history import History
+from jepsen_tpu.util import chunk_vec, real_pmap
+
+#: Keys dropped before serialization (store.clj:155-163).
+NONSERIALIZABLE_KEYS = (
+    "db", "os", "net", "client", "checker", "nemesis", "generator", "model",
+    "barrier", "ssh", "remote",
+)
+
+#: Chunked parallel history writing threshold (util.clj:154-158).
+PARALLEL_WRITE_THRESHOLD = 16384
+
+DEFAULT_ROOT = "store"
+
+
+def _root(test: dict) -> str:
+    return test.get("store-root") or DEFAULT_ROOT
+
+
+def time_str(t: Optional[float] = None) -> str:
+    dt = datetime.fromtimestamp(t) if t else datetime.now()
+    return dt.strftime("%Y%m%dT%H%M%S.%f")[:-3]
+
+
+def prepare_dir(test: dict) -> str:
+    """Create (and record) the store directory for this run
+    (store.clj:113-142 path!)."""
+    d = test.get("store-dir")
+    if not d:
+        d = os.path.join(_root(test), str(test.get("name", "noop")),
+                         time_str(test.get("start-time")))
+        test["store-dir"] = d
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+# ---------------------------------------------------------------------------
+# Logging (store.clj:304-326)
+# ---------------------------------------------------------------------------
+
+def start_logging(test: dict) -> None:
+    d = prepare_dir(test)
+    handler = logging.FileHandler(os.path.join(d, "jepsen.log"))
+    handler.setFormatter(logging.Formatter(
+        "%(asctime)s %(levelname)s [%(threadName)s] %(name)s: %(message)s"))
+    logger = logging.getLogger("jepsen")
+    logger.setLevel(logging.INFO)
+    logger.addHandler(handler)
+    test["_log_handler"] = handler
+
+
+def stop_logging(test: dict) -> None:
+    handler = test.pop("_log_handler", None)
+    if handler is not None:
+        logging.getLogger("jepsen").removeHandler(handler)
+        handler.close()
+
+
+# ---------------------------------------------------------------------------
+# Serialization
+# ---------------------------------------------------------------------------
+
+def serializable_test(test: dict) -> dict:
+    """The test map minus functions/protocol impls/internal state
+    (store.clj:155-163)."""
+    out = {}
+    for k, v in test.items():
+        if k in NONSERIALIZABLE_KEYS or k.startswith("_"):
+            continue
+        if k in ("history", "results"):
+            continue
+        try:
+            json.dumps(v)
+            out[k] = v
+        except (TypeError, ValueError):
+            out[k] = repr(v)
+    return out
+
+
+def _json_default(x):
+    if isinstance(x, (set, frozenset)):
+        return sorted(x, key=repr)
+    if isinstance(x, bytes):
+        return x.decode("utf-8", "replace")
+    return repr(x)
+
+
+def write_history(d: str, history: History) -> None:
+    """history.txt + history.jsonl; big histories are formatted in parallel
+    chunks (util.clj:149-170 pwrite-history!)."""
+    ops = list(history)
+    if len(ops) > PARALLEL_WRITE_THRESHOLD:
+        chunks = chunk_vec(PARALLEL_WRITE_THRESHOLD, ops)
+        txt_parts = real_pmap(
+            lambda ch: "\n".join(str(o) for o in ch), chunks)
+        jsonl_parts = real_pmap(
+            lambda ch: "\n".join(
+                json.dumps(o.to_dict(), default=_json_default)
+                for o in ch),
+            chunks)
+        txt = "\n".join(txt_parts)
+        jsonl = "\n".join(jsonl_parts)
+    else:
+        txt = "\n".join(str(o) for o in ops)
+        jsonl = "\n".join(json.dumps(o.to_dict(), default=_json_default)
+                          for o in ops)
+    with open(os.path.join(d, "history.txt"), "w") as f:
+        f.write(txt + "\n")
+    with open(os.path.join(d, "history.jsonl"), "w") as f:
+        f.write(jsonl + "\n")
+
+
+def write_results(d: str, results: dict) -> None:
+    with open(os.path.join(d, "results.json"), "w") as f:
+        json.dump(results, f, indent=2, default=_json_default)
+
+
+def update_symlinks(test: dict) -> None:
+    """store/<name>/latest and store/latest (store.clj:235-247)."""
+    d = test.get("store-dir")
+    if not d:
+        return
+    d = os.path.abspath(d)
+    name_dir = os.path.dirname(d)
+    root = os.path.dirname(name_dir)
+    for link_dir, target in ((name_dir, d), (root, d)):
+        link = os.path.join(link_dir, "latest")
+        try:
+            if os.path.islink(link):
+                os.unlink(link)
+            os.symlink(os.path.relpath(target, link_dir), link)
+        except OSError:
+            pass
+
+
+def save_1(test: dict) -> dict:
+    """Phase 1: history + test snapshot, written in parallel futures
+    (store.clj:279-290)."""
+    d = prepare_dir(test)
+    history = test.get("history") or History()
+
+    def write_test():
+        with open(os.path.join(d, "test.json"), "w") as f:
+            json.dump(serializable_test(test), f, indent=2,
+                      default=_json_default)
+
+    real_pmap(lambda f: f(), [write_test,
+                              lambda: write_history(d, history)])
+    update_symlinks(test)
+    return test
+
+
+def save_2(test: dict) -> dict:
+    """Phase 2: results after analysis (store.clj:292-302)."""
+    d = prepare_dir(test)
+    write_results(d, test.get("results", {}))
+    update_symlinks(test)
+    return test
+
+
+# ---------------------------------------------------------------------------
+# Loading (store.clj:165-233)
+# ---------------------------------------------------------------------------
+
+def load(path: str) -> dict:
+    """Load a saved test dir -> dict with 'history' and 'results'."""
+    out: Dict[str, Any] = {}
+    tj = os.path.join(path, "test.json")
+    if os.path.exists(tj):
+        with open(tj) as f:
+            out.update(json.load(f))
+    hj = os.path.join(path, "history.jsonl")
+    if os.path.exists(hj):
+        with open(hj) as f:
+            out["history"] = History.from_jsonl(f.read())
+    rj = os.path.join(path, "results.json")
+    if os.path.exists(rj):
+        with open(rj) as f:
+            out["results"] = json.load(f)
+    out["store-dir"] = path
+    return out
+
+
+def tests(name: Optional[str] = None, root: str = DEFAULT_ROOT) -> List[str]:
+    """List saved test directories, newest last (store.clj:214-233)."""
+    out = []
+    names = [name] if name else sorted(os.listdir(root)) \
+        if os.path.isdir(root) else []
+    for n in names:
+        nd = os.path.join(root, n)
+        if not os.path.isdir(nd) or n == "latest":
+            continue
+        for ts in sorted(os.listdir(nd)):
+            if ts == "latest":
+                continue
+            td = os.path.join(nd, ts)
+            if os.path.isdir(td):
+                out.append(td)
+    return out
+
+
+def latest(root: str = DEFAULT_ROOT) -> Optional[dict]:
+    """Load the most recent test (repl.clj:6-13 last-test)."""
+    link = os.path.join(root, "latest")
+    if os.path.exists(link):
+        return load(os.path.realpath(link))
+    ts = tests(root=root)
+    return load(ts[-1]) if ts else None
+
+
+def delete(name: Optional[str] = None, root: str = DEFAULT_ROOT) -> None:
+    """Delete stored tests (store.clj:328-345)."""
+    if name:
+        shutil.rmtree(os.path.join(root, name), ignore_errors=True)
+    else:
+        shutil.rmtree(root, ignore_errors=True)

@@ -1,0 +1,138 @@
+"""In-process test fixtures: the fake backend.
+
+Rebuild of jepsen.tests (jepsen/tests.clj:12-56): ``noop_test`` — a complete
+base test map that does nothing — plus ``AtomDB``/``AtomClient``, which
+implement the full DB/Client protocols against a local, lock-guarded value so
+``core.run`` exercises its entire lifecycle (workers, generator, history,
+checker) without SSH or a real database. This is the protocol-boundary seam
+the reference uses for its own integration tests (core_test.clj:17-28).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from jepsen_tpu import client as client_ns
+from jepsen_tpu import db as db_ns
+from jepsen_tpu import os as os_ns
+from jepsen_tpu.history import Op
+from jepsen_tpu.models import CASRegister
+from jepsen_tpu.util import Atom
+
+
+def noop_test() -> dict:
+    """A test map that does nothing: the default skeleton other tests merge
+    over (tests.clj:12-25)."""
+    return {
+        "name": "noop",
+        "nodes": ["n1", "n2", "n3", "n4", "n5"],
+        "concurrency": 5,
+        "os": os_ns.noop(),
+        "db": db_ns.noop(),
+        "client": client_ns.noop(),
+        "nemesis": None,
+        "generator": None,
+        "checker": None,
+        "ssh": {"mode": "dummy"},
+    }
+
+
+class SharedRegister:
+    """A lock-guarded register with atomic cas — the 'database'."""
+
+    def __init__(self, value: Any = None):
+        self._value = value
+        self._lock = threading.Lock()
+
+    def read(self):
+        with self._lock:
+            return self._value
+
+    def write(self, v):
+        with self._lock:
+            self._value = v
+
+    def cas(self, old, new) -> bool:
+        with self._lock:
+            if self._value == old:
+                self._value = new
+                return True
+            return False
+
+
+class AtomDB(db_ns.DB):
+    """DB whose 'state' is an in-memory register; setup resets it
+    (tests.clj:27-34)."""
+
+    def __init__(self, register: Optional[SharedRegister] = None):
+        self.register = register or SharedRegister()
+
+    def setup(self, test, node):
+        self.register.write(None)
+
+    def teardown(self, test, node):
+        self.register.write(None)
+
+
+class AtomClient(client_ns.Client):
+    """Client over the shared register: linearizable by construction
+    (tests.clj:36-56)."""
+
+    def __init__(self, register: SharedRegister):
+        self.register = register
+
+    def open(self, test, node):
+        return AtomClient(self.register)
+
+    def invoke(self, test, op: Op) -> Op:
+        if op.f == "read":
+            return op.replace(type="ok", value=self.register.read())
+        if op.f == "write":
+            self.register.write(op.value)
+            return op.replace(type="ok")
+        if op.f == "cas":
+            old, new = op.value
+            ok = self.register.cas(old, new)
+            return op.replace(type="ok" if ok else "fail")
+        raise ValueError(f"unknown op {op.f!r}")
+
+
+class FlakyClient(AtomClient):
+    """AtomClient that sometimes times out *after* applying (or not
+    applying) the op — produces indeterminate :info completions so tests can
+    exercise process reincarnation and crashed-op checker semantics."""
+
+    def __init__(self, register: SharedRegister, flake_p: float = 0.1,
+                 seed: Optional[int] = None):
+        super().__init__(register)
+        import random
+        self.flake_p = flake_p
+        self.rng = random.Random(seed)
+
+    def open(self, test, node):
+        return FlakyClient(self.register, self.flake_p,
+                           self.rng.randrange(2**31))
+
+    def invoke(self, test, op: Op) -> Op:
+        if self.rng.random() < self.flake_p:
+            # maybe apply, then 'time out'
+            if self.rng.random() < 0.5 and op.f != "read":
+                super().invoke(test, op)
+            raise TimeoutError("simulated client timeout")
+        return super().invoke(test, op)
+
+
+def atom_test(register: Optional[SharedRegister] = None, **overrides) -> dict:
+    """A runnable in-memory CAS-register test (core_test.clj basic-cas-test
+    shape)."""
+    reg = register or SharedRegister()
+    test = noop_test()
+    test.update({
+        "name": "atom-cas",
+        "db": AtomDB(reg),
+        "client": AtomClient(reg),
+        "model": CASRegister(),
+    })
+    test.update(overrides)
+    return test

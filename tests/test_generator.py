@@ -1,0 +1,248 @@
+"""Generator semantics tests — mirrors reference generator_test.clj's
+in-memory op-pump: fake worker threads pull ops until exhaustion."""
+
+import threading
+import time
+from collections import defaultdict
+
+import pytest
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.history import NEMESIS, Op
+from jepsen_tpu.util import with_relative_time
+
+
+def pump(g, concurrency=2, with_nemesis=False, max_ops=10_000):
+    """Spin worker threads pulling ops until the generator is exhausted.
+    Returns {thread: [op, ...]} (generator_test.clj:10-25)."""
+    test = {"concurrency": concurrency, "nodes": ["n1", "n2", "n3"]}
+    out = defaultdict(list)
+    lock = threading.Lock()
+    threads = list(range(concurrency)) + ([NEMESIS] if with_nemesis else [])
+
+    def worker(t):
+        with gen.threads_bound(gen.all_threads(test) if with_nemesis
+                               else frozenset(range(concurrency))):
+            n = 0
+            while n < max_ops:
+                o = gen.op_and_validate(g, test, t)
+                if o is None:
+                    break
+                with lock:
+                    out[t].append(o)
+                n += 1
+
+    ts = [threading.Thread(target=worker, args=(t,)) for t in threads]
+    with with_relative_time():
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+            assert not t.is_alive(), "worker deadlocked"
+    return dict(out)
+
+
+def ops_of(result):
+    return [o for ops in result.values() for o in ops]
+
+
+class TestCoercions:
+    def test_none_is_void(self):
+        assert gen.gen(None).op({}, 0) is None
+
+    def test_dict_is_infinite(self):
+        g = gen.gen({"f": "read"})
+        o1 = g.op({}, 0)
+        o2 = g.op({}, 0)
+        assert o1.f == "read" and o2.f == "read" and o1 is not o2
+        assert o1.type == "invoke"
+
+    def test_fn_gen(self):
+        g = gen.gen(lambda test, process: Op(type="invoke", f="x",
+                                             value=process))
+        assert g.op({}, 7).value == 7
+
+    def test_list_is_seq(self):
+        g = gen.gen([gen.once({"f": "a"}), gen.once({"f": "b"})])
+        assert g.op({}, 0).f == "a"
+        assert g.op({}, 0).f == "b"
+        assert g.op({}, 0) is None
+
+
+class TestLimit:
+    def test_limit_total(self):
+        res = pump(gen.limit(10, {"f": "read"}), concurrency=4)
+        assert len(ops_of(res)) == 10
+
+    def test_once(self):
+        res = pump(gen.once({"f": "read"}), concurrency=4)
+        assert len(ops_of(res)) == 1
+
+
+class TestSeq:
+    # generator_test.clj seq semantics: generators exhausted in order
+    def test_seq_in_order(self):
+        g = gen.seq([gen.limit(2, {"f": "a"}), gen.limit(3, {"f": "b"})])
+        res = pump(g, concurrency=1)
+        assert [o.f for o in res[0]] == ["a", "a", "b", "b", "b"]
+
+    def test_concat(self):
+        g = gen.concat(gen.once({"f": "a"}), gen.once({"f": "b"}))
+        res = pump(g, concurrency=2)
+        assert sorted(o.f for o in ops_of(res)) == ["a", "b"]
+
+
+class TestMix:
+    def test_mix_draws_from_all(self):
+        g = gen.limit(200, gen.mix([{"f": "a"}, {"f": "b"}]))
+        fs = {o.f for o in ops_of(pump(g, concurrency=2))}
+        assert fs == {"a", "b"}
+
+
+class TestTimeLimit:
+    def test_time_limit_stops(self):
+        g = gen.time_limit(0.2, {"f": "read"})
+        t0 = time.monotonic()
+        res = pump(gen.delay(0.01, g), concurrency=2)
+        dt = time.monotonic() - t0
+        assert ops_of(res)  # got some ops
+        assert dt < 5
+
+
+class TestRouting:
+    def test_nemesis_routing(self):
+        # generator_test.clj:34-95 nemesis routing: nemesis sees its gen,
+        # clients see theirs
+        g = gen.nemesis(gen.limit(3, {"f": "break"}),
+                        gen.limit(5, {"f": "read"}))
+        res = pump(g, concurrency=2, with_nemesis=True)
+        assert all(o.f == "break" for o in res.get(NEMESIS, []))
+        assert len(res.get(NEMESIS, [])) == 3
+        client_ops = [o for t, ops in res.items() if t != NEMESIS
+                      for o in ops]
+        assert all(o.f == "read" for o in client_ops)
+        assert len(client_ops) == 5
+
+    def test_clients_hides_nemesis(self):
+        g = gen.clients(gen.limit(4, {"f": "read"}))
+        res = pump(g, concurrency=2, with_nemesis=True)
+        assert not res.get(NEMESIS)
+        assert len(ops_of(res)) == 4
+
+    def test_on_filters_threads(self):
+        g = gen.on_threads(lambda t: t == 0, gen.limit(3, {"f": "x"}))
+        res = pump(g, concurrency=3)
+        assert len(res.get(0, [])) == 3
+        assert not res.get(1) and not res.get(2)
+
+
+class TestReserve:
+    def test_reserve_ranges(self):
+        g = gen.reserve(2, gen.limit(10, {"f": "writes"}),
+                        gen.limit(10, {"f": "reads"}))
+        res = pump(g, concurrency=5)
+        for t, ops in res.items():
+            if t in (0, 1):
+                assert all(o.f == "writes" for o in ops)
+            else:
+                assert all(o.f == "reads" for o in ops)
+
+    def test_reserve_requires_default(self):
+        with pytest.raises(ValueError):
+            gen.reserve(2, {"f": "a"})
+
+
+class TestSynchronize:
+    def test_synchronize_releases_together(self):
+        order = []
+        lock = threading.Lock()
+
+        def record(test, process):
+            with lock:
+                order.append(("op", time.monotonic()))
+            return None
+
+        g = gen.seq([
+            gen.on_threads(lambda t: t == 0, gen.Sleep(0.2)),
+            gen.synchronize(gen.limit(2, {"f": "after"})),
+        ])
+        res = pump(g, concurrency=2)
+        assert len(ops_of(res)) == 2
+
+    def test_phases(self):
+        # generator_test.clj phases: all threads finish phase 1 before 2
+        g = gen.phases(gen.limit(2, {"f": "p1"}),
+                       gen.limit(2, {"f": "p2"}))
+        res = pump(g, concurrency=2)
+        fs = [o.f for o in ops_of(res)]
+        assert sorted(fs) == ["p1", "p1", "p2", "p2"]
+
+    def test_then(self):
+        g = gen.then_(gen.once({"f": "second"}), gen.once({"f": "first"}))
+        res = pump(g, concurrency=2)
+        fs = [o.f for o in ops_of(res)]
+        assert sorted(fs) == ["first", "second"]
+
+
+class TestEach:
+    def test_each_thread_gets_own_copy(self):
+        g = gen.each(lambda: gen.limit(2, {"f": "mine"}))
+        res = pump(g, concurrency=3)
+        assert all(len(ops) == 2 for ops in res.values())
+        assert len(res) == 3
+
+
+class TestFilter:
+    def test_filter(self):
+        src = gen.seq([gen.once({"f": "a"}), gen.once({"f": "b"}),
+                       gen.once({"f": "a"})])
+        g = gen.filter_gen(lambda o: o.f == "a", src)
+        res = pump(g, concurrency=1)
+        assert [o.f for o in res[0]] == ["a", "a"]
+
+
+class TestWorkloads:
+    def test_cas_gen_shapes(self):
+        g = gen.limit(100, gen.cas_gen())
+        for o in ops_of(pump(g, concurrency=2)):
+            assert o.f in ("read", "write", "cas")
+            if o.f == "cas":
+                assert len(o.value) == 2
+            if o.f == "read":
+                assert o.value is None
+
+    def test_queue_gen_unique_enqueues(self):
+        g = gen.limit(100, gen.queue_gen())
+        vals = [o.value for o in ops_of(pump(g, concurrency=3))
+                if o.f == "enqueue"]
+        assert len(vals) == len(set(vals))
+
+    def test_start_stop(self):
+        g = gen.limit(4, gen.start_stop(0, 0))
+        res = pump(g, concurrency=1)
+        assert [o.f for o in res[0]] == ["start", "stop", "start", "stop"]
+
+
+class TestDelayTil:
+    def test_delay_til_aligns(self):
+        g = gen.delay_til(0.05, gen.limit(4, {"f": "x"}))
+        res = pump(g, concurrency=2)
+        assert len(ops_of(res)) == 4
+
+
+class TestValidation:
+    def test_rejects_completion_types(self):
+        g = gen.gen({"type": "ok", "f": "read"})
+        with pytest.raises(ValueError):
+            gen.op_and_validate(g, {"concurrency": 1}, 0)
+
+    def test_process_to_thread(self):
+        test = {"concurrency": 3}
+        assert gen.process_to_thread(0, test) == 0
+        assert gen.process_to_thread(5, test) == 2
+        assert gen.process_to_thread(NEMESIS, test) == NEMESIS
+
+    def test_process_to_node(self):
+        test = {"nodes": ["n1", "n2"]}
+        assert gen.process_to_node(0, test) == "n1"
+        assert gen.process_to_node(3, test) == "n2"
