@@ -1,0 +1,357 @@
+"""Batched linearizability search on TPU — the north-star workload.
+
+This is the device backend for :class:`jepsen_tpu.checker.wgl.
+LinearizableChecker` (reference: knossos's wgl/linear algorithms selected at
+jepsen/src/jepsen/checker.clj:85-94; the CPU oracle with identical semantics
+is :mod:`jepsen_tpu.checker.wgl`).
+
+Design
+------
+A WGL configuration is ``(k, mask, state)``: ops ``[0, k)`` in return order
+are linearized, ``mask`` bit *o* marks op ``k+o`` as additionally
+linearized, ``state`` is the model state as one int32 (see
+:class:`jepsen_tpu.models.core.KernelSpec`). The crucial structural fact is
+that **every successor linearizes exactly one more operation**, so the
+search DAG is leveled: a configuration reachable in L moves is reachable
+*only* in L moves. Level-synchronous BFS therefore needs no global visited
+set — deduplicating within each frontier (a sort + adjacent-compare, which
+XLA maps onto the TPU's sort unit) gives the same pruning the CPU oracle
+gets from its hash set.
+
+Each level is a fixed-shape tensor program:
+
+1. expand: ``[C] configs × [W] window offsets -> [C*W]`` candidate
+   successors through the model's branchless integer step kernel (vmapped —
+   thousands of model states per vector lane),
+2. detect completion (any successor with ``k >= n_required``),
+3. sort ``[C*W]`` rows lexicographically by (validity, k, mask, state),
+   mark adjacent duplicates, compact survivors to the front,
+4. keep the first C as the next frontier.
+
+The whole search is one ``lax.while_loop`` under ``jit``; histories are the
+int32 columns of :class:`jepsen_tpu.ops.encode.PackedHistory`. Independent
+keys (the data-parallel axis of reference independent.clj:65-219) batch via
+``vmap`` and shard across a ``jax.sharding.Mesh`` — per-key validity is
+combined host-side (logical AND), counterexamples gathered per key.
+
+Soundness: a found witness proves linearizability outright. An exhausted
+search proves non-linearizability only if neither capacity (frontier > C
+unique configs) nor window (a candidate beyond offset W) overflowed;
+otherwise the result is "unknown" and the caller falls back to the exact
+CPU search.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+from jepsen_tpu.checker import UNKNOWN
+from jepsen_tpu.history import History
+from jepsen_tpu.models.core import KernelSpec, Model, kernel_spec_for
+from jepsen_tpu.ops.encode import (
+    PackedHistory, RET_INF, pack_keyed_histories, pack_with_init)
+
+try:  # JAX is a hard dependency of this module, soft for the package.
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    HAVE_JAX = True
+except ImportError:  # pragma: no cover
+    HAVE_JAX = False
+
+
+#: Default frontier capacity (configurations kept per level, per key).
+DEFAULT_CAPACITY = 2048
+#: Candidate window width: max offset from the frontier an op may be
+#: linearized at. Bounded below by the history's max concurrency.
+WINDOW = 32
+
+
+def _bucket(n: int, lo: int = 16) -> int:
+    """Round n up to a power of two so jit compilations are shared across
+    histories of similar length (padding rows are never candidates)."""
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+def _suffix_min_inv(inv: np.ndarray, n: int) -> np.ndarray:
+    """suffix_min[j] = min(inv[j:]), suffix_min[n] = RET_INF — lets the
+    device test "any candidate beyond the window?" with one gather."""
+    out = np.full(n + 1, int(RET_INF), dtype=np.int32)
+    for j in range(n - 1, -1, -1):
+        out[j] = min(int(inv[j]), int(out[j + 1]))
+    return out
+
+
+def _trailing_ones(m):
+    """Count trailing one-bits of a uint32 array (branchless)."""
+    y = ~m
+    low = y & (jnp.uint32(0) - y)          # lowest zero bit of m, 0 if none
+    return lax.population_count(low - jnp.uint32(1)).astype(jnp.int32)
+
+
+def _search_fn(step, n: int, capacity: int, window: int):
+    """Build the single-key search over columns of static length n.
+
+    Returns a function (f, v1, v2, inv, ret, sufmin, n_required, init_state)
+    -> (done, exhausted_clean, best_k, levels) of jnp scalars. Pure jnp —
+    safe under jit, vmap, and shard_map.
+    """
+    C, W = capacity, window
+
+    def search(f, v1, v2, inv, ret, sufmin, n_required, init_state):
+        offs = jnp.arange(W, dtype=jnp.int32)          # [W]
+
+        k0 = jnp.zeros(C, jnp.int32)
+        mask0 = jnp.zeros(C, jnp.uint32)
+        state0 = jnp.full(C, 0, jnp.int32) + init_state
+        alive0 = jnp.arange(C) == 0
+        # (k, mask, state, alive, done, overflow, window_ovf, level, best_k)
+        carry0 = (k0, mask0, state0, alive0,
+                  n_required == 0, jnp.bool_(False), jnp.bool_(False),
+                  jnp.int32(0), jnp.int32(0))
+
+        def active(c):
+            k, mask, state, alive, done, ovf, wovf, level, best = c
+            return (~done) & jnp.any(alive) & (level <= n)
+
+        def body(c):
+            k, mask, state, alive, done, ovf, wovf, level, best = c
+
+            # -- window-overflow probe on the live frontier ----------------
+            kc = jnp.clip(k, 0, n - 1)
+            ret_k = ret[kc]                                     # [C]
+            beyond = sufmin[jnp.clip(k + W, 0, n)]              # [C]
+            wovf2 = wovf | jnp.any(alive & (beyond < ret_k))
+
+            # -- expand: [C, W] successor grid ----------------------------
+            j = k[:, None] + offs[None, :]                      # [C, W]
+            jc = jnp.clip(j, 0, n - 1)
+            cand = (alive[:, None]
+                    & (j < n)
+                    & (inv[jc] < ret_k[:, None])
+                    & (((mask[:, None] >> offs.astype(jnp.uint32)[None, :])
+                        & jnp.uint32(1)) == 0))
+            s2, ok = step(state[:, None], f[jc], v1[jc], v2[jc])
+            valid = cand & ok
+
+            # frontier advance for o == 0: skip runs of already-linearized
+            m1 = mask >> jnp.uint32(1)
+            t = _trailing_ones(m1)                              # [C]
+            k_adv = k + 1 + t
+            m_adv = jnp.where(t >= 32, jnp.uint32(0),
+                              m1 >> jnp.minimum(t, 31).astype(jnp.uint32))
+
+            is0 = offs[None, :] == 0                            # [1, W]
+            k2 = jnp.where(is0, k_adv[:, None], k[:, None])
+            bit = jnp.uint32(1) << offs.astype(jnp.uint32)[None, :]
+            m2 = jnp.where(is0, m_adv[:, None], mask[:, None] | bit)
+            s2 = s2.astype(jnp.int32)
+
+            # -- flatten + completion check -------------------------------
+            fk = k2.reshape(-1)
+            fm = m2.reshape(-1)
+            fs = s2.reshape(-1)
+            fv = valid.reshape(-1)
+            done2 = done | jnp.any(fv & (fk >= n_required))
+            best2 = jnp.maximum(best, jnp.max(jnp.where(fv, fk, 0)))
+
+            # -- dedup: lexsort by (invalid, k, mask, state) --------------
+            inval = (~fv).astype(jnp.int32)
+            inval, fk, fm, fs = lax.sort((inval, fk, fm, fs), num_keys=4)
+            same_prev = jnp.concatenate([
+                jnp.zeros(1, bool),
+                (fk[1:] == fk[:-1]) & (fm[1:] == fm[:-1])
+                & (fs[1:] == fs[:-1]) & (inval[1:] == 0) & (inval[:-1] == 0),
+            ])
+            uniq = (inval == 0) & ~same_prev
+            u = jnp.sum(uniq.astype(jnp.int32))
+            ovf2 = ovf | (u > C)
+
+            # -- compact unique survivors to the front, keep first C ------
+            inval2 = (~uniq).astype(jnp.int32)
+            inval2, fk, fm, fs = lax.sort((inval2, fk, fm, fs), num_keys=1)
+            k3 = fk[:C]
+            m3 = fm[:C]
+            s3 = fs[:C]
+            a3 = inval2[:C] == 0
+
+            new = (k3, m3, s3, a3, done2, ovf2, wovf2,
+                   level + 1, best2)
+            # Masked update: lanes finished under vmap must not mutate.
+            act = active(c)
+            return tuple(jnp.where(act, nw, old) for nw, old in zip(new, c))
+
+        out = lax.while_loop(active, body, carry0)
+        _, _, _, alive, done, ovf, wovf, level, best = out
+        return done, ~(ovf | wovf), best, level
+
+    return search
+
+
+# The jit caches key on kernel *identity* (two KernelSpecs sharing a name
+# must not share compiled search code); the side table pins the object so
+# its id cannot be recycled.
+_KERNELS_BY_ID: Dict[int, KernelSpec] = {}
+
+
+def _kernel_key(kernel: KernelSpec) -> int:
+    _KERNELS_BY_ID[id(kernel)] = kernel
+    return id(kernel)
+
+
+@functools.lru_cache(maxsize=32)
+def _jit_single(kernel_id: int, capacity: int, window: int):
+    kernel = _KERNELS_BY_ID[kernel_id]
+    return jax.jit(
+        lambda f, v1, v2, inv, ret, sm, nr, ini: _search_fn(
+            kernel.step, f.shape[0], capacity, window)(
+                f, v1, v2, inv, ret, sm, nr, ini))
+
+
+@functools.lru_cache(maxsize=32)
+def _jit_batch(kernel_id: int, capacity: int, window: int):
+    kernel = _KERNELS_BY_ID[kernel_id]
+
+    def batched(f, v1, v2, inv, ret, sm, nr, ini):
+        search = _search_fn(kernel.step, f.shape[1], capacity, window)
+        return jax.vmap(search)(f, v1, v2, inv, ret, sm, nr, ini)
+
+    return jax.jit(batched)
+
+
+def _check_window(window: int) -> None:
+    if window > 32:
+        raise ValueError(
+            f"window {window} > 32: masks are uint32; shifts past the word "
+            f"width would silently corrupt the search")
+
+
+def _result(done: bool, clean: bool, best_k: int, levels: int,
+            p: Optional[PackedHistory] = None) -> Dict[str, Any]:
+    if done:
+        return {"valid": True, "levels": levels, "backend": "tpu"}
+    if clean:
+        out = {"valid": False, "levels": levels,
+               "max-linearized-prefix": best_k, "backend": "tpu"}
+        if p is not None and p.ops and best_k < len(p.ops):
+            inv_op = p.ops[best_k][0]
+            out["frontier-op"] = inv_op.to_dict() if inv_op else None
+        return out
+    return {"valid": UNKNOWN, "levels": levels,
+            "error": "frontier capacity or window exhausted",
+            "backend": "tpu"}
+
+
+def check_packed_tpu(p: PackedHistory, kernel: KernelSpec,
+                     capacity: int = DEFAULT_CAPACITY,
+                     window: int = WINDOW) -> Dict[str, Any]:
+    """Check one packed single-key history on the default JAX backend."""
+    _check_window(window)
+    if p.n_required == 0:
+        return {"valid": True, "levels": 0, "backend": "tpu"}
+    orig = p
+    p = p.pad_to(_bucket(p.n))
+    p.ops = orig.ops  # pad_to copies; counterexample lookup stays exact
+    fn = _jit_single(_kernel_key(kernel), capacity, window)
+    sm = _suffix_min_inv(p.inv, p.n)
+    done, clean, best, levels = fn(
+        jnp.asarray(p.f), jnp.asarray(p.v1), jnp.asarray(p.v2),
+        jnp.asarray(p.inv), jnp.asarray(p.ret), jnp.asarray(sm),
+        jnp.int32(p.n_required), jnp.int32(p.init_state))
+    return _result(bool(done), bool(clean), int(best), int(levels), p)
+
+
+def check_history_tpu(history: History, model: Model,
+                      capacity: int = DEFAULT_CAPACITY,
+                      window: int = WINDOW) -> Optional[Dict[str, Any]]:
+    """Entry point used by LinearizableChecker(backend='tpu').
+
+    Returns None when the model has no single-word integer kernel (the
+    caller then uses the generic CPU object search).
+    """
+    _check_window(window)
+    try:
+        pk = pack_with_init(history, model)
+    except ValueError:  # op f unsupported by the integer kernel
+        return None
+    if pk is None:
+        return None
+    packed, kernel = pk
+    if packed.max_concurrency() > window:
+        return {"valid": UNKNOWN, "backend": "tpu",
+                "error": f"concurrency {packed.max_concurrency()} exceeds "
+                         f"window {window}"}
+    return check_packed_tpu(packed, kernel, capacity, window)
+
+
+def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
+                    capacity: int = DEFAULT_CAPACITY,
+                    window: int = WINDOW,
+                    mesh: Optional["jax.sharding.Mesh"] = None,
+                    axis: str = "keys") -> Dict[str, Any]:
+    """Check a {key: history} map batched on device — the independent-key
+    data-parallel axis (reference independent.clj:65-219 lifts generators,
+    independent.clj:246-296 fans the checker out per key; here the fan-out
+    is a vmapped, mesh-sharded tensor program).
+
+    With a mesh, key-batch arrays are sharded over ``axis`` and XLA's SPMD
+    partitioner runs each shard's searches on its own device over ICI.
+    """
+    _check_window(window)
+    kernel = kernel_spec_for(model)
+    if kernel is None:
+        raise ValueError(f"model {model!r} has no integer kernel")
+    keys = list(keyed.keys())
+    if not keys:
+        return {"valid": True, "results": {}, "backend": "tpu"}
+    packed, batch = pack_keyed_histories(keyed, kernel, model=model)
+    K = len(keys)
+    n = int(batch["f"].shape[1])
+    if n == 0:
+        return {"valid": True,
+                "results": {k: {"valid": True} for k in keys},
+                "backend": "tpu"}
+    b = _bucket(n)
+    if b > n:  # bucket column length so compilations are shared
+        pad_spec = {"f": 0, "v1": -1, "v2": -1,
+                    "inv": int(RET_INF), "ret": int(RET_INF)}
+        for name, fill in pad_spec.items():
+            batch[name] = np.pad(batch[name], ((0, 0), (0, b - n)),
+                                 constant_values=fill)
+        n = b
+    sm = np.stack([_suffix_min_inv(batch["inv"][i], n) for i in range(K)])
+
+    arrays = [batch["f"], batch["v1"], batch["v2"], batch["inv"],
+              batch["ret"], sm, batch["n_required"], batch["init_state"]]
+
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        # Pad K up to the mesh axis size so the batch divides evenly.
+        per = mesh.shape[axis]
+        pad = (-K) % per
+        if pad:
+            arrays = [np.concatenate([a, np.repeat(a[-1:], pad, axis=0)])
+                      for a in arrays]
+        sh_row = NamedSharding(mesh, P(axis))
+        arrays = [jax.device_put(np.asarray(a), sh_row) for a in arrays]
+
+    fn = _jit_batch(_kernel_key(kernel), capacity, window)
+    done, clean, best, levels = (np.asarray(x) for x in fn(*arrays))
+    results = {}
+    for i, key in enumerate(keys):
+        results[key] = _result(bool(done[i]), bool(clean[i]),
+                               int(best[i]), int(levels[i]), packed[i])
+    valid = True
+    for r in results.values():
+        if r["valid"] is False:
+            valid = False
+            break
+        if r["valid"] is UNKNOWN:
+            valid = UNKNOWN
+    return {"valid": valid, "results": results, "backend": "tpu"}

@@ -34,8 +34,8 @@ from typing import Any, Dict, List, Optional, Tuple
 from jepsen_tpu.checker import Checker, UNKNOWN
 from jepsen_tpu.history import History, Op
 from jepsen_tpu.models.core import (
-    KernelSpec, Model, is_inconsistent, kernel_spec_for)
-from jepsen_tpu.ops.encode import PackedHistory, RET_INF, pack_history
+    KernelSpec, Model, is_inconsistent)
+from jepsen_tpu.ops.encode import PackedHistory, RET_INF
 
 
 def check_packed(p: PackedHistory,
@@ -244,22 +244,15 @@ class LinearizableChecker(Checker):
                 return res
             # fall through to exact CPU search on unknown (e.g. window
             # overflow or model without an integer kernel)
-        kernel = kernel_spec_for(model)
-        if kernel is not None:
-            from jepsen_tpu.ops.encode import _Interner
-            intern = _Interner()
-            # Non-nil initial register value: intern it first so it becomes
-            # the packed init state.
-            init_value = getattr(model, "value", None)
-            init_id = intern.id(init_value) if init_value is not None else None
-            try:
-                packed = pack_history(history, kernel, intern)
-            except ValueError:
-                return check_model(history, model, self.max_configs)
-            if init_id is not None:
-                packed.init_state = init_id
-            return check_packed(packed, kernel, self.max_configs)
-        return check_model(history, model, self.max_configs)
+        from jepsen_tpu.ops.encode import pack_with_init
+        try:
+            pk = pack_with_init(history, model)
+        except ValueError:  # op f unsupported by the integer kernel
+            pk = None
+        if pk is None:
+            return check_model(history, model, self.max_configs)
+        packed, kernel = pk
+        return check_packed(packed, kernel, self.max_configs)
 
 
 def linearizable(model: Optional[Model] = None, backend: str = "cpu",

@@ -309,6 +309,9 @@ class KernelSpec:
     init_state: int
     step: Callable  # (state, f, v1, v2) -> (state', ok)
     f_codes: dict   # op.f -> int code
+    #: Map a model *instance* to its packed initial state, given an interner
+    #: fn (value -> id). None means init_state is instance-independent.
+    pack_init: Optional[Callable] = None
 
 
 def _cas_register_step(state, f, v1, v2):
@@ -343,6 +346,8 @@ CAS_REGISTER_KERNEL = KernelSpec(
     init_state=NIL_ID,
     step=_cas_register_step,
     f_codes={"read": F_READ, "write": F_WRITE, "cas": F_CAS},
+    pack_init=lambda m, intern: (NIL_ID if m.value is None
+                                 else intern(m.value)),
 )
 
 MUTEX_KERNEL = KernelSpec(
@@ -350,6 +355,7 @@ MUTEX_KERNEL = KernelSpec(
     init_state=0,
     step=_mutex_step,
     f_codes={"acquire": F_ACQUIRE, "release": F_RELEASE},
+    pack_init=lambda m, intern: int(m.locked),
 )
 
 NOOP_KERNEL = KernelSpec(

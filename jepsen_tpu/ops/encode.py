@@ -210,8 +210,30 @@ def pack_history(history: Sequence[Op], kernel: KernelSpec,
     )
 
 
+def pack_with_init(history: Sequence[Op], model,
+                   kernel: Optional[KernelSpec] = None
+                   ) -> Optional[Tuple[PackedHistory, KernelSpec]]:
+    """Pack a history with the initial state taken from a model *instance*
+    (via the kernel's pack_init hook). Returns None when the model has no
+    integer kernel; raises ValueError on unsupported op f's (caller falls
+    back to the generic object search). Shared by the CPU (checker.wgl) and
+    TPU (checker.tpu) backends so the init-state encoding cannot diverge.
+    """
+    from jepsen_tpu.models.core import kernel_spec_for
+    kernel = kernel or kernel_spec_for(model)
+    if kernel is None:
+        return None
+    intern = _Interner()
+    init = (kernel.pack_init(model, intern.id)
+            if kernel.pack_init is not None else kernel.init_state)
+    packed = pack_history(history, kernel, intern)
+    packed.init_state = init
+    return packed, kernel
+
+
 def pack_keyed_histories(keyed: Dict[Any, Sequence[Op]],
-                         kernel: KernelSpec) -> Tuple[list, dict]:
+                         kernel: KernelSpec,
+                         model=None) -> Tuple[list, dict]:
     """Pack a {key: history} map (the independent-key axis, reference
     independent.clj:65-219) into a list of equal-length PackedHistories plus
     batched arrays ready for vmap/sharding.
@@ -221,7 +243,10 @@ def pack_keyed_histories(keyed: Dict[Any, Sequence[Op]],
     init_state: int32[K].
     """
     keys = list(keyed.keys())
-    packed = [pack_history(keyed[k], kernel) for k in keys]
+    if model is not None:
+        packed = [pack_with_init(keyed[k], model, kernel)[0] for k in keys]
+    else:
+        packed = [pack_history(keyed[k], kernel) for k in keys]
     n_max = max((p.n for p in packed), default=0)
     padded = [p.pad_to(n_max) for p in packed]
     batch = {

@@ -1,0 +1,166 @@
+"""TPU (batched JAX) linearizability backend tests.
+
+Runs on the virtual 8-device CPU mesh (conftest.py). The CPU WGL from
+jepsen_tpu.checker.wgl — itself validated against a brute-force oracle in
+test_linearizable.py — is the reference semantics here.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from jepsen_tpu.checker import UNKNOWN
+from jepsen_tpu.checker.tpu import (
+    check_history_tpu, check_keyed_tpu, check_packed_tpu)
+from jepsen_tpu.checker.wgl import check_packed, linearizable
+from jepsen_tpu.history import History, Op
+from jepsen_tpu.models import CASRegister, Mutex
+from jepsen_tpu.models.core import CAS_REGISTER_KERNEL, MUTEX_KERNEL
+from jepsen_tpu.ops import pack_history
+
+from test_linearizable import H, random_register_history
+
+
+class TestGoldenTPU:
+    def test_sequential_valid(self):
+        h = H((0, "invoke", "write", 0), (0, "ok", "write", 0),
+              (1, "invoke", "read", None), (1, "ok", "read", 0))
+        assert check_history_tpu(h, CASRegister())["valid"] is True
+
+    def test_sequential_invalid(self):
+        h = H((0, "invoke", "write", 0), (0, "ok", "write", 0),
+              (1, "invoke", "read", None), (1, "ok", "read", 1))
+        assert check_history_tpu(h, CASRegister())["valid"] is False
+
+    def test_cas_then_stale_read_invalid(self):
+        h = H((0, "invoke", "write", 0), (0, "ok", "write", 0),
+              (1, "invoke", "cas", (0, 1)), (1, "ok", "cas", (0, 1)),
+              (2, "invoke", "read", None), (2, "ok", "read", 0))
+        assert check_history_tpu(h, CASRegister())["valid"] is False
+
+    def test_crashed_write_may_apply(self):
+        h = H((0, "invoke", "write", 1),
+              (0, "info", "write", 1),
+              (1, "invoke", "read", None), (1, "ok", "read", 1))
+        assert check_history_tpu(h, CASRegister())["valid"] is True
+
+    def test_crashed_write_applies_late(self):
+        h = H((0, "invoke", "write", 0), (0, "ok", "write", 0),
+              (1, "invoke", "write", 9),
+              (2, "invoke", "read", None), (2, "ok", "read", 0),
+              (3, "invoke", "read", None), (3, "ok", "read", 9))
+        assert check_history_tpu(h, CASRegister())["valid"] is True
+
+    def test_mutex(self):
+        bad = H((0, "invoke", "acquire", None), (0, "ok", "acquire", None),
+                (1, "invoke", "acquire", None), (1, "ok", "acquire", None))
+        assert check_history_tpu(bad, Mutex())["valid"] is False
+        good = H((0, "invoke", "acquire", None), (0, "ok", "acquire", None),
+                 (0, "invoke", "release", None), (0, "ok", "release", None),
+                 (1, "invoke", "acquire", None), (1, "ok", "acquire", None))
+        assert check_history_tpu(good, Mutex())["valid"] is True
+
+    def test_empty(self):
+        assert check_history_tpu(H(), CASRegister())["valid"] is True
+
+    def test_nonnil_initial_value(self):
+        h = H((0, "invoke", "read", None), (0, "ok", "read", 7))
+        assert check_history_tpu(h, CASRegister(7))["valid"] is True
+        assert check_history_tpu(h, CASRegister(8))["valid"] is False
+
+
+class TestInitStates:
+    def test_keyed_nonnil_initial_value(self):
+        # regression: keyed path must honor the model instance's init state
+        h = H((0, "invoke", "read", None), (0, "ok", "read", 7))
+        out = check_keyed_tpu({0: h}, CASRegister(7))
+        assert out["results"][0]["valid"] is True
+        out8 = check_keyed_tpu({0: h}, CASRegister(8))
+        assert out8["results"][0]["valid"] is False
+
+    def test_locked_mutex_initial_state(self):
+        # regression: Mutex(True) must start locked on the device path
+        h = H((0, "invoke", "acquire", None), (0, "ok", "acquire", None))
+        assert check_history_tpu(h, Mutex(True))["valid"] is False
+        assert check_history_tpu(h, Mutex(False))["valid"] is True
+
+    def test_window_over_32_rejected(self):
+        h = H((0, "invoke", "write", 0), (0, "ok", "write", 0))
+        with pytest.raises(ValueError):
+            check_history_tpu(h, CASRegister(), window=64)
+
+
+class TestAgainstCPUOracle:
+    def test_random_histories_agree(self):
+        rng = random.Random(7)
+        mismatches = []
+        for i in range(120):
+            h = random_register_history(rng, n_procs=4, n_ops=8, n_vals=3)
+            p = pack_history(h, CAS_REGISTER_KERNEL)
+            want = check_packed(p, CAS_REGISTER_KERNEL)["valid"]
+            got = check_packed_tpu(p, CAS_REGISTER_KERNEL,
+                                   capacity=512)["valid"]
+            if got is not want and got is not UNKNOWN:
+                mismatches.append((i, want, got))
+        assert not mismatches
+
+    def test_longer_histories_agree(self):
+        rng = random.Random(99)
+        for _ in range(10):
+            h = random_register_history(rng, n_procs=5, n_ops=60, n_vals=4,
+                                        crash_p=0.05)
+            p = pack_history(h, CAS_REGISTER_KERNEL)
+            want = check_packed(p, CAS_REGISTER_KERNEL)["valid"]
+            got = check_packed_tpu(p, CAS_REGISTER_KERNEL)["valid"]
+            assert got is want or got is UNKNOWN
+
+    def test_facade_tpu_backend(self):
+        h = H((0, "invoke", "write", 0), (0, "ok", "write", 0),
+              (1, "invoke", "read", None), (1, "ok", "read", 1))
+        c = linearizable(CASRegister(), backend="tpu")
+        assert c.check({}, h)["valid"] is False
+
+
+class TestKeyedBatch:
+    def _keyed(self, rng, n_keys):
+        keyed = {}
+        for k in range(n_keys):
+            keyed[k] = random_register_history(
+                rng, n_procs=3, n_ops=10, n_vals=3, crash_p=0.1)
+        return keyed
+
+    def test_keyed_matches_per_key_cpu(self):
+        rng = random.Random(11)
+        keyed = self._keyed(rng, 6)
+        out = check_keyed_tpu(keyed, CASRegister(), capacity=512)
+        for k, h in keyed.items():
+            want = check_packed(pack_history(h, CAS_REGISTER_KERNEL),
+                                CAS_REGISTER_KERNEL)["valid"]
+            got = out["results"][k]["valid"]
+            assert got is want or got is UNKNOWN, (k, want, got)
+
+    def test_keyed_sharded_over_mesh(self):
+        devs = jax.devices()
+        assert len(devs) == 8, "conftest should force an 8-device CPU mesh"
+        mesh = jax.sharding.Mesh(np.array(devs), ("keys",))
+        rng = random.Random(13)
+        keyed = self._keyed(rng, 16)
+        out = check_keyed_tpu(keyed, CASRegister(), capacity=256, mesh=mesh)
+        assert set(out["results"]) == set(keyed)
+        for k, h in keyed.items():
+            want = check_packed(pack_history(h, CAS_REGISTER_KERNEL),
+                                CAS_REGISTER_KERNEL)["valid"]
+            got = out["results"][k]["valid"]
+            assert got is want or got is UNKNOWN, (k, want, got)
+
+    def test_keyed_unpadded_key_count(self):
+        # key count not divisible by mesh size exercises the padding path
+        devs = jax.devices()
+        mesh = jax.sharding.Mesh(np.array(devs), ("keys",))
+        rng = random.Random(17)
+        keyed = self._keyed(rng, 5)
+        out = check_keyed_tpu(keyed, CASRegister(), capacity=256, mesh=mesh)
+        assert set(out["results"]) == set(keyed)
