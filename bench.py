@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""North-star benchmark: linearizability-check a 10k-op etcd-style CAS
+register history on the attached accelerator.
+
+Baseline (BASELINE.md): the reference's checker (knossos on a 32 GB JVM)
+needs output truncation because results can take hours; the driver target is
+"10k-op history checked in < 60 s on TPU". vs_baseline = 60 / seconds, so
+1.0 == on-target, higher is better.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": ..., "unit": "s", "vs_baseline": ...}
+"""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+
+N_OPS = 10_000
+N_PROCS = 5
+TARGET_S = 60.0
+CAPACITY = 1024
+
+
+def main():
+    from jepsen_tpu.checker.tpu import check_history_tpu
+    from jepsen_tpu.models import CASRegister
+    from jepsen_tpu.testing import simulate_register_history
+
+    import jax
+
+    dev = jax.devices()[0]
+    print(f"# device: {dev.platform} {getattr(dev, 'device_kind', '')}",
+          file=sys.stderr)
+
+    print(f"# synthesizing {N_OPS}-op register history...", file=sys.stderr)
+    t0 = time.time()
+    history = simulate_register_history(
+        N_OPS, n_procs=N_PROCS, n_vals=16, seed=42, crash_p=0.002)
+    print(f"# synthesized {len(history)} events in {time.time()-t0:.1f}s",
+          file=sys.stderr)
+
+    # Warm-up: same op count => same padded bucket => shared compilation.
+    t0 = time.time()
+    warm = simulate_register_history(N_OPS, n_procs=N_PROCS, n_vals=16,
+                                     seed=7, crash_p=0.002)
+    r = check_history_tpu(warm, CASRegister(), capacity=CAPACITY)
+    print(f"# warm-up (incl. compile): {time.time()-t0:.1f}s -> {r['valid']}",
+          file=sys.stderr)
+
+    t0 = time.time()
+    result = check_history_tpu(history, CASRegister(), capacity=CAPACITY)
+    dt = time.time() - t0
+    print(f"# check: valid={result['valid']} levels={result.get('levels')} "
+          f"in {dt:.2f}s", file=sys.stderr)
+    if result["valid"] is not True:
+        # A wrong or unknown verdict on a valid-by-construction history is a
+        # bench failure, not a number.
+        print(json.dumps({"metric": "cas-register-10k-op-linearize",
+                          "value": None, "unit": "s", "vs_baseline": 0,
+                          "error": f"verdict {result['valid']!r}"}))
+        return 1
+
+    print(json.dumps({
+        "metric": "cas-register-10k-op-linearize",
+        "value": round(dt, 3),
+        "unit": "s",
+        "vs_baseline": round(TARGET_S / dt, 2),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

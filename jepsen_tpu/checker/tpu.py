@@ -51,8 +51,7 @@ import numpy as np
 from jepsen_tpu.checker import UNKNOWN
 from jepsen_tpu.history import History
 from jepsen_tpu.models.core import KernelSpec, Model, kernel_spec_for
-from jepsen_tpu.ops.encode import (
-    PackedHistory, RET_INF, pack_keyed_histories, pack_with_init)
+from jepsen_tpu.ops.encode import PackedHistory, RET_INF, pack_with_init
 
 try:  # JAX is a hard dependency of this module, soft for the package.
     import jax
@@ -95,33 +94,42 @@ def _trailing_ones(m):
     return lax.population_count(low - jnp.uint32(1)).astype(jnp.int32)
 
 
-def _search_fn(step, n: int, capacity: int, window: int):
-    """Build the single-key search over columns of static length n.
+def _search_fn(step, n: int, n_cr: int, capacity: int, window: int):
+    """Build the single-key search. ``n`` is the (static, padded) length of
+    the *required* section — ops with finite return, sorted by return index.
+    ``n_cr`` is the (static, padded) width of the *crashed* section — 'info'
+    ops pending forever, which MAY be linearized at any point after their
+    invocation; they get their own bitmask since they never age out of the
+    candidate set and so can't live in the offset window.
 
-    Returns a function (f, v1, v2, inv, ret, sufmin, n_required, init_state)
-    -> (done, exhausted_clean, best_k, levels) of jnp scalars. Pure jnp —
-    safe under jit, vmap, and shard_map.
+    Returns a function
+      (f, v1, v2, inv, ret, sufmin, cf, cv1, cv2, cinv, n_required,
+       init_state) -> (done, exhausted_clean, best_k, levels)
+    of jnp scalars. Pure jnp — safe under jit, vmap, and shard_map.
     """
-    C, W = capacity, window
+    C, W, CR = capacity, window, n_cr
 
-    def search(f, v1, v2, inv, ret, sufmin, n_required, init_state):
+    def search(f, v1, v2, inv, ret, sufmin, cf, cv1, cv2, cinv,
+               n_required, init_state):
         offs = jnp.arange(W, dtype=jnp.int32)          # [W]
+        coffs = jnp.arange(CR, dtype=jnp.int32)        # [CR]
 
         k0 = jnp.zeros(C, jnp.int32)
         mask0 = jnp.zeros(C, jnp.uint32)
+        cmask0 = jnp.zeros(C, jnp.uint32)
         state0 = jnp.full(C, 0, jnp.int32) + init_state
         alive0 = jnp.arange(C) == 0
-        # (k, mask, state, alive, done, overflow, window_ovf, level, best_k)
-        carry0 = (k0, mask0, state0, alive0,
+        # (k, mask, cmask, state, alive, done, ovf, wovf, level, best_k)
+        carry0 = (k0, mask0, cmask0, state0, alive0,
                   n_required == 0, jnp.bool_(False), jnp.bool_(False),
                   jnp.int32(0), jnp.int32(0))
 
         def active(c):
-            k, mask, state, alive, done, ovf, wovf, level, best = c
-            return (~done) & jnp.any(alive) & (level <= n)
+            k, mask, cmask, state, alive, done, ovf, wovf, level, best = c
+            return (~done) & jnp.any(alive) & (level <= n + CR)
 
         def body(c):
-            k, mask, state, alive, done, ovf, wovf, level, best = c
+            k, mask, cmask, state, alive, done, ovf, wovf, level, best = c
 
             # -- window-overflow probe on the live frontier ----------------
             kc = jnp.clip(k, 0, n - 1)
@@ -129,7 +137,7 @@ def _search_fn(step, n: int, capacity: int, window: int):
             beyond = sufmin[jnp.clip(k + W, 0, n)]              # [C]
             wovf2 = wovf | jnp.any(alive & (beyond < ret_k))
 
-            # -- expand: [C, W] successor grid ----------------------------
+            # -- expand required ops: [C, W] successor grid ---------------
             j = k[:, None] + offs[None, :]                      # [C, W]
             jc = jnp.clip(j, 0, n - 1)
             cand = (alive[:, None]
@@ -151,23 +159,44 @@ def _search_fn(step, n: int, capacity: int, window: int):
             k2 = jnp.where(is0, k_adv[:, None], k[:, None])
             bit = jnp.uint32(1) << offs.astype(jnp.uint32)[None, :]
             m2 = jnp.where(is0, m_adv[:, None], mask[:, None] | bit)
+            cm2 = jnp.broadcast_to(cmask[:, None], (C, W))
             s2 = s2.astype(jnp.int32)
 
-            # -- flatten + completion check -------------------------------
-            fk = k2.reshape(-1)
-            fm = m2.reshape(-1)
-            fs = s2.reshape(-1)
-            fv = valid.reshape(-1)
+            # -- expand crashed ops: [C, CR] successor grid ---------------
+            # A crashed op is a candidate once invoked before the frontier
+            # op's return; it stays one until taken (pad rows: cinv=RET_INF).
+            ccand = (alive[:, None]
+                     & (cinv[None, :] < ret_k[:, None])
+                     & (((cmask[:, None]
+                          >> coffs.astype(jnp.uint32)[None, :])
+                         & jnp.uint32(1)) == 0))
+            cs2, cok = step(state[:, None], cf[None, :], cv1[None, :],
+                            cv2[None, :])
+            cvalid = ccand & cok
+            ck2 = jnp.broadcast_to(k[:, None], (C, CR))
+            cmm2 = jnp.broadcast_to(mask[:, None], (C, CR))
+            cbit = jnp.uint32(1) << coffs.astype(jnp.uint32)[None, :]
+            ccm2 = cmask[:, None] | cbit
+            cs2 = jnp.broadcast_to(cs2.astype(jnp.int32), (C, CR))
+
+            # -- flatten both grids + completion check --------------------
+            fk = jnp.concatenate([k2.reshape(-1), ck2.reshape(-1)])
+            fm = jnp.concatenate([m2.reshape(-1), cmm2.reshape(-1)])
+            fcm = jnp.concatenate([cm2.reshape(-1), ccm2.reshape(-1)])
+            fs = jnp.concatenate([s2.reshape(-1), cs2.reshape(-1)])
+            fv = jnp.concatenate([valid.reshape(-1), cvalid.reshape(-1)])
             done2 = done | jnp.any(fv & (fk >= n_required))
             best2 = jnp.maximum(best, jnp.max(jnp.where(fv, fk, 0)))
 
-            # -- dedup: lexsort by (invalid, k, mask, state) --------------
+            # -- dedup: lexsort by (invalid, k, mask, cmask, state) -------
             inval = (~fv).astype(jnp.int32)
-            inval, fk, fm, fs = lax.sort((inval, fk, fm, fs), num_keys=4)
+            inval, fk, fm, fcm, fs = lax.sort(
+                (inval, fk, fm, fcm, fs), num_keys=5)
             same_prev = jnp.concatenate([
                 jnp.zeros(1, bool),
                 (fk[1:] == fk[:-1]) & (fm[1:] == fm[:-1])
-                & (fs[1:] == fs[:-1]) & (inval[1:] == 0) & (inval[:-1] == 0),
+                & (fcm[1:] == fcm[:-1]) & (fs[1:] == fs[:-1])
+                & (inval[1:] == 0) & (inval[:-1] == 0),
             ])
             uniq = (inval == 0) & ~same_prev
             u = jnp.sum(uniq.astype(jnp.int32))
@@ -175,20 +204,22 @@ def _search_fn(step, n: int, capacity: int, window: int):
 
             # -- compact unique survivors to the front, keep first C ------
             inval2 = (~uniq).astype(jnp.int32)
-            inval2, fk, fm, fs = lax.sort((inval2, fk, fm, fs), num_keys=1)
+            inval2, fk, fm, fcm, fs = lax.sort(
+                (inval2, fk, fm, fcm, fs), num_keys=1)
             k3 = fk[:C]
             m3 = fm[:C]
+            cm3 = fcm[:C]
             s3 = fs[:C]
             a3 = inval2[:C] == 0
 
-            new = (k3, m3, s3, a3, done2, ovf2, wovf2,
+            new = (k3, m3, cm3, s3, a3, done2, ovf2, wovf2,
                    level + 1, best2)
             # Masked update: lanes finished under vmap must not mutate.
             act = active(c)
             return tuple(jnp.where(act, nw, old) for nw, old in zip(new, c))
 
         out = lax.while_loop(active, body, carry0)
-        _, _, _, alive, done, ovf, wovf, level, best = out
+        done, ovf, wovf, level, best = out[5], out[6], out[7], out[8], out[9]
         return done, ~(ovf | wovf), best, level
 
     return search
@@ -208,21 +239,76 @@ def _kernel_key(kernel: KernelSpec) -> int:
 @functools.lru_cache(maxsize=32)
 def _jit_single(kernel_id: int, capacity: int, window: int):
     kernel = _KERNELS_BY_ID[kernel_id]
-    return jax.jit(
-        lambda f, v1, v2, inv, ret, sm, nr, ini: _search_fn(
-            kernel.step, f.shape[0], capacity, window)(
-                f, v1, v2, inv, ret, sm, nr, ini))
+
+    def single(f, v1, v2, inv, ret, sm, cf, cv1, cv2, cinv, nr, ini):
+        search = _search_fn(kernel.step, f.shape[0], cf.shape[0],
+                            capacity, window)
+        return search(f, v1, v2, inv, ret, sm, cf, cv1, cv2, cinv, nr, ini)
+
+    return jax.jit(single)
 
 
 @functools.lru_cache(maxsize=32)
 def _jit_batch(kernel_id: int, capacity: int, window: int):
     kernel = _KERNELS_BY_ID[kernel_id]
 
-    def batched(f, v1, v2, inv, ret, sm, nr, ini):
-        search = _search_fn(kernel.step, f.shape[1], capacity, window)
-        return jax.vmap(search)(f, v1, v2, inv, ret, sm, nr, ini)
+    def batched(f, v1, v2, inv, ret, sm, cf, cv1, cv2, cinv, nr, ini):
+        search = _search_fn(kernel.step, f.shape[1], cf.shape[1],
+                            capacity, window)
+        return jax.vmap(search)(
+            f, v1, v2, inv, ret, sm, cf, cv1, cv2, cinv, nr, ini)
 
     return jax.jit(batched)
+
+
+#: Max crashed ('info') ops per key: the crashed-set bitmask is uint32.
+CRASH_MAX = 32
+
+
+def _split_packed(p: PackedHistory, breq: int, cr: int) -> Optional[dict]:
+    """Split an (unpadded) PackedHistory into the padded required section
+    [breq] and crashed section [cr] device arrays. Returns None when the
+    history has more crashed ops than the crashed bitmask can hold."""
+    nr = p.n_required
+    n_cr = p.n - nr
+    if n_cr > cr:
+        return None
+
+    def pad(a, width, fill):
+        out = np.full(width, fill, dtype=np.int32)
+        out[:a.shape[0]] = a
+        return out
+
+    from jepsen_tpu.models.core import NIL_ID
+    inf = int(RET_INF)
+    inv_req = pad(p.inv[:nr], breq, inf)
+    return {
+        "f": pad(p.f[:nr], breq, 0),
+        "v1": pad(p.v1[:nr], breq, NIL_ID),
+        "v2": pad(p.v2[:nr], breq, NIL_ID),
+        "inv": inv_req,
+        "ret": pad(p.ret[:nr], breq, inf),
+        "sm": _suffix_min_inv(inv_req, breq),
+        "cf": pad(p.f[nr:], cr, 0),
+        "cv1": pad(p.v1[nr:], cr, NIL_ID),
+        "cv2": pad(p.v2[nr:], cr, NIL_ID),
+        "cinv": pad(p.inv[nr:], cr, inf),
+        "nr": np.int32(nr),
+        "ini": np.int32(p.init_state),
+    }
+
+
+_COLS = ("f", "v1", "v2", "inv", "ret", "sm", "cf", "cv1", "cv2", "cinv",
+         "nr", "ini")
+
+
+def _crash_width(n_cr: int) -> Optional[int]:
+    """Padded crashed-section width, or None when over the bitmask limit."""
+    if n_cr == 0:
+        return 0
+    if n_cr > CRASH_MAX:
+        return None
+    return _bucket(n_cr, lo=8)
 
 
 def _check_window(window: int) -> None:
@@ -255,15 +341,15 @@ def check_packed_tpu(p: PackedHistory, kernel: KernelSpec,
     _check_window(window)
     if p.n_required == 0:
         return {"valid": True, "levels": 0, "backend": "tpu"}
-    orig = p
-    p = p.pad_to(_bucket(p.n))
-    p.ops = orig.ops  # pad_to copies; counterexample lookup stays exact
+    cr = _crash_width(p.n - p.n_required)
+    cols = (None if cr is None
+            else _split_packed(p, _bucket(p.n_required), cr))
+    if cols is None:
+        return {"valid": UNKNOWN, "backend": "tpu",
+                "error": f"{p.n - p.n_required} crashed ops exceed the "
+                         f"crashed-set width {CRASH_MAX}"}
     fn = _jit_single(_kernel_key(kernel), capacity, window)
-    sm = _suffix_min_inv(p.inv, p.n)
-    done, clean, best, levels = fn(
-        jnp.asarray(p.f), jnp.asarray(p.v1), jnp.asarray(p.v2),
-        jnp.asarray(p.inv), jnp.asarray(p.ret), jnp.asarray(sm),
-        jnp.int32(p.n_required), jnp.int32(p.init_state))
+    done, clean, best, levels = fn(*(cols[c] for c in _COLS))
     return _result(bool(done), bool(clean), int(best), int(levels), p)
 
 
@@ -283,10 +369,6 @@ def check_history_tpu(history: History, model: Model,
     if pk is None:
         return None
     packed, kernel = pk
-    if packed.max_concurrency() > window:
-        return {"valid": UNKNOWN, "backend": "tpu",
-                "error": f"concurrency {packed.max_concurrency()} exceeds "
-                         f"window {window}"}
     return check_packed_tpu(packed, kernel, capacity, window)
 
 
@@ -310,43 +392,57 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
     keys = list(keyed.keys())
     if not keys:
         return {"valid": True, "results": {}, "backend": "tpu"}
-    packed, batch = pack_keyed_histories(keyed, kernel, model=model)
-    K = len(keys)
-    n = int(batch["f"].shape[1])
-    if n == 0:
-        return {"valid": True,
-                "results": {k: {"valid": True} for k in keys},
-                "backend": "tpu"}
-    b = _bucket(n)
-    if b > n:  # bucket column length so compilations are shared
-        pad_spec = {"f": 0, "v1": -1, "v2": -1,
-                    "inv": int(RET_INF), "ret": int(RET_INF)}
-        for name, fill in pad_spec.items():
-            batch[name] = np.pad(batch[name], ((0, 0), (0, b - n)),
-                                 constant_values=fill)
-        n = b
-    sm = np.stack([_suffix_min_inv(batch["inv"][i], n) for i in range(K)])
+    results: Dict[Any, Dict[str, Any]] = {}
+    packed: Dict[Any, PackedHistory] = {}
+    for k in keys:
+        try:
+            packed[k] = pack_with_init(keyed[k], model, kernel)[0]
+        except ValueError as e:
+            # One key with an op the integer kernel can't encode must not
+            # abort the batch; the caller can fall back per key.
+            results[k] = {"valid": UNKNOWN, "backend": "tpu",
+                          "error": str(e)}
 
-    arrays = [batch["f"], batch["v1"], batch["v2"], batch["inv"],
-              batch["ret"], sm, batch["n_required"], batch["init_state"]]
+    # Common padded widths across the batch, so one compilation serves all.
+    # A key with more crashed ops than the bitmask holds goes UNKNOWN alone
+    # (per-key split failure), not the whole batch.
+    breq = _bucket(max((p.n_required for p in packed.values()),
+                       default=1) or 1)
+    crash_counts = [p.n - p.n_required for p in packed.values()]
+    cr = _crash_width(min(max(crash_counts, default=0), CRASH_MAX))
 
-    if mesh is not None:
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        # Pad K up to the mesh axis size so the batch divides evenly.
-        per = mesh.shape[axis]
-        pad = (-K) % per
-        if pad:
-            arrays = [np.concatenate([a, np.repeat(a[-1:], pad, axis=0)])
-                      for a in arrays]
-        sh_row = NamedSharding(mesh, P(axis))
-        arrays = [jax.device_put(np.asarray(a), sh_row) for a in arrays]
+    rows = []      # (key, cols) for keys that go to the device
+    for key, p in packed.items():
+        if p.n_required == 0:
+            results[key] = {"valid": True, "levels": 0, "backend": "tpu"}
+            continue
+        cols = None if cr is None else _split_packed(p, breq, cr)
+        if cols is None:
+            results[key] = {
+                "valid": UNKNOWN, "backend": "tpu",
+                "error": f"{p.n - p.n_required} crashed ops exceed the "
+                         f"crashed-set width {CRASH_MAX}"}
+            continue
+        rows.append((key, cols))
 
-    fn = _jit_batch(_kernel_key(kernel), capacity, window)
-    done, clean, best, levels = (np.asarray(x) for x in fn(*arrays))
-    results = {}
-    for i, key in enumerate(keys):
-        results[key] = _result(bool(done[i]), bool(clean[i]),
-                               int(best[i]), int(levels[i]), packed[i])
+    if rows:
+        arrays = [np.stack([cols[c] for _, cols in rows]) for c in _COLS]
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            # Pad the key batch up to the mesh axis size so it divides.
+            per = mesh.shape[axis]
+            pad = (-len(rows)) % per
+            if pad:
+                arrays = [np.concatenate(
+                    [a, np.repeat(a[-1:], pad, axis=0)]) for a in arrays]
+            sh_row = NamedSharding(mesh, P(axis))
+            arrays = [jax.device_put(a, sh_row) for a in arrays]
+        fn = _jit_batch(_kernel_key(kernel), capacity, window)
+        done, clean, best, levels = (np.asarray(x) for x in fn(*arrays))
+        for r, (key, _) in enumerate(rows):
+            results[key] = _result(bool(done[r]), bool(clean[r]),
+                                   int(best[r]), int(levels[r]),
+                                   packed[key])
     valid = True
     for r in results.values():
         if r["valid"] is False:

@@ -232,8 +232,7 @@ def pack_with_init(history: Sequence[Op], model,
 
 
 def pack_keyed_histories(keyed: Dict[Any, Sequence[Op]],
-                         kernel: KernelSpec,
-                         model=None) -> Tuple[list, dict]:
+                         kernel: KernelSpec) -> Tuple[list, dict]:
     """Pack a {key: history} map (the independent-key axis, reference
     independent.clj:65-219) into a list of equal-length PackedHistories plus
     batched arrays ready for vmap/sharding.
@@ -243,10 +242,7 @@ def pack_keyed_histories(keyed: Dict[Any, Sequence[Op]],
     init_state: int32[K].
     """
     keys = list(keyed.keys())
-    if model is not None:
-        packed = [pack_with_init(keyed[k], model, kernel)[0] for k in keys]
-    else:
-        packed = [pack_history(keyed[k], kernel) for k in keys]
+    packed = [pack_history(keyed[k], kernel) for k in keys]
     n_max = max((p.n for p in packed), default=0)
     padded = [p.pad_to(n_max) for p in packed]
     batch = {

@@ -123,6 +123,81 @@ class FlakyClient(AtomClient):
         return super().invoke(test, op)
 
 
+def simulate_register_history(n_ops: int, n_procs: int = 5, n_vals: int = 8,
+                              seed: int = 0, cas_p: float = 0.2,
+                              crash_p: float = 0.0):
+    """Synthesize a concurrent CAS-register history that is linearizable by
+    construction: ops take effect at a random *commit* instant between their
+    invocation and completion events (the linearization point), against one
+    true register. Used by bench.py (the north-star workload shape: etcd-style
+    CAS register, reference etcd.clj:149-188) and by checker stress tests.
+
+    n_ops counts operations (invoke/complete pairs); the returned History has
+    ~2*n_ops event rows.
+    """
+    import random
+
+    from jepsen_tpu.history import History
+
+    rng = random.Random(seed)
+    h = History()
+    value = None
+    free = list(range(n_procs))
+    in_flight = []  # [process, op, committed?]
+    invoked = 0
+    t = 0
+    while invoked < n_ops or in_flight:
+        can_invoke = free and invoked < n_ops
+        # Bias toward keeping several ops in flight so the history has real
+        # concurrency (overlapping intervals) for the checker to resolve.
+        if can_invoke and (not in_flight or rng.random() < 0.6):
+            p = free.pop(rng.randrange(len(free)))
+            r = rng.random()
+            if r < cas_p:
+                f, v = "cas", (rng.randrange(n_vals), rng.randrange(n_vals))
+            elif r < cas_p + (1 - cas_p) / 2:
+                f, v = "write", rng.randrange(n_vals)
+            else:
+                f, v = "read", None
+            h.append(Op(type="invoke", f=f, value=v, process=p, time=t))
+            in_flight.append([p, h[-1], False])
+            invoked += 1
+        else:
+            entry = rng.choice(in_flight)
+            p, inv_op, committed = entry
+            if not committed:
+                # commit now: apply the effect at this instant
+                if inv_op.f == "write":
+                    value = inv_op.value
+                    entry[2] = ("ok", inv_op.value)
+                elif inv_op.f == "cas":
+                    old, new = inv_op.value
+                    if value == old:
+                        value = new
+                        entry[2] = ("ok", inv_op.value)
+                    else:
+                        entry[2] = ("fail", inv_op.value)
+                else:
+                    entry[2] = ("ok", value)
+                # complete immediately half the time, else stay in flight
+                if rng.random() >= 0.5:
+                    continue
+            typ, val = entry[2]
+            in_flight.remove(entry)
+            if crash_p and rng.random() < crash_p:
+                h.append(Op(type="info", f=inv_op.f, value=inv_op.value,
+                            process=p, time=t))
+                # jepsen's reincarnation rule (core.clj:175,211): the crashed
+                # logical process is replaced by p + concurrency
+                free.append(p + n_procs)
+            else:
+                h.append(Op(type=typ, f=inv_op.f, value=val, process=p,
+                            time=t))
+                free.append(p)
+        t += 1
+    return h
+
+
 def atom_test(register: Optional[SharedRegister] = None, **overrides) -> dict:
     """A runnable in-memory CAS-register test (core_test.clj basic-cas-test
     shape)."""

@@ -156,6 +156,17 @@ class TestKeyedBatch:
             got = out["results"][k]["valid"]
             assert got is want or got is UNKNOWN, (k, want, got)
 
+    def test_keyed_unsupported_op_isolated(self):
+        # regression: one key with an un-encodable op must not abort the
+        # batch — that key alone goes unknown
+        good = H((0, "invoke", "write", 1), (0, "ok", "write", 1))
+        bad = H((0, "invoke", "frobnicate", None),
+                (0, "ok", "frobnicate", None))
+        out = check_keyed_tpu({"g": good, "b": bad}, CASRegister())
+        assert out["results"]["g"]["valid"] is True
+        assert out["results"]["b"]["valid"] is UNKNOWN
+        assert out["valid"] is UNKNOWN
+
     def test_keyed_unpadded_key_count(self):
         # key count not divisible by mesh size exercises the padding path
         devs = jax.devices()
