@@ -1,0 +1,300 @@
+"""Logical data-parallelism for expensive checkers.
+
+Rebuild of jepsen.independent (jepsen/src/jepsen/independent.clj):
+linearizability checking is exponential in history length, so instead of one
+long history over one register we run a *map* of keys to registers —
+generators wrap values in ``[k v]`` tuples, the checker partitions the
+history per key and checks each subhistory independently.
+
+This axis is also the framework's device-sharding axis: when the lifted
+inner checker is a linearizability check over an integer-kernel model, the
+per-key fan-out runs as ONE batched, vmapped, optionally mesh-sharded tensor
+program on TPU (jepsen_tpu.checker.tpu.check_keyed_tpu) instead of a pool of
+host threads.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker import Checker, UNKNOWN, check_safe, merge_valid
+from jepsen_tpu.history import History, Op
+from jepsen_tpu.util import real_pmap
+
+#: Subdirectory of the store dir for per-key results (independent.clj:16-18).
+DIR = "independent"
+
+
+class KV:
+    """A key/value tuple as produced by independent generators
+    (independent.clj:20-28, clojure.lang.MapEntry). A dedicated type — NOT a
+    Python tuple — so op values that are themselves tuples (e.g. cas pairs)
+    can't be mistaken for keyed values."""
+
+    __slots__ = ("key", "value")
+
+    def __init__(self, key, value):
+        self.key = key
+        self.value = value
+
+    def __iter__(self):
+        return iter((self.key, self.value))
+
+    def __eq__(self, other):
+        return (isinstance(other, KV) and self.key == other.key
+                and self.value == other.value)
+
+    def __hash__(self):
+        return hash((KV, self.key, self.value))
+
+    def __repr__(self):
+        return f"[{self.key!r} {self.value!r}]"
+
+
+def tuple_(k, v) -> KV:
+    return KV(k, v)
+
+
+def is_tuple(v) -> bool:
+    return isinstance(v, KV)
+
+
+class SequentialGenerator(gen.Generator):
+    """One key at a time: yields ops from fgen(k1) until exhausted, then
+    moves to k2, wrapping values in [k v] (independent.clj:30-63)."""
+
+    def __init__(self, keys: Iterable, fgen: Callable[[Any], Any]):
+        self.fgen = fgen
+        self._lock = threading.Lock()
+        self._keys = iter(keys)
+        self._gen: Optional[gen.Generator] = None
+        self._done = False
+        self._advance()
+
+    def _advance(self) -> bool:
+        try:
+            k = next(self._keys)
+        except StopIteration:
+            self._gen = None
+            self._done = True
+            return False
+        self._key = k
+        self._gen = gen.gen(self.fgen(k))
+        return True
+
+    def op(self, test, process):
+        while True:
+            with self._lock:
+                if self._done:
+                    return None
+                g, k = self._gen, self._key
+            o = g.op(test, process)
+            if o is not None:
+                return o.replace(value=KV(k, o.value))
+            with self._lock:
+                if self._gen is g:  # lost race: someone already advanced
+                    if not self._advance():
+                        return None
+
+
+class ConcurrentGenerator(gen.Generator):
+    """n threads per key, (thread_count // n) keys in flight at once
+    (independent.clj:65-219). Worker threads are split into contiguous
+    groups of n; each group runs one key's generator with the thread scope
+    rebound to the group (so barrier-style combinators synchronize within a
+    key, not across keys). When a group's generator is exhausted it takes
+    the next key; out of keys, that group's workers retire. The nemesis
+    never draws from sub-generators."""
+
+    def __init__(self, n: int, keys: Iterable, fgen: Callable[[Any], Any]):
+        assert n > 0 and int(n) == n
+        self.n = int(n)
+        self.fgen = fgen
+        self._keys = iter(keys)
+        self._lock = threading.Lock()
+        self._state: Optional[dict] = None
+
+    def _init_state(self, test):
+        threads = sorted(t for t in (gen.current_threads()
+                                     or gen.all_threads(test))
+                         if isinstance(t, int))
+        thread_count = len(threads)
+        assert threads == list(range(thread_count)), (
+            f"expected integer threads 0..{thread_count - 1}, got {threads}")
+        assert test.get("concurrency") == thread_count, (
+            f"expected test concurrency ({test.get('concurrency')}) to equal "
+            f"the number of integer threads ({thread_count})")
+        group_size = self.n
+        group_count = thread_count // group_size
+        assert group_size <= thread_count, (
+            f"with {thread_count} worker threads, this concurrent-generator "
+            f"cannot run a key with {group_size} threads; raise concurrency "
+            f"to at least {group_size}")
+        assert thread_count == group_size * group_count, (
+            f"{thread_count} threads cannot be split into groups of "
+            f"{group_size}; make concurrency a multiple of {group_size}")
+        active = []
+        for _ in range(group_count):
+            try:
+                k = next(self._keys)
+                active.append((k, gen.gen(self.fgen(k))))
+            except StopIteration:
+                active.append(None)
+        self._state = {
+            "active": active,
+            "group_threads": [frozenset(threads[g * group_size:
+                                                (g + 1) * group_size])
+                              for g in range(group_count)],
+            "group_size": group_size,
+        }
+
+    def op(self, test, process):
+        with self._lock:
+            if self._state is None:
+                self._init_state(test)
+            s = self._state
+        thread = gen.process_to_thread(process, test)
+        assert isinstance(thread, int), (
+            f"only worker threads with numeric ids can draw from a "
+            f"concurrent-generator; got a request from {thread!r}")
+        group = thread // s["group_size"]
+        while True:
+            with self._lock:
+                pair = s["active"][group]
+            if pair is None:
+                return None  # out of keys: this group's workers retire
+            k, g = pair
+            with gen.threads_bound(s["group_threads"][group]):
+                o = g.op(test, process)
+            if o is not None:
+                return o.replace(value=KV(k, o.value))
+            with self._lock:
+                if s["active"][group] is pair:  # we advance, others recur
+                    try:
+                        nk = next(self._keys)
+                        s["active"][group] = (nk, gen.gen(self.fgen(nk)))
+                    except StopIteration:
+                        s["active"][group] = None
+
+
+def sequential_generator(keys, fgen) -> SequentialGenerator:
+    return SequentialGenerator(keys, fgen)
+
+
+def concurrent_generator(n, keys, fgen) -> ConcurrentGenerator:
+    return ConcurrentGenerator(n, keys, fgen)
+
+
+def history_keys(history: Sequence[Op]) -> set:
+    """The set of keys appearing in [k v] op values
+    (independent.clj:222-231)."""
+    return {o.value.key for o in history if is_tuple(o.value)}
+
+
+def subhistory(k, history: Sequence[Op]) -> History:
+    """All ops without a *differing* key, tuples unwrapped
+    (independent.clj:233-244): un-keyed ops (nemesis, logging) appear in
+    every subhistory."""
+    out = History()
+    for o in history:
+        v = o.value
+        if not is_tuple(v):
+            out.append(o)
+        elif v.key == k:
+            out.append(o.replace(value=v.value))
+    return out
+
+
+class IndependentChecker(Checker):
+    """Lift a checker over plain values to one over [k v] histories
+    (independent.clj:246-296): valid iff the inner checker holds for every
+    key's subhistory; per-key results under 'results', invalid keys under
+    'failures'.
+
+    When the inner checker is a LinearizableChecker with backend='tpu' and
+    an integer-kernel model, all keys are checked as one batched device
+    program; keys the device search can't settle (capacity/window/crash
+    overflow) fall back to the exact per-key CPU search.
+    """
+
+    def __init__(self, inner: Checker):
+        self.inner = inner
+
+    # -- device fast path ---------------------------------------------------
+
+    def _try_tpu_batch(self, test, keyed: Dict[Any, History], opts):
+        from jepsen_tpu.checker.wgl import LinearizableChecker
+        if not isinstance(self.inner, LinearizableChecker):
+            return None
+        if self.inner.backend != "tpu":
+            return None
+        model = self.inner.model or test.get("model")
+        if model is None:
+            return None
+        try:
+            from jepsen_tpu.checker.tpu import check_keyed_tpu
+            from jepsen_tpu.models.core import kernel_spec_for
+            if kernel_spec_for(model) is None:
+                return None
+            return check_keyed_tpu(keyed, model,
+                                   mesh=opts.get("mesh") if opts else None)
+        except ImportError:
+            return None
+
+    def check(self, test, history, opts=None):
+        opts = opts or {}
+        ks = sorted(history_keys(history), key=repr)
+        keyed = {k: subhistory(k, history) for k in ks}
+
+        results: Dict[Any, dict] = {}
+        batch = self._try_tpu_batch(test, keyed, opts)
+        if batch is not None:
+            for k, r in batch["results"].items():
+                if r.get("valid") is UNKNOWN:
+                    # exact CPU fallback for keys the device couldn't settle
+                    r = check_safe(self.inner, test, keyed[k],
+                                   {**opts, "history-key": k})
+                results[k] = r
+        else:
+            def check_one(k):
+                return check_safe(self.inner, test, keyed[k],
+                                  {**opts, "history-key": k})
+            for k, r in zip(ks, real_pmap(check_one, ks)):
+                results[k] = r
+
+        self._write_artifacts(test, keyed, results, opts)
+        failures = [k for k, r in results.items()
+                    if r.get("valid") is not True]
+        return {
+            "valid": merge_valid(r.get("valid", UNKNOWN)
+                                 for r in results.values()),
+            "results": results,
+            "failures": failures,
+        }
+
+    def _write_artifacts(self, test, keyed, results, opts):
+        """Per-key results.json + history.jsonl under
+        store/<...>/independent/<k>/ (independent.clj:274-283)."""
+        store_dir = test.get("store-dir")
+        if not store_dir or not isinstance(store_dir, str):
+            return
+        sub = opts.get("subdirectory", []) if opts else []
+        for k, r in results.items():
+            d = os.path.join(store_dir, *map(str, sub), DIR, str(k))
+            try:
+                os.makedirs(d, exist_ok=True)
+                with open(os.path.join(d, "results.json"), "w") as f:
+                    json.dump(r, f, indent=2, default=repr)
+                with open(os.path.join(d, "history.jsonl"), "w") as f:
+                    for o in keyed[k]:
+                        f.write(json.dumps(o.to_dict(), default=repr) + "\n")
+            except OSError:
+                pass
+
+
+def checker(inner: Checker) -> IndependentChecker:
+    return IndependentChecker(inner)

@@ -1,0 +1,184 @@
+"""Independent (keyed) generators and lifted checker — mirrors reference
+independent_test.clj plus the TPU batched fan-out."""
+
+import pytest
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu import independent as ind
+from jepsen_tpu.checker import Checker
+from jepsen_tpu.history import History, Op
+
+from test_generator import pump, ops_of
+
+
+def vals(result):
+    return [o.value for ops in result.values() for o in ops]
+
+
+def seq_of_values(values):
+    return gen.seq([gen.once({"f": "x", "value": v}) for v in values])
+
+
+class TestKV:
+    def test_kv_is_not_a_tuple(self):
+        kv = ind.tuple_("k", (0, 1))
+        assert ind.is_tuple(kv)
+        assert not ind.is_tuple((0, 1))
+        k, v = kv
+        assert k == "k" and v == (0, 1)
+
+    def test_equality(self):
+        assert ind.KV(1, 2) == ind.KV(1, 2)
+        assert ind.KV(1, 2) != ind.KV(1, 3)
+        assert hash(ind.KV(1, 2)) == hash(ind.KV(1, 2))
+
+
+class TestSequentialGenerator:
+    def test_empty_keys(self):
+        out = pump(ind.sequential_generator([], lambda k: {"f": "x"}),
+                   concurrency=2)
+        assert ops_of(out) == []
+
+    def test_one_key(self):
+        g = ind.sequential_generator(
+            ["k1"], lambda k: seq_of_values(["ashley", "katchadourian"]))
+        out = vals(pump(g, concurrency=1))
+        assert out == [ind.KV("k1", "ashley"), ind.KV("k1", "katchadourian")]
+
+    def test_n_keys_in_order(self):
+        g = ind.sequential_generator(
+            [1, 2, 3], lambda k: seq_of_values(list(range(k))))
+        out = vals(pump(g, concurrency=1))
+        assert out == [ind.KV(1, 0),
+                       ind.KV(2, 0), ind.KV(2, 1),
+                       ind.KV(3, 0), ind.KV(3, 1), ind.KV(3, 2)]
+
+    def test_concurrency_stress(self):
+        # reference: 1000 keys x 10 values pulled by 10 threads; every kv
+        # appears exactly once
+        kmax, vmax = 1000, 10
+        g = ind.sequential_generator(
+            range(kmax), lambda k: seq_of_values(list(range(vmax))))
+        out = vals(pump(g, concurrency=10, max_ops=100_000))
+        assert len(out) == kmax * vmax
+        assert {(kv.key, kv.value) for kv in out} == {
+            (k, v) for k in range(kmax) for v in range(vmax)}
+
+
+class TestConcurrentGenerator:
+    def test_empty_keys(self):
+        out = pump(ind.concurrent_generator(1, [], lambda k: {"f": "x"}),
+                   concurrency=10)
+        assert ops_of(out) == []
+
+    def test_too_few_threads(self):
+        test = {"concurrency": 10, "nodes": ["n1"]}
+        g = ind.concurrent_generator(12, [1], lambda k: {"f": "x"})
+        with gen.threads_bound(frozenset(range(10))):
+            with pytest.raises(AssertionError, match="raise concurrency"):
+                g.op(test, 0)
+
+    def test_uneven_threads(self):
+        test = {"concurrency": 11, "nodes": ["n1"]}
+        g = ind.concurrent_generator(2, [1], lambda k: {"f": "x"})
+        with gen.threads_bound(frozenset(range(11))):
+            with pytest.raises(AssertionError, match="multiple of 2"):
+                g.op(test, 0)
+
+    def test_fully_concurrent(self):
+        # reference: 10 keys x 5 values, 5 threads/key, 100 worker threads
+        kmax, vmax, n, threads = 10, 5, 5, 100
+        g = ind.concurrent_generator(
+            n, range(kmax), lambda k: seq_of_values(list(range(vmax))))
+        out = vals(pump(g, concurrency=threads, max_ops=100_000))
+        assert {(kv.key, kv.value) for kv in out} == {
+            (k, v) for k in range(kmax) for v in range(vmax)}
+
+    def test_group_thread_scoping(self):
+        # each key's ops must come only from its group's threads
+        seen = {}
+        g = ind.concurrent_generator(
+            2, range(3), lambda k: seq_of_values(list(range(20))))
+        out = pump(g, concurrency=6, max_ops=100_000)
+        for thread, ops in out.items():
+            for o in ops:
+                seen.setdefault(o.value.key, set()).add(thread)
+        for k, ts in seen.items():
+            groups = {t // 2 for t in ts}
+            assert len(groups) == 1, (k, ts)
+
+
+class TestSubhistory:
+    def H(self):
+        return History.of([
+            Op(type="invoke", f="w", value=ind.KV("a", 1), process=0, time=0),
+            Op(type="info", f="kill", value=None, process="nemesis", time=1),
+            Op(type="ok", f="w", value=ind.KV("a", 1), process=0, time=2),
+            Op(type="invoke", f="w", value=ind.KV("b", 2), process=1, time=3),
+            Op(type="ok", f="w", value=ind.KV("b", 2), process=1, time=4),
+        ])
+
+    def test_history_keys(self):
+        assert ind.history_keys(self.H()) == {"a", "b"}
+
+    def test_subhistory_unwraps_and_keeps_unkeyed(self):
+        sub = ind.subhistory("a", self.H())
+        assert [o.value for o in sub] == [1, None, 1]
+        assert sub[1].process == "nemesis"
+
+
+class _EvenChecker(Checker):
+    def check(self, test, history, opts=None):
+        return {"valid": len(history) % 2 == 0}
+
+
+class TestLiftedChecker:
+    def test_reference_even_checker_case(self):
+        # independent_test.clj checker-test: keys 1,2,3 with k ops each plus
+        # one unsharded op present in every subhistory
+        rows = [Op(type="invoke", f="x", value="not-sharded",
+                   process=0, time=0)]
+        for k in (0, 1, 2, 3):
+            for v in range(k):
+                rows.append(Op(type="invoke", f="x", value=ind.KV(k, v),
+                               process=0, time=len(rows)))
+        history = History.of(rows)
+        out = ind.checker(_EvenChecker()).check(
+            {"name": "independent-checker-test"}, history)
+        assert out["valid"] is False
+        assert out["results"][1]["valid"] is True
+        assert out["results"][2]["valid"] is False
+        assert out["results"][3]["valid"] is True
+        assert out["failures"] == [2]
+
+    def test_tpu_batched_linearizable(self, tmp_path):
+        import random
+
+        from jepsen_tpu.checker.wgl import linearizable
+        from jepsen_tpu.models import CASRegister
+        from test_linearizable import random_register_history
+        from jepsen_tpu.checker.wgl import check_model
+
+        rng = random.Random(3)
+        rows = []
+        keyed = {}
+        t = 0
+        for k in range(4):
+            h = random_register_history(rng, n_procs=3, n_ops=8)
+            keyed[k] = h
+            for o in h:
+                rows.append(o.replace(value=ind.KV(k, o.value), time=t))
+                t += 1
+        history = History.of(rows)
+        # NOTE: interleaving keys' events sequentially preserves per-key
+        # real-time order, so per-key validity matches the original history
+        lifted = ind.checker(linearizable(CASRegister(), backend="tpu"))
+        test = {"model": CASRegister(), "store-dir": str(tmp_path)}
+        out = lifted.check(test, history)
+        for k, h in keyed.items():
+            want = check_model(h, CASRegister())["valid"]
+            assert out["results"][k]["valid"] is want, (k, want)
+        # artifacts written per key
+        for k in keyed:
+            assert (tmp_path / "independent" / str(k)
+                    / "results.json").exists()
