@@ -1,0 +1,232 @@
+"""Nemesis tests: pure grudge math with no network (reference
+nemesis_test.clj) plus dummy-control-plane partition/compose behavior."""
+
+import pytest
+
+from jepsen_tpu import control, net, nemesis
+from jepsen_tpu.history import Op
+
+
+def nop(f, value=None):
+    return Op(type="invoke", f=f, value=value, process="nemesis", time=0)
+
+
+class TestBisect:
+    def test_cases(self):
+        assert nemesis.bisect([]) == [[], []]
+        assert nemesis.bisect([1]) == [[], [1]]
+        assert nemesis.bisect([1, 2, 3, 4]) == [[1, 2], [3, 4]]
+        assert nemesis.bisect([1, 2, 3, 4, 5]) == [[1, 2], [3, 4, 5]]
+
+
+class TestSplitOne:
+    def test_loner(self):
+        assert nemesis.split_one([1, 2, 3], loner=2) == [[2], [1, 3]]
+
+    def test_random_loner(self):
+        parts = nemesis.split_one([1, 2, 3])
+        assert len(parts[0]) == 1 and len(parts[1]) == 2
+        assert set(parts[0]) | set(parts[1]) == {1, 2, 3}
+
+
+class TestCompleteGrudge:
+    def test_bisected(self):
+        assert nemesis.complete_grudge(nemesis.bisect([1, 2, 3, 4, 5])) == {
+            1: {3, 4, 5},
+            2: {3, 4, 5},
+            3: {1, 2},
+            4: {1, 2},
+            5: {1, 2},
+        }
+
+    def test_empty(self):
+        assert nemesis.complete_grudge([]) == {}
+
+
+class TestBridge:
+    def test_five(self):
+        assert nemesis.bridge([1, 2, 3, 4, 5]) == {
+            1: {4, 5},
+            2: {4, 5},
+            4: {1, 2},
+            5: {1, 2},
+        }
+
+
+class TestMajoritiesRing:
+    def test_properties(self):
+        nodes = list(range(5))
+        grudge = nemesis.majorities_ring(nodes)
+        assert len(grudge) == 5
+        assert set(grudge) == set(nodes)
+        for node, snubbed in grudge.items():
+            assert len(snubbed) == 2
+            assert node not in snubbed
+        assert len({frozenset(v) for v in grudge.values()}) == 5
+
+    def test_five_node_ring_walk(self):
+        # degenerate 5-node case: each node sees its two ring neighbors
+        # symmetrically; the visibility graph is a single ring traversable
+        # out and back (reference nemesis_test.clj:50-87)
+        nodes = list(range(5))
+        grudge = nemesis.majorities_ring(nodes)
+        U = set(grudge)
+        start = next(iter(grudge))
+        frm, node, returning, path = None, start, False, []
+        for _ in range(2 * len(U) + 2):
+            vis = U - grudge[node]
+            assert len(vis) == 3
+            assert node in vis
+            if frm is not None and node == start:
+                if returning:
+                    path.append(node)
+                    break
+                frm, node, returning = node, frm, True
+                path.append(node)
+            else:
+                nxt = next(iter(vis - {node, frm}))
+                frm, node = node, nxt
+                path.append(frm)
+        assert set(path) == U
+        assert path == path[::-1]
+        assert len(path) == 2 * len(U) + 1
+
+    def test_larger_rings(self):
+        for n in (7, 9, 11):
+            nodes = list(range(n))
+            grudge = nemesis.majorities_ring(nodes)
+            from jepsen_tpu.util import majority
+            m = majority(n)
+            assert len(grudge) == n
+            for node, snubbed in grudge.items():
+                assert len(snubbed) == n - m
+                assert node not in snubbed
+
+
+def dummy_test(**over):
+    test = {
+        "nodes": ["n1", "n2", "n3", "n4", "n5"],
+        "concurrency": 5,
+        "ssh": {"mode": "dummy"},
+        "net": net.iptables(),
+    }
+    test.update(over)
+    return test
+
+
+def logs(test):
+    return {node: list(s.log)
+            for node, s in test.get("_sessions", {}).items()}
+
+
+class TestPartitioner:
+    def test_start_cuts_stop_heals(self):
+        test = dummy_test()
+        with control.session_pool(test):
+            n = nemesis.partition_halves().setup(test)
+            out = n.invoke(test, nop("start"))
+            assert out.value.startswith("Cut off")
+            cmds = logs(test)
+            # n1, n2 drop from {n3,n4,n5}; n3..n5 drop from {n1,n2}
+            assert sum("iptables -A INPUT" in c
+                       for c in cmds["n1"]) == 3
+            assert sum("iptables -A INPUT" in c
+                       for c in cmds["n3"]) == 2
+            out = n.invoke(test, nop("stop"))
+            assert out.value == "fully connected"
+            assert any("iptables -F" in c for c in logs(test)["n1"])
+
+    def test_unknown_f_raises(self):
+        test = dummy_test()
+        with control.session_pool(test):
+            n = nemesis.partition_halves().setup(test)
+            with pytest.raises(ValueError):
+                n.invoke(test, nop("zap"))
+
+
+class Recorder(nemesis.Nemesis):
+    def __init__(self, name="rec"):
+        self.name = name
+        self.calls = []
+
+    def invoke(self, t, op):
+        self.calls.append(op.f)
+        return op
+
+
+class TestCompose:
+    def test_routes_by_set(self):
+        part, killer = Recorder("part"), Recorder("kill")
+        n = nemesis.compose({
+            frozenset({"start", "stop"}): part,
+            frozenset({"kill"}): killer,
+        }).setup({})
+        out = n.invoke({}, nop("start"))
+        assert out.f == "start"
+        n.invoke({}, nop("kill"))
+        assert part.calls == ["start"] and killer.calls == ["kill"]
+
+    def test_dict_spec_renames_f(self):
+        # two partitioners both speaking start/stop, disambiguated by
+        # renaming dict specs (nemesis.clj compose docstring); dicts are
+        # unhashable keys, so compose also takes (spec, nemesis) pairs
+        a, b = Recorder("a"), Recorder("b")
+        n = nemesis.compose([
+            ({"split-start": "start", "split-stop": "stop"}, a),
+            ({"ring-start": "start", "ring-stop": "stop"}, b),
+        ]).setup({})
+        out = n.invoke({}, nop("ring-start"))
+        assert out.f == "ring-start"   # outer f restored
+        assert a.calls == [] and b.calls == ["start"]  # inner f renamed
+
+    def test_callable_spec(self):
+        r = Recorder()
+        n = nemesis.compose([
+            (lambda f: f.removeprefix("x-") if f.startswith("x-") else None,
+             r),
+        ])
+        n.invoke({}, nop("x-go"))
+        assert r.calls == ["go"]
+
+    def test_no_route_raises(self):
+        n = nemesis.compose({frozenset({"start"}): nemesis.noop()})
+        with pytest.raises(ValueError):
+            n.invoke({}, nop("bogus"))
+
+
+class TestNodeStartStopper:
+    def test_start_stop_cycle(self):
+        test = dummy_test()
+        with control.session_pool(test):
+            n = nemesis.hammer_time("java", targeter=lambda ns: ns[0])
+            out = n.invoke(test, nop("start"))
+            assert out.type == "info"
+            assert out.value == {"n1": ["paused", "java"]}
+            assert any("killall -s STOP java" in c
+                       for c in logs(test)["n1"])
+            # double start refuses
+            out2 = n.invoke(test, nop("start"))
+            assert "already disrupting" in str(out2.value)
+            out3 = n.invoke(test, nop("stop"))
+            assert out3.value == {"n1": ["resumed", "java"]}
+            out4 = n.invoke(test, nop("stop"))
+            assert out4.value == "not-started"
+
+    def test_no_target_skips(self):
+        test = dummy_test()
+        with control.session_pool(test):
+            n = nemesis.node_start_stopper(
+                lambda ns: None, lambda t, nd: "x", lambda t, nd: "y")
+            out = n.invoke(test, nop("start"))
+            assert out.value == "no-target"
+
+
+class TestTruncateFile:
+    def test_truncate_plan(self):
+        test = dummy_test()
+        with control.session_pool(test):
+            n = nemesis.truncate_file()
+            plan = {"n2": {"file": "/var/lib/db/wal", "drop": 64}}
+            n.invoke(test, nop("truncate", value=plan))
+            assert any("truncate -c -s -64 /var/lib/db/wal" in c
+                       for c in logs(test)["n2"])
