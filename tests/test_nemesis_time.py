@@ -1,0 +1,159 @@
+"""Clock-fault toolkit tests: native helpers compile and behave at the CLI
+boundary; the nemesis drives the right remote commands (dummy control)."""
+
+import os
+import subprocess
+
+import pytest
+
+from jepsen_tpu import control
+from jepsen_tpu.nemesis import time as nt
+from jepsen_tpu.history import Op
+
+from test_nemesis import dummy_test, logs, nop
+
+
+@pytest.fixture(scope="module")
+def built(tmp_path_factory):
+    """Compile both helpers locally with g++ (same compiler the control
+    plane invokes on nodes)."""
+    d = tmp_path_factory.mktemp("clock-helpers")
+    bins = {}
+    for bin_name, src in nt.HELPERS.items():
+        out = str(d / bin_name)
+        subprocess.run(
+            ["g++", "-O2", "-o", out, os.path.join(nt.RESOURCE_DIR, src)],
+            check=True, capture_output=True)
+        bins[bin_name] = out
+    return bins
+
+
+class TestNativeHelpers:
+    def test_bump_usage_exit_1(self, built):
+        p = subprocess.run([built["bump-time"]], capture_output=True)
+        assert p.returncode == 1
+        assert b"usage" in p.stderr
+
+    def test_strobe_usage_exit_1(self, built):
+        p = subprocess.run([built["strobe-time"]], capture_output=True)
+        assert p.returncode == 1
+        assert b"delta" in p.stderr.lower()
+
+    def test_bump_without_root_fails_cleanly(self, built):
+        # bump by 0ms still calls settimeofday; as non-root it must exit 2
+        # (reference exit-code contract), as root it exits 0 having set the
+        # clock to itself.
+        p = subprocess.run([built["bump-time"], "0"], capture_output=True)
+        assert p.returncode in (0, 2)
+
+    def test_strobe_zero_duration_exits_zero(self, built):
+        # duration 0: loop never entered, clock restored once; as non-root
+        # settimeofday fails with exit 2, as root prints 0 adjustments
+        p = subprocess.run([built["strobe-time"], "100", "10", "0"],
+                           capture_output=True)
+        assert p.returncode in (0, 2)
+        if p.returncode == 0:
+            assert p.stdout.strip() == b"0"
+
+
+class TestClockNemesis:
+    def test_setup_installs_and_resets(self):
+        test = dummy_test()
+        with control.session_pool(test):
+            nt.clock_nemesis().setup(test)
+            for node in test["nodes"]:
+                cmds = logs(test)[node]
+                assert any("UPLOAD" in c and "bump-time.cc" in c
+                           for c in cmds)
+                assert any("g++ -O2 -o bump-time" in c for c in cmds)
+                assert any("g++ -O2 -o strobe-time" in c for c in cmds)
+                assert any("ntpdate" in c for c in cmds)
+
+    def test_bump_targets_only_planned_nodes(self):
+        test = dummy_test()
+        with control.session_pool(test):
+            n = nt.clock_nemesis()
+            n.invoke(test, nop("bump", value={"n2": 5000, "n4": -250.5}))
+            cmds = logs(test)
+            assert any("/opt/jepsen/bump-time 5000" in c
+                       for c in cmds["n2"])
+            assert any("bump-time" in c and "250.5" in c
+                       for c in cmds["n4"])
+            assert not any("bump-time" in c for c in cmds["n1"])
+
+    def test_strobe_passes_all_three_args(self):
+        test = dummy_test()
+        with control.session_pool(test):
+            n = nt.clock_nemesis()
+            n.invoke(test, nop("strobe", value={
+                "n3": {"delta": 100, "period": 10, "duration": 2}}))
+            assert any("/opt/jepsen/strobe-time 100 10 2" in c
+                       for c in logs(test)["n3"])
+
+    def test_reset_subset(self):
+        test = dummy_test()
+        with control.session_pool(test):
+            n = nt.clock_nemesis()
+            n.invoke(test, nop("reset", value=["n1", "n5"]))
+            cmds = logs(test)
+            assert any("ntpdate" in c for c in cmds["n1"])
+            assert not any("ntpdate" in c for c in cmds["n2"])
+
+    def test_unknown_f_raises(self):
+        test = dummy_test()
+        with control.session_pool(test):
+            with pytest.raises(ValueError):
+                nt.clock_nemesis().invoke(test, nop("warp"))
+
+
+class TestFaketime:
+    def test_script_shape(self):
+        from jepsen_tpu import faketime
+        s = faketime.script("/usr/bin/db", -30, 5)
+        assert s.startswith("#!/bin/bash")
+        assert 'faketime -m -f "-30s x5.0" /usr/bin/db "$@"' in s
+        s2 = faketime.script("/usr/bin/db", 10, 0.5)
+        assert '"+10s x0.5"' in s2
+
+    def test_wrap_idempotent_under_dummy(self):
+        # dummy sessions answer rc=0 to the existence probe, exercising the
+        # already-wrapped path: wrapper rewritten, no mv
+        from jepsen_tpu import faketime
+        test = dummy_test()
+        with control.session_pool(test):
+            faketime.wrap(test, "n1", "/opt/db/bin", 0, 2)
+            cmds = logs(test)["n1"]
+            assert not any(c.startswith("mv ") for c in cmds)
+            assert any("chmod a+x /opt/db/bin" in c for c in cmds)
+            assert any("faketime" in c and ">" in c for c in cmds)
+
+
+class TestGenerators:
+    def test_reset_gen_shape(self):
+        test = {"nodes": ["a", "b", "c"]}
+        op = nt.reset_gen(test, 0)
+        assert op["f"] == "reset"
+        assert set(op["value"]) <= {"a", "b", "c"}
+        assert len(op["value"]) >= 1
+
+    def test_bump_gen_ranges(self):
+        test = {"nodes": ["a", "b", "c", "d", "e"]}
+        for _ in range(50):
+            op = nt.bump_gen(test, 0)
+            for node, delta in op["value"].items():
+                assert 4 <= abs(delta) <= 2 ** 18
+
+    def test_strobe_gen_ranges(self):
+        test = {"nodes": ["a", "b"]}
+        for _ in range(50):
+            op = nt.strobe_gen(test, 0)
+            for node, spec in op["value"].items():
+                assert 4 <= spec["delta"] <= 2 ** 18
+                assert 1 <= spec["period"] <= 2 ** 10
+                assert 0 <= spec["duration"] <= 32
+
+    def test_clock_gen_mixes(self):
+        g = nt.clock_gen()
+        test = {"nodes": ["a", "b"], "concurrency": 2}
+        fs = {g.op(test, 0).f for _ in range(60)}
+        assert fs == {"reset", "bump", "strobe"}
