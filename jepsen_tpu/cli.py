@@ -1,0 +1,225 @@
+"""Command-line interface.
+
+Rebuild of jepsen.cli (jepsen/src/jepsen/cli.clj): subcommand dispatch with
+the reference's exit-code contract —
+
+    0    all tests passed
+    1    some test failed
+    254  invalid arguments / unknown command
+    255  internal error
+
+— plus the standard test options (repeatable --node, --nodes-file,
+ssh credentials folded into an 'ssh' map, '3n'-style concurrency
+multipliers, --test-count loops, --time-limit) and the serve command for
+the results web UI.
+
+Suites build runners with::
+
+    from jepsen_tpu import cli
+
+    def my_test(opts): return {...test map...}
+
+    if __name__ == "__main__":
+        cli.main(cli.merge_commands(
+            cli.single_test_cmd(my_test), cli.serve_cmd()))
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+import traceback
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+DEFAULT_NODES = ["n1", "n2", "n3", "n4", "n5"]
+
+OK = 0
+TEST_FAILED = 1
+INVALID_ARGS = 254
+CRASHED = 255
+
+
+class _ArgError(Exception):
+    pass
+
+
+class Parser(argparse.ArgumentParser):
+    """argparse parser that raises instead of exiting, so run() owns the
+    exit-code contract (cli.clj:201-276)."""
+
+    def error(self, message):
+        raise _ArgError(message)
+
+
+def add_test_opts(p: argparse.ArgumentParser) -> None:
+    """The standard test option spec (cli.clj:52-87)."""
+    p.add_argument("-n", "--node", action="append", metavar="HOSTNAME",
+                   help="node to run the test on; repeatable "
+                        f"(default: {' '.join(DEFAULT_NODES)})")
+    p.add_argument("--nodes-file", metavar="FILENAME",
+                   help="file of node hostnames, one per line")
+    p.add_argument("--username", default="root", help="ssh username")
+    p.add_argument("--password", default="root", help="sudo password")
+    p.add_argument("--strict-host-key-checking", action="store_true",
+                   help="check ssh host keys")
+    p.add_argument("--ssh-private-key", metavar="FILE",
+                   help="ssh identity file")
+    p.add_argument("--ssh-mode", default=None,
+                   choices=[None, "ssh", "dummy", "local"],
+                   help="control-plane transport (dummy = record only)")
+    p.add_argument("--concurrency", default="1n",
+                   help="worker count; an integer, optionally followed by n "
+                        "to multiply by the node count (e.g. 3n)")
+    p.add_argument("--test-count", type=int, default=1,
+                   help="how many times to repeat the test")
+    p.add_argument("--time-limit", type=int, default=60,
+                   help="test phase duration in seconds")
+    p.add_argument("--backend", default="cpu", choices=["cpu", "tpu"],
+                   help="checker backend (tpu = batched device search)")
+
+
+def parse_concurrency(c: str, n_nodes: int) -> int:
+    """'3n' -> 3 * nodes; plain integer otherwise (cli.clj:123-138)."""
+    m = re.fullmatch(r"(\d+)(n?)", str(c))
+    if not m:
+        raise _ArgError(
+            f"--concurrency {c} should be an integer optionally "
+            f"followed by n")
+    unit = n_nodes if m.group(2) == "n" else 1
+    return int(m.group(1)) * unit
+
+
+def read_nodes_file(path: str) -> List[str]:
+    """Node hostnames, one per line (cli.clj:174-187)."""
+    with open(path) as f:
+        return [line.strip() for line in f if line.strip()]
+
+
+def test_opt_fn(opts: Dict[str, Any]) -> Dict[str, Any]:
+    """Standard option post-processing (cli.clj:189-199): --node/
+    --nodes-file -> 'nodes', ssh options -> 'ssh' map, concurrency
+    parsed."""
+    nodes = list(opts.pop("node", None) or [])
+    nodes_file = opts.pop("nodes_file", None)
+    if nodes_file:
+        nodes.extend(read_nodes_file(nodes_file))
+    if not nodes:
+        nodes = list(DEFAULT_NODES)
+    opts["nodes"] = nodes
+    opts["ssh"] = {
+        "username": opts.pop("username", "root"),
+        "password": opts.pop("password", "root"),
+        "strict-host-key-checking": opts.pop("strict_host_key_checking",
+                                             False),
+        "private-key-path": opts.pop("ssh_private_key", None),
+        "mode": opts.pop("ssh_mode", None),
+    }
+    opts["concurrency"] = parse_concurrency(opts.get("concurrency", "1n"),
+                                            len(nodes))
+    opts["time-limit"] = opts.pop("time_limit", 60)
+    opts["test-count"] = opts.pop("test_count", 1)
+    return opts
+
+
+def single_test_cmd(test_fn: Callable[[dict], dict],
+                    opt_spec: Optional[Callable] = None,
+                    opt_fn: Optional[Callable] = None,
+                    usage: Optional[str] = None) -> dict:
+    """The 'test' subcommand (cli.clj:295-329): builds a test from parsed
+    options via test_fn, runs it --test-count times, fails (exit 1) if any
+    run is invalid."""
+
+    def build_parser():
+        p = Parser(prog="test", description=usage or "Run a test.")
+        add_test_opts(p)
+        if opt_spec:
+            opt_spec(p)
+        return p
+
+    def run(opts) -> int:
+        from jepsen_tpu import core
+        for _ in range(opts.get("test-count", 1)):
+            test = core.run(test_fn(dict(opts)))
+            if test["results"].get("valid") is not True:
+                return TEST_FAILED
+        return OK
+
+    return {"test": {"parser": build_parser,
+                     "opt_fn": (lambda o: opt_fn(test_opt_fn(o)))
+                     if opt_fn else test_opt_fn,
+                     "run": run}}
+
+
+def serve_cmd() -> dict:
+    """The 'serve' subcommand (cli.clj:278-293)."""
+
+    def build_parser():
+        p = Parser(prog="serve", description="Serve the results browser.")
+        p.add_argument("-b", "--host", default="0.0.0.0")
+        p.add_argument("-p", "--port", type=int, default=8080)
+        p.add_argument("--store-root", default="store")
+        return p
+
+    def run(opts) -> int:
+        from jepsen_tpu import web
+        server = web.serve(host=opts["host"], port=opts["port"],
+                           root=opts["store_root"])
+        print(f"Listening on http://{opts['host']}:{server.server_port}/")
+        try:
+            server.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        return OK
+
+    return {"serve": {"parser": build_parser, "run": run}}
+
+
+def merge_commands(*cmds: dict) -> dict:
+    out: Dict[str, dict] = {}
+    for c in cmds:
+        out.update(c)
+    return out
+
+
+def run(subcommands: Dict[str, dict], argv: Sequence[str]) -> int:
+    """Dispatch a subcommand; returns the exit code (cli.clj:201-276)."""
+    argv = list(argv)
+    command = argv[0] if argv else None
+    if command not in subcommands:
+        print("Usage: COMMAND [OPTIONS ...]")
+        print("Commands:", ", ".join(sorted(subcommands)))
+        return INVALID_ARGS
+    spec = subcommands[command]
+    try:
+        parser = spec["parser"]()
+        try:
+            ns = parser.parse_args(argv[1:])
+        except _ArgError as e:
+            print(str(e), file=sys.stderr)
+            return INVALID_ARGS
+        opts = vars(ns)
+        opt_fn = spec.get("opt_fn")
+        if opt_fn:
+            try:
+                opts = opt_fn(opts)
+            except _ArgError as e:
+                print(str(e), file=sys.stderr)
+                return INVALID_ARGS
+        return spec["run"](opts)
+    except SystemExit as e:  # argparse --help exits 0
+        return int(e.code or 0)
+    except Exception:  # noqa: BLE001 (cli.clj:271-275)
+        print("Oh jeez, I'm sorry, Jepsen broke. Here's why:",
+              file=sys.stderr)
+        traceback.print_exc()
+        return CRASHED
+
+
+def main(subcommands: Dict[str, dict],
+         argv: Optional[Sequence[str]] = None) -> None:
+    sys.exit(run(subcommands, argv if argv is not None else sys.argv[1:]))
+
+
+if __name__ == "__main__":  # default main: the results server (cli.clj -main)
+    main(serve_cmd())

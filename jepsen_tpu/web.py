@@ -1,0 +1,196 @@
+"""Results web browser.
+
+Rebuild of jepsen.web (jepsen/src/jepsen/web.clj) on the stdlib http
+server: a test table with validity color-coding ('/'), a file/directory
+browser with text and image previews ('/files/...'), streaming zip
+downloads of run directories ('?zip'), and the same path-traversal guard
+the reference enforces (web.clj:273-278 assert-file-in-scope!).
+"""
+
+from __future__ import annotations
+
+import html
+import io
+import json
+import os
+import threading
+import zipfile
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional, Tuple
+from urllib.parse import quote, unquote, urlparse
+
+VALID_COLORS = {True: "#6DB6FE", False: "#FEA786", "unknown": "#FEFF7F"}
+
+TEXT_EXT = {".txt", ".log", ".json", ".jsonl", ".edn", ".md", ".py", ".cc",
+            ".yml", ".yaml", ".csv"}
+IMAGE_EXT = {".png": "image/png", ".svg": "image/svg+xml",
+             ".jpg": "image/jpeg", ".jpeg": "image/jpeg"}
+
+PAGE = """<!doctype html><html><head><title>{title}</title><style>
+body {{ font-family: sans-serif; margin: 2em; }}
+table {{ border-collapse: collapse; }}
+td, th {{ padding: .3em .8em; text-align: left;
+          border-bottom: 1px solid #ddd; }}
+a {{ text-decoration: none; color: #0366d6; }}
+.valid {{ font-weight: bold; }}
+</style></head><body><h1>{title}</h1>{body}</body></html>"""
+
+
+def run_rows(root: str) -> List[Tuple[str, str, object]]:
+    """(name, timestamp, valid) for every saved run, newest first
+    (web.clj:47-67 fast-tests)."""
+    rows = []
+    if not os.path.isdir(root):
+        return rows
+    for name in sorted(os.listdir(root)):
+        name_dir = os.path.join(root, name)
+        if not os.path.isdir(name_dir) or name == "latest":
+            continue
+        for ts in sorted(os.listdir(name_dir), reverse=True):
+            run_dir = os.path.join(name_dir, ts)
+            if not os.path.isdir(run_dir) or ts == "latest" \
+                    or os.path.islink(run_dir):
+                continue
+            valid = None
+            results = os.path.join(run_dir, "results.json")
+            if os.path.exists(results):
+                try:
+                    with open(results) as f:
+                        valid = json.load(f).get("valid")
+                except (OSError, ValueError):
+                    valid = "unknown"
+            rows.append((name, ts, valid))
+    rows.sort(key=lambda r: r[1], reverse=True)
+    return rows
+
+
+def _within(root: str, path: str) -> bool:
+    """Path-traversal guard (web.clj:273-278)."""
+    root = os.path.realpath(root)
+    return os.path.realpath(path).startswith(root + os.sep) or \
+        os.path.realpath(path) == root
+
+
+class Handler(BaseHTTPRequestHandler):
+    root = "store"
+
+    def log_message(self, *args):  # quiet by default
+        pass
+
+    def _send(self, code: int, body: bytes,
+              ctype: str = "text/html; charset=utf-8",
+              headers: Optional[dict] = None):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _page(self, title: str, body: str, code: int = 200):
+        self._send(code, PAGE.format(title=html.escape(title),
+                                     body=body).encode())
+
+    # -- routes -------------------------------------------------------------
+
+    def do_GET(self):  # noqa: N802 (stdlib naming)
+        url = urlparse(self.path)
+        path = unquote(url.path)
+        try:
+            if path == "/":
+                return self.home()
+            if path.startswith("/files/"):
+                return self.files(path[len("/files/"):],
+                                  zip_requested=url.query == "zip")
+            self._page("404", "<p>Not found.</p>", code=404)
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # noqa: BLE001
+            self._page("Error", f"<pre>{html.escape(repr(e))}</pre>",
+                       code=500)
+
+    def home(self):
+        """Test table with validity colors (web.clj:116-128)."""
+        rows = []
+        for name, ts, valid in run_rows(self.root):
+            color = VALID_COLORS.get(valid, "#ffffff")
+            link = f"/files/{quote(name)}/{quote(ts)}/"
+            rows.append(
+                f"<tr style='background:{color}'>"
+                f"<td class=valid>{html.escape(str(valid))}</td>"
+                f"<td><a href='{link}'>{html.escape(name)}</a></td>"
+                f"<td><a href='{link}'>{html.escape(ts)}</a></td>"
+                f"<td><a href='{link[:-1]}?zip'>zip</a></td></tr>")
+        body = ("<table><tr><th>valid</th><th>test</th><th>time</th>"
+                "<th></th></tr>" + "".join(rows) + "</table>"
+                if rows else "<p>No tests run yet.</p>")
+        self._page("Jepsen-TPU results", body)
+
+    def files(self, rel: str, zip_requested: bool = False):
+        """Static file / dir browser / zip download (web.clj:194-271)."""
+        target = os.path.join(self.root, rel)
+        if not _within(self.root, target):
+            return self._page("403", "<p>Forbidden.</p>", code=403)
+        if not os.path.exists(target):
+            return self._page("404", "<p>Not found.</p>", code=404)
+        if os.path.isdir(target):
+            if zip_requested:
+                return self.zip_dir(target, rel)
+            return self.dir_listing(target, rel)
+        return self.file(target)
+
+    def dir_listing(self, target: str, rel: str):
+        entries = sorted(os.listdir(target))
+        items = []
+        if rel.strip("/"):
+            items.append("<li><a href='..'>..</a></li>")
+        for e in entries:
+            suffix = "/" if os.path.isdir(os.path.join(target, e)) else ""
+            items.append(f"<li><a href='{quote(e)}{suffix}'>"
+                         f"{html.escape(e)}{suffix}</a></li>")
+        self._page(f"/{rel}", "<ul>" + "".join(items) + "</ul>")
+
+    def file(self, target: str):
+        ext = os.path.splitext(target)[1].lower()
+        with open(target, "rb") as f:
+            data = f.read()
+        if ext in IMAGE_EXT:
+            return self._send(200, data, IMAGE_EXT[ext])
+        if ext in TEXT_EXT or not ext:
+            return self._send(200, data, "text/plain; charset=utf-8")
+        return self._send(200, data, "application/octet-stream",
+                          {"Content-Disposition": "attachment"})
+
+    def zip_dir(self, target: str, rel: str):
+        """Zip a run directory for download (web.clj:250-271)."""
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+            for dirpath, _dirs, files in os.walk(target):
+                for fname in files:
+                    full = os.path.join(dirpath, fname)
+                    if os.path.islink(full):
+                        continue
+                    z.write(full, os.path.relpath(full, target))
+        name = rel.strip("/").replace("/", "-") or "store"
+        self._send(200, buf.getvalue(), "application/zip",
+                   {"Content-Disposition":
+                    f'attachment; filename="{name}.zip"'})
+
+
+def serve(host: str = "127.0.0.1", port: int = 8080,
+          root: str = "store") -> ThreadingHTTPServer:
+    """Start the results server (web.clj:315-320); caller runs
+    serve_forever (or uses serve_background)."""
+    handler = type("BoundHandler", (Handler,), {"root": root})
+    return ThreadingHTTPServer((host, port), handler)
+
+
+def serve_background(host: str = "127.0.0.1", port: int = 0,
+                     root: str = "store") -> ThreadingHTTPServer:
+    """serve() on a daemon thread; returns the live server (its
+    server_port reports the bound port when port=0)."""
+    server = serve(host, port, root)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return server

@@ -1,0 +1,178 @@
+"""CLI exit-code contract + option processing; web browser routes."""
+
+import json
+import os
+import urllib.request
+
+import pytest
+
+from jepsen_tpu import cli, web
+from jepsen_tpu.checker import Unbridled
+from jepsen_tpu.checker.wgl import linearizable
+from jepsen_tpu.models import CASRegister
+from jepsen_tpu import generator as gen
+from jepsen_tpu.testing import atom_test
+
+
+class TestOptionProcessing:
+    def test_concurrency_multiplier(self):
+        assert cli.parse_concurrency("3n", 5) == 15
+        assert cli.parse_concurrency("10", 5) == 10
+        with pytest.raises(Exception):
+            cli.parse_concurrency("3x", 5)
+
+    def test_test_opt_fn_defaults(self):
+        opts = cli.test_opt_fn({
+            "node": None, "nodes_file": None, "username": "root",
+            "password": "root", "strict_host_key_checking": False,
+            "ssh_private_key": None, "ssh_mode": None,
+            "concurrency": "1n", "test_count": 1, "time_limit": 60})
+        assert opts["nodes"] == cli.DEFAULT_NODES
+        assert opts["concurrency"] == 5
+        assert opts["ssh"]["username"] == "root"
+
+    def test_nodes_file(self, tmp_path):
+        f = tmp_path / "nodes"
+        f.write_text("h1\nh2\n\nh3\n")
+        opts = cli.test_opt_fn({"node": None, "nodes_file": str(f),
+                                "concurrency": "2n"})
+        assert opts["nodes"] == ["h1", "h2", "h3"]
+        assert opts["concurrency"] == 6
+
+    def test_explicit_nodes_override_default(self):
+        opts = cli.test_opt_fn({"node": ["a", "b"], "concurrency": "1n"})
+        assert opts["nodes"] == ["a", "b"]
+        assert opts["concurrency"] == 2
+
+
+class TestRunDispatch:
+    def test_unknown_command_exits_254(self, capsys):
+        assert cli.run({}, ["bogus"]) == cli.INVALID_ARGS
+        assert cli.run({}, []) == cli.INVALID_ARGS
+
+    def test_bad_args_exit_254(self):
+        cmds = cli.single_test_cmd(lambda opts: atom_test())
+        assert cli.run(cmds, ["test", "--no-such-flag"]) == cli.INVALID_ARGS
+        assert cli.run(cmds, ["test", "--concurrency", "x3"]) == \
+            cli.INVALID_ARGS
+
+    def test_help_exits_0(self, capsys):
+        cmds = cli.single_test_cmd(lambda opts: atom_test())
+        assert cli.run(cmds, ["test", "--help"]) == cli.OK
+        assert "--concurrency" in capsys.readouterr().out
+
+    def test_crash_exits_255(self):
+        def boom(opts):
+            raise RuntimeError("kaboom")
+        cmds = {"test": {"parser": lambda: cli.Parser(prog="t"),
+                         "run": boom}}
+        assert cli.run(cmds, ["test"]) == cli.CRASHED
+
+    def _test_fn(self, valid: bool):
+        def build(opts):
+            t = atom_test(**{
+                "nodes": opts["nodes"],
+                "concurrency": opts["concurrency"],
+                "store-root": opts["_root"],
+            })
+            t["generator"] = gen.limit(20, _cas_mix())
+            t["checker"] = (linearizable(CASRegister()) if valid
+                            else _AlwaysInvalid())
+            return t
+        return build
+
+    def test_end_to_end_valid_run_exits_0(self, tmp_path):
+        cmds = cli.single_test_cmd(
+            self._test_fn(valid=True),
+            opt_fn=lambda o: {**o, "_root": str(tmp_path)})
+        rc = cli.run(cmds, ["test", "--ssh-mode", "dummy",
+                            "--concurrency", "3"])
+        assert rc == cli.OK
+        # store artifacts + latest symlinks exist
+        latest = tmp_path / "latest"
+        assert latest.exists()
+        assert (latest / "results.json").exists()
+        assert (latest / "history.jsonl").exists()
+        results = json.loads((latest / "results.json").read_text())
+        assert results["valid"] is True
+
+    def test_end_to_end_invalid_run_exits_1(self, tmp_path):
+        cmds = cli.single_test_cmd(
+            self._test_fn(valid=False),
+            opt_fn=lambda o: {**o, "_root": str(tmp_path)})
+        rc = cli.run(cmds, ["test", "--ssh-mode", "dummy",
+                            "--concurrency", "3"])
+        assert rc == cli.TEST_FAILED
+
+
+class _AlwaysInvalid(Unbridled):
+    def check(self, test, history, opts=None):
+        return {"valid": False}
+
+
+def _cas_mix():
+    import random
+
+    def next_op(test, process):
+        r = random.random()
+        if r < 0.4:
+            return {"f": "read", "value": None}
+        if r < 0.8:
+            return {"f": "write", "value": random.randrange(5)}
+        return {"f": "cas", "value": (random.randrange(5),
+                                      random.randrange(5))}
+    return next_op
+
+
+@pytest.fixture()
+def store_with_runs(tmp_path):
+    for name, ts, valid in [("etcd-cas", "20260729T100000.000", True),
+                            ("etcd-cas", "20260729T110000.000", False),
+                            ("queue", "20260729T120000.000", "unknown")]:
+        d = tmp_path / name / ts
+        d.mkdir(parents=True)
+        (d / "results.json").write_text(json.dumps({"valid": valid}))
+        (d / "history.txt").write_text("0 invoke read nil\n")
+        (d / "jepsen.log").write_text("hello log\n")
+    return tmp_path
+
+
+class TestWeb:
+    def get(self, server, path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.server_port}{path}") as r:
+            return r.status, r.read(), r.headers
+
+    def test_routes(self, store_with_runs):
+        server = web.serve_background(root=str(store_with_runs))
+        try:
+            code, body, _ = self.get(server, "/")
+            assert code == 200
+            assert b"etcd-cas" in body and b"queue" in body
+            assert web.VALID_COLORS[False].encode() in body
+
+            code, body, _ = self.get(server, "/files/etcd-cas/")
+            assert code == 200 and b"20260729T100000.000" in body
+
+            code, body, hdrs = self.get(
+                server, "/files/etcd-cas/20260729T100000.000/history.txt")
+            assert code == 200 and b"invoke read" in body
+            assert hdrs["Content-Type"].startswith("text/plain")
+
+            code, body, hdrs = self.get(
+                server, "/files/etcd-cas/20260729T100000.000?zip")
+            assert code == 200
+            assert hdrs["Content-Type"] == "application/zip"
+            assert body[:2] == b"PK"
+        finally:
+            server.shutdown()
+
+    def test_path_traversal_blocked(self, store_with_runs):
+        server = web.serve_background(root=str(store_with_runs))
+        try:
+            import urllib.error
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self.get(server, "/files/../../../etc/passwd")
+            assert ei.value.code in (403, 404)
+        finally:
+            server.shutdown()
