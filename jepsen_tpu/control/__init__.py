@@ -208,6 +208,8 @@ class DummySession(Session):
         self.log.append(cmd)
         for pat, resp in self.responses.items():
             if pat in cmd:
+                if isinstance(resp, tuple):  # scripted (rc, out, err)
+                    return resp
                 return 0, resp, ""
         return 0, "", ""
 

@@ -1,0 +1,195 @@
+"""Remote sysadmin helpers + debian OS prep, driven through the dummy
+control plane with scripted responses."""
+
+import pytest
+
+from jepsen_tpu import control
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.os import debian
+
+
+def dummy_test(responses=None, **over):
+    test = {
+        "nodes": ["n1", "n2"],
+        "concurrency": 2,
+        "ssh": {"mode": "dummy", "dummy-responses": responses or {}},
+    }
+    test.update(over)
+    return test
+
+
+def log_of(test, node="n1"):
+    return list(test["_sessions"][node].log)
+
+
+class TestExistsWgetArchive:
+    def test_exists_true_false(self):
+        t = dummy_test({"stat /there": "ok",
+                        "stat /missing": (1, "", "no such file")})
+        with control.session_pool(t):
+            assert cu.exists(t, "n1", "/there") is True
+            assert cu.exists(t, "n1", "/missing") is False
+
+    def test_wget_skips_when_present(self):
+        t = dummy_test({"stat": "ok"})
+        with control.session_pool(t):
+            name = cu.wget(t, "n1", "https://example.com/db-1.2.tgz")
+            assert name == "db-1.2.tgz"
+            assert not any("wget" in c for c in log_of(t))
+
+    def test_wget_downloads_when_missing(self):
+        t = dummy_test({"stat": (1, "", "nope")})
+        with control.session_pool(t):
+            cu.wget(t, "n1", "https://example.com/db-1.2.tgz")
+            assert any("wget --tries 20" in c and "db-1.2.tgz" in c
+                       for c in log_of(t))
+
+    def test_install_archive_single_root_collapses(self):
+        t = dummy_test({"stat": (1, "", "nope"),
+                        "ls -A": "db-1.2-amd64",
+                        "dirname": "/opt"})
+        with control.session_pool(t):
+            dest = cu.install_archive(
+                t, "n1", "https://example.com/db-1.2.tgz", "/opt/db")
+            assert dest == "/opt/db"
+            cmds = log_of(t)
+            assert any(c.startswith("cd /tmp/jepsen/") and "tar xf" in c
+                       for c in cmds)
+            assert any("mv /tmp/jepsen/" in c and c.endswith("/opt/db")
+                       and "db-1.2-amd64" in c for c in cmds)
+            assert any("rm -rf /opt/db" in c for c in cmds)
+
+    def test_install_archive_zip(self):
+        t = dummy_test({"stat": (1, "", "nope"),
+                        "ls -A": "a\nb",
+                        "dirname": "/opt"})
+        with control.session_pool(t):
+            cu.install_archive(t, "n1", "file:///tmp/x.zip", "/opt/db")
+            cmds = log_of(t)
+            assert any("unzip /tmp/x.zip" in c for c in cmds)
+            # multiple roots: whole tmpdir moves to dest
+            assert any("mv /tmp/jepsen/" in c and c.endswith("/opt/db")
+                       for c in cmds)
+            assert not any("wget" in c for c in cmds)
+
+
+class TestDaemons:
+    def test_start_daemon_command_shape(self):
+        t = dummy_test()
+        with control.session_pool(t):
+            cu.start_daemon(t, "n1", "/opt/etcd/etcd",
+                            "--name", "n1", "--data-dir", "/var/lib/etcd",
+                            logfile="/var/log/etcd.log",
+                            pidfile="/var/run/etcd.pid",
+                            chdir="/opt/etcd")
+            cmds = log_of(t)
+            assert any("Jepsen starting" in c and ">> /var/log/etcd.log" in c
+                       for c in cmds)
+            start = next(c for c in cmds if "start-stop-daemon" in c)
+            for frag in ("--start", "--background", "--no-close",
+                         "--make-pidfile", "--exec /opt/etcd/etcd",
+                         "--pidfile /var/run/etcd.pid", "--chdir /opt/etcd",
+                         "--oknodo", "--startas /opt/etcd/etcd",
+                         "-- --name n1 --data-dir /var/lib/etcd",
+                         ">> /var/log/etcd.log 2>&1"):
+                assert frag in start, (frag, start)
+
+    def test_stop_daemon_by_pidfile(self):
+        t = dummy_test({"cat /var/run/db.pid": "1234"})
+        with control.session_pool(t):
+            cu.stop_daemon(t, "n1", "/var/run/db.pid")
+            cmds = log_of(t)
+            assert any("kill -9 1234" in c for c in cmds)
+            assert any("rm -rf /var/run/db.pid" in c for c in cmds)
+
+    def test_stop_daemon_by_cmd(self):
+        t = dummy_test()
+        with control.session_pool(t):
+            cu.stop_daemon(t, "n1", "/var/run/db.pid", cmd="etcd")
+            assert any("killall -9 -w etcd" in c for c in log_of(t))
+
+    def test_grepkill(self):
+        t = dummy_test()
+        with control.session_pool(t):
+            cu.grepkill(t, "n1", "cockroach")
+            assert any("ps aux | grep cockroach" in c
+                       and "xargs kill -9" in c for c in log_of(t))
+        t2 = dummy_test()
+        with control.session_pool(t2):
+            cu.grepkill(t2, "n1", "java", signal=15)
+            assert any("kill -15" in c for c in log_of(t2))
+
+
+class TestEnsureUser:
+    def test_creates(self):
+        t = dummy_test()
+        with control.session_pool(t):
+            assert cu.ensure_user(t, "n1", "etcd") == "etcd"
+            assert any("adduser --disabled-password" in c
+                       for c in log_of(t))
+
+    def test_tolerates_existing(self):
+        t = dummy_test({"adduser": (1, "", "user etcd already exists")})
+        with control.session_pool(t):
+            assert cu.ensure_user(t, "n1", "etcd") == "etcd"
+
+
+class TestDebian:
+    def test_install_only_missing(self):
+        t = dummy_test({"dpkg --get-selections":
+                        "wget\tinstall\ncurl\tinstall"})
+        with control.session_pool(t):
+            debian.install(t, "n1", ["wget", "curl", "ntpdate"])
+            cmds = log_of(t)
+            inst = [c for c in cmds if "apt-get install" in c]
+            assert len(inst) == 1
+            assert "ntpdate" in inst[0]
+            assert "curl" not in inst[0]
+
+    def test_install_all_present_is_noop(self):
+        t = dummy_test({"dpkg --get-selections": "wget\tinstall"})
+        with control.session_pool(t):
+            debian.install(t, "n1", ["wget"])
+            assert not any("apt-get install" in c for c in log_of(t))
+
+    def test_version_pinning(self):
+        t = dummy_test({"apt-cache policy": "Installed: 1.0\n"})
+        with control.session_pool(t):
+            debian.install(t, "n1", {"db": "2.0"})
+            assert any("apt-get install -y --force-yes db=2.0" in c
+                       for c in log_of(t))
+            log_of(t).clear()
+        t2 = dummy_test({"apt-cache policy": "Installed: 2.0\n"})
+        with control.session_pool(t2):
+            debian.install(t2, "n1", {"db": "2.0"})
+            assert not any("apt-get install" in c for c in log_of(t2))
+
+    def test_setup_hostfile_rewrites(self):
+        t = dummy_test({"cat /etc/hosts":
+                        "127.0.0.1\tweird-name\n10.0.0.2 n2"})
+        with control.session_pool(t):
+            debian.setup_hostfile(t, "n1")
+            assert any("127.0.0.1\tlocalhost" in c and "/etc/hosts" in c
+                       for c in log_of(t))
+
+    def test_setup_hostfile_noop_when_fine(self):
+        t = dummy_test({"cat /etc/hosts": "127.0.0.1\tlocalhost"})
+        with control.session_pool(t):
+            debian.setup_hostfile(t, "n1")
+            assert not any("> /etc/hosts" in c for c in log_of(t))
+
+    def test_os_setup_runs(self):
+        t = dummy_test({"cat /etc/hosts": "127.0.0.1\tlocalhost",
+                        "date +%s": "1000000000",
+                        "stat -c": "999999999"})
+        with control.session_pool(t):
+            debian.os().setup(t, "n1")
+            cmds = log_of(t)
+            assert any("apt-get install" in c for c in cmds)
+
+    def test_add_repo_idempotent(self):
+        t = dummy_test({"stat": "ok"})  # list file exists
+        with control.session_pool(t):
+            debian.add_repo(t, "n1", "webupd8", "deb http://x y main")
+            assert not any("sources.list.d" in c and "echo" in c
+                           for c in log_of(t))
