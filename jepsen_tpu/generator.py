@@ -290,22 +290,34 @@ class SeqGen(Generator):
     threads."""
 
     def __init__(self, gens: Iterable[GenLike]):
-        self.gens = [gen(g) for g in gens]
+        # Lazy, like the reference's (gen/seq (cycle ...)): infinite
+        # sequences of generators are materialized one at a time.
+        self._iter = iter(gens)
+        self.gens: list = []
         self.i = 0
         self.lock = threading.RLock()
+
+    def _get(self, i):
+        """Materialize up to index i; None past the end. Call with lock."""
+        while len(self.gens) <= i:
+            try:
+                self.gens.append(gen(next(self._iter)))
+            except StopIteration:
+                return None
+        return self.gens[i]
 
     def op(self, test, process):
         while True:
             with self.lock:
-                if self.i >= len(self.gens):
-                    return None
-                g = self.gens[self.i]
+                g = self._get(self.i)
+            if g is None:
+                return None
             out = g.op(test, process)
             if out is not None:
                 return out
             with self.lock:
                 # advance only if nobody else already did
-                if self.i < len(self.gens) and self.gens[self.i] is g:
+                if self._get(self.i) is g:
                     self.i += 1
 
 

@@ -1,0 +1,34 @@
+"""Per-database test suites (the reference's L7 layer, SURVEY §2.6).
+
+Each suite module exposes a ``<name>_test(opts) -> test-map`` constructor
+and a ``main()`` CLI entry. Data planes use the DB's own wire protocol
+(HTTP APIs or the DB's CLI over the control plane) — never SSH for data
+ops.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+
+def registry() -> Dict[str, Callable[[dict], dict]]:
+    """Suite-name -> test constructor, imported lazily."""
+    from jepsen_tpu.suites import etcd
+    out = {"etcd": etcd.etcd_test}
+    try:
+        from jepsen_tpu.suites import zookeeper
+        out["zookeeper"] = zookeeper.zk_test
+    except ImportError:
+        pass
+    try:
+        from jepsen_tpu.suites import queues
+        out["rabbitmq"] = queues.rabbitmq_test
+        out["disque"] = queues.disque_test
+    except ImportError:
+        pass
+    try:
+        from jepsen_tpu.suites import cockroachdb
+        out["cockroachdb"] = cockroachdb.register_test
+    except ImportError:
+        pass
+    return out

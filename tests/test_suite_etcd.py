@@ -1,0 +1,169 @@
+"""etcd suite tests: DB lifecycle against the dummy control plane, and the
+real HTTP client + full canonical test against an in-process fake etcd
+speaking the v2 keys API."""
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from jepsen_tpu import control, core
+from jepsen_tpu import generator as gen
+from jepsen_tpu import independent
+from jepsen_tpu.suites import etcd
+
+from test_nemesis import dummy_test, logs
+
+
+class TestEtcdDB:
+    def test_setup_installs_and_starts(self):
+        t = dummy_test(**{"ssh": {"mode": "dummy", "dummy-responses": {
+            "stat ": (1, "", "nope"), "ls -A": "etcd-v3.1.5-linux-amd64",
+            "dirname": "/opt"}}})
+        with control.session_pool(t):
+            db = etcd.EtcdDB()
+            db.setup(t, "n1")
+            cmds = logs(t)["n1"]
+            assert any("wget" in c and "etcd-v3.1.5-linux-amd64.tar.gz" in c
+                       for c in cmds)
+            start = next(c for c in cmds if "start-stop-daemon" in c)
+            assert "--name n1" in start
+            assert ("--initial-cluster n1=http://n1:2380,n2=http://n2:2380"
+                    in start)
+            assert "--advertise-client-urls http://n1:2379" in start
+
+    def test_teardown_stops_and_wipes(self):
+        t = dummy_test()
+        with control.session_pool(t):
+            etcd.EtcdDB().teardown(t, "n1")
+            cmds = logs(t)["n1"]
+            assert any("killall -9 -w etcd" in c for c in cmds)
+            assert any("rm -rf /opt/etcd/default.etcd" in c for c in cmds)
+
+    def test_log_files(self):
+        assert etcd.EtcdDB().log_files({}, "n1") == ["/opt/etcd/etcd.log"]
+
+
+class FakeEtcdHandler(BaseHTTPRequestHandler):
+    """Minimal etcd v2 /v2/keys implementation over a lock-guarded dict."""
+
+    store = {}
+    lock = threading.Lock()
+
+    def log_message(self, *a):
+        pass
+
+    def _key(self):
+        return urllib.parse.unquote(
+            urllib.parse.urlparse(self.path).path[len("/v2/keys/"):])
+
+    def _reply(self, code, obj):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802
+        k = self._key()
+        with self.lock:
+            if k not in self.store:
+                return self._reply(404, {"errorCode": 100})
+            return self._reply(200, {"node": {"value":
+                                              str(self.store[k])}})
+
+    def do_PUT(self):  # noqa: N802
+        k = self._key()
+        n = int(self.headers.get("Content-Length", 0))
+        form = dict(urllib.parse.parse_qsl(self.rfile.read(n).decode()))
+        with self.lock:
+            if "prevValue" in form:
+                if k not in self.store:
+                    return self._reply(404, {"errorCode": 100})
+                if str(self.store[k]) != form["prevValue"]:
+                    return self._reply(412, {"errorCode": 101})
+            self.store[k] = form["value"]
+            return self._reply(200, {"node": {"value": form["value"]}})
+
+
+@pytest.fixture()
+def fake_etcd():
+    FakeEtcdHandler.store = {}
+    server = ThreadingHTTPServer(("127.0.0.1", 0), FakeEtcdHandler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield f"127.0.0.1:{server.server_port}"
+    server.shutdown()
+
+
+class TestEtcdClient:
+    def test_write_read_cas(self, fake_etcd):
+        c = etcd.EtcdClient().open({}, fake_etcd)
+
+        def op(f, v):
+            from jepsen_tpu.history import Op
+            return Op(type="invoke", f=f,
+                      value=independent.tuple_(0, v), process=0, time=0)
+
+        assert c.invoke({}, op("read", None)).type == "fail"  # not found
+        assert c.invoke({}, op("write", 3)).type == "ok"
+        got = c.invoke({}, op("read", None))
+        assert got.type == "ok" and got.value.value == 3
+        assert c.invoke({}, op("cas", (3, 4))).type == "ok"
+        assert c.invoke({}, op("cas", (3, 9))).type == "fail"
+        got = c.invoke({}, op("read", None))
+        assert got.value.value == 4
+
+    def test_connection_refused_crashes_appropriately(self):
+        c = etcd.EtcdClient(timeout=0.3).open({}, "127.0.0.1:1")
+        from jepsen_tpu.history import Op
+
+        def op(f, v):
+            return Op(type="invoke", f=f,
+                      value=independent.tuple_(0, v), process=0, time=0)
+        assert c.invoke({}, op("read", None)).type == "fail"
+        assert c.invoke({}, op("write", 1)).type == "info"
+
+
+class TestCanonicalEtcdTest:
+    def test_full_run_against_fake_etcd(self, fake_etcd, tmp_path):
+        # scaled-down canonical test: 2 keys' worth of ops, no partitions
+        # (the fake is a single linearizable store)
+        opts = {"time-limit": 3, "threads-per-key": 2, "ops-per-key": 30,
+                "backend": "cpu"}
+        test = etcd.etcd_test(opts)
+        test.update({
+            "nodes": [fake_etcd] * 2,
+            "concurrency": 4,
+            "nemesis": None,
+            "net": None,
+            "db": None,
+            "ssh": {"mode": "dummy"},
+            "store-dir": str(tmp_path / "run"),
+        })
+        # drop the nemesis schedule: no nemesis object is installed
+        test["generator"] = gen.time_limit(
+            3, gen.clients(_inner_workload(opts)))
+        out = core.run(test)
+        res = out["results"]
+        assert res["valid"] is True, res
+        assert res["indep"]["valid"] is True
+        ops = [o for o in out["history"] if o.is_ok]
+        assert len(ops) > 20
+
+    def test_structure(self):
+        test = etcd.etcd_test({"time-limit": 1})
+        assert test["name"] == "etcd"
+        assert test["model"] is not None
+        from jepsen_tpu.nemesis import Partitioner
+        assert isinstance(test["nemesis"], Partitioner)
+
+
+def _inner_workload(opts):
+    import itertools
+    from jepsen_tpu.suites import workloads as wl
+    return independent.concurrent_generator(
+        opts.get("threads-per-key", 2), itertools.count(),
+        lambda k: gen.limit(opts.get("ops-per-key", 30),
+                            gen.stagger(1 / 100, wl.register_gen())))

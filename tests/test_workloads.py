@@ -1,0 +1,149 @@
+"""Workload library self-tests: each checker validated against a correct
+in-memory backend (must pass) and a deliberately broken one (must fail),
+run through the full core.run lifecycle."""
+
+import pytest
+
+from jepsen_tpu import core
+from jepsen_tpu import generator as gen
+from jepsen_tpu import independent
+from jepsen_tpu.checker import compose, total_queue
+from jepsen_tpu.history import History, Op
+from jepsen_tpu.suites import workloads as wl
+from jepsen_tpu.testing import (
+    BankClient, G2Client, MonotonicClient, QueueClient, SequentialClient,
+    SharedBank, SharedKV, SharedMonotonic, SharedQueue, noop_test)
+
+
+def run_test(client, generator, checker, **over):
+    t = noop_test()
+    t.update({
+        # clients-routing: without it the nemesis process also draws from
+        # the workload generator (same idiom the reference requires)
+        "client": client,
+        "generator": gen.clients(generator),
+        "checker": checker,
+        "store-dir": None,
+        "name": over.pop("name", "workload-test"),
+    })
+    t.update(over)
+    return core.run(t)
+
+
+class TestBank:
+    def gen(self):
+        mix = gen.mix([wl.bank_read, wl.bank_diff_transfer(5)])
+        return gen.limit(200, mix)
+
+    def test_atomic_bank_valid(self):
+        bank = SharedBank(5, 10)
+        t = run_test(BankClient(bank), self.gen(),
+                     wl.bank_checker(5, 50), name="bank")
+        assert t["results"]["valid"] is True
+        # sanity: reads actually happened
+        assert any(o.f == "read" and o.is_ok for o in t["history"])
+
+    def test_broken_bank_detected(self):
+        bank = SharedBank(5, 10)
+        t = run_test(BankClient(bank, broken=True), self.gen(),
+                     wl.bank_checker(5, 50), name="bank-broken")
+        assert t["results"]["valid"] is False
+        kinds = {b["type"] for b in t["results"]["bad-reads"]}
+        assert kinds & {"wrong-total", "negative-value"}
+
+
+class TestMonotonic:
+    def gen(self):
+        adds = gen.limit(100, lambda test, p: {"f": "add", "value": None})
+        final = gen.once({"f": "read", "value": None})
+        return gen.phases(adds, final)
+
+    def test_monotonic_valid(self):
+        tbl = SharedMonotonic()
+        t = run_test(MonotonicClient(tbl), self.gen(),
+                     wl.monotonic_checker(), name="monotonic")
+        assert t["results"]["valid"] is True, t["results"]
+
+    def test_skewed_timestamps_detected(self):
+        tbl = SharedMonotonic()
+        t = run_test(MonotonicClient(tbl, broken=True), self.gen(),
+                     wl.monotonic_checker(), name="monotonic-broken")
+        assert t["results"]["valid"] is False
+        assert t["results"]["order-by-errors"]
+
+    def test_never_read_is_unknown(self):
+        tbl = SharedMonotonic()
+        t = run_test(MonotonicClient(tbl),
+                     gen.limit(10, lambda _t, _p: {"f": "add",
+                                                   "value": None}),
+                     wl.monotonic_checker(), name="monotonic-noread")
+        assert t["results"]["valid"] == "unknown"
+
+
+class TestSequential:
+    def test_ordered_writes_valid(self):
+        kv = SharedKV()
+        t = run_test(SequentialClient(kv),
+                     gen.time_limit(1.0, gen.stagger(
+                         0.001, wl.sequential_gen(2))),
+                     wl.SequentialChecker(),
+                     name="sequential", **{"key-count": 5,
+                                           "concurrency": 5})
+        assert t["results"]["valid"] is True, t["results"]
+
+    def test_reversed_writes_detected(self):
+        # reversed subkey writes + concurrent readers -> trailing nils
+        kv = SharedKV()
+        t = run_test(SequentialClient(kv, broken=True),
+                     gen.time_limit(1.5, wl.sequential_gen(2)),
+                     wl.SequentialChecker(),
+                     name="sequential-broken", **{"key-count": 8,
+                                                  "concurrency": 5})
+        # The race is probabilistic but heavily biased; require detection
+        assert t["results"]["bad-count"] >= 1
+        assert t["results"]["valid"] is False
+
+    def test_trailing_nil(self):
+        assert wl.trailing_nil(["b", None])
+        assert not wl.trailing_nil([None, "a"])
+        assert not wl.trailing_nil(["a", "b"])
+        assert not wl.trailing_nil([None, None])
+
+
+class TestG2:
+    def test_serializable_valid(self):
+        t = run_test(G2Client(), gen.time_limit(1.0, wl.g2_gen()),
+                     wl.g2_checker(), name="g2",
+                     concurrency=4)
+        res = t["results"]
+        assert res["valid"] is True
+        assert res["key-count"] > 0
+
+    def test_racy_inserts_detected(self):
+        t = run_test(G2Client(broken=True),
+                     gen.time_limit(1.5, wl.g2_gen()),
+                     wl.g2_checker(), name="g2-broken",
+                     concurrency=4)
+        assert t["results"]["valid"] is False
+        assert t["results"]["illegal-count"] >= 1
+
+
+class TestQueueWorkload:
+    def gen(self):
+        q = gen.queue_gen()
+        return gen.phases(gen.limit(150, q),
+                          gen.limit(80, {"f": "dequeue"}))
+
+    def test_fifo_queue_valid(self):
+        q = SharedQueue()
+        t = run_test(QueueClient(q), self.gen(), total_queue(),
+                     name="queue")
+        assert t["results"]["valid"] is True, t["results"]
+
+    def test_lost_enqueues_detected(self):
+        q = SharedQueue()
+        t = run_test(QueueClient(q, broken=True), self.gen(),
+                     total_queue(), name="queue-broken")
+        res = t["results"]
+        assert res["valid"] is False
+        assert res.get("lost") or res.get("lost-count")
