@@ -13,22 +13,22 @@ from typing import Callable, Dict
 
 def registry() -> Dict[str, Callable[[dict], dict]]:
     """Suite-name -> test constructor, imported lazily."""
-    from jepsen_tpu.suites import etcd
-    out = {"etcd": etcd.etcd_test}
-    try:
-        from jepsen_tpu.suites import zookeeper
-        out["zookeeper"] = zookeeper.zk_test
-    except ImportError:
-        pass
-    try:
-        from jepsen_tpu.suites import queues
-        out["rabbitmq"] = queues.rabbitmq_test
-        out["disque"] = queues.disque_test
-    except ImportError:
-        pass
-    try:
-        from jepsen_tpu.suites import cockroachdb
-        out["cockroachdb"] = cockroachdb.register_test
-    except ImportError:
-        pass
+    from jepsen_tpu.suites import consul, disque, etcd, raftis, zookeeper
+    out = {
+        "etcd": etcd.etcd_test,
+        "zookeeper": zookeeper.zk_test,
+        "consul": consul.consul_test,
+        "disque": disque.disque_test,
+        "raftis": raftis.raftis_test,
+    }
+    import importlib
+    for name, mod, attr in (
+            ("rabbitmq", "rabbitmq", "rabbitmq_test"),
+            ("hazelcast", "hazelcast", "hazelcast_test"),
+            ("cockroachdb", "cockroachdb", "register_test")):
+        try:
+            m = importlib.import_module(f"jepsen_tpu.suites.{mod}")
+            out[name] = getattr(m, attr)
+        except (ImportError, AttributeError):
+            pass  # suite not built yet
     return out

@@ -259,8 +259,14 @@ class SequentialClient(client_ns.Client):
         key_count = test.get("key-count", 5)
         ks = subkeys(key_count, op.value)
         if op.f == "write":
-            for k in (reversed(ks) if self.broken else ks):
-                self.kv.put(k)
+            if self.broken:
+                import time as _t
+                for k in reversed(ks):
+                    self.kv.put(k)
+                    _t.sleep(0.001)  # widen the visibility window
+            else:
+                for k in ks:
+                    self.kv.put(k)
             return op.replace(type="ok")
         if op.f == "read":
             vals = [k if self.kv.get(k) else None for k in reversed(ks)]

@@ -1,0 +1,307 @@
+"""Consul / zookeeper / raftis / disque suite tests: real wire clients
+against in-process fakes (HTTP consul, RESP redis/disque), DB lifecycles
+against the dummy control plane."""
+
+import base64
+import json
+import socketserver
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from jepsen_tpu import control
+from jepsen_tpu.history import Op
+from jepsen_tpu.suites import consul, disque, raftis, zookeeper
+from jepsen_tpu.suites.resp import RespClient, RespError
+
+from test_nemesis import dummy_test, logs
+
+
+def op(f, v, p=0):
+    return Op(type="invoke", f=f, value=v, process=p, time=0)
+
+
+# ---------------------------------------------------------------------------
+# Fake consul (HTTP KV with index CAS)
+# ---------------------------------------------------------------------------
+
+
+class FakeConsulHandler(BaseHTTPRequestHandler):
+    store = {}
+    index = [1]
+    lock = threading.Lock()
+
+    def log_message(self, *a):
+        pass
+
+    def _key(self):
+        return urllib.parse.urlparse(self.path).path[len("/v1/kv/"):]
+
+    def _reply(self, code, body: bytes):
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802
+        k = self._key()
+        with self.lock:
+            if k not in self.store:
+                return self._reply(404, b"")
+            val, idx = self.store[k]
+            row = [{"Key": k, "ModifyIndex": idx,
+                    "Value": base64.b64encode(val).decode()}]
+            return self._reply(200, json.dumps(row).encode())
+
+    def do_PUT(self):  # noqa: N802
+        k = self._key()
+        q = dict(urllib.parse.parse_qsl(
+            urllib.parse.urlparse(self.path).query))
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n)
+        with self.lock:
+            if "cas" in q:
+                cur = self.store.get(k)
+                if cur is None or cur[1] != int(q["cas"]):
+                    return self._reply(200, b"false")
+            self.index[0] += 1
+            self.store[k] = (body, self.index[0])
+            return self._reply(200, b"true")
+
+
+@pytest.fixture()
+def fake_consul():
+    FakeConsulHandler.store = {}
+    FakeConsulHandler.index = [1]
+    server = ThreadingHTTPServer(("127.0.0.1", 0), FakeConsulHandler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield f"127.0.0.1:{server.server_port}"
+    server.shutdown()
+
+
+class TestConsulClient:
+    def test_read_write_cas(self, fake_consul):
+        c = consul.ConsulClient().open({}, fake_consul)
+        c.setup({"nodes": [fake_consul]})
+        got = c.invoke({}, op("read", None))
+        assert got.type == "ok" and got.value is None
+        assert c.invoke({}, op("write", 3)).type == "ok"
+        assert c.invoke({}, op("read", None)).value == 3
+        assert c.invoke({}, op("cas", (3, 5))).type == "ok"
+        assert c.invoke({}, op("cas", (3, 9))).type == "fail"
+        assert c.invoke({}, op("read", None)).value == 5
+
+    def test_down_node(self):
+        c = consul.ConsulClient(timeout=0.3).open({}, "127.0.0.1:1")
+        assert c.invoke({}, op("read", None)).type == "fail"
+        assert c.invoke({}, op("write", 1)).type == "info"
+
+
+# ---------------------------------------------------------------------------
+# Fake RESP server (redis + disque verbs)
+# ---------------------------------------------------------------------------
+
+
+class FakeRespHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        srv = self.server
+        while True:
+            try:
+                line = self.rfile.readline()
+            except OSError:
+                return
+            if not line:
+                return
+            assert line.startswith(b"*")
+            n = int(line[1:].strip())
+            args = []
+            for _ in range(n):
+                ln = self.rfile.readline()
+                assert ln.startswith(b"$")
+                size = int(ln[1:].strip())
+                args.append(self.rfile.read(size))
+                self.rfile.read(2)
+            self.wfile.write(srv.dispatch([a.decode("utf-8", "replace")
+                                           if i != srv.payload_index(args)
+                                           else a
+                                           for i, a in enumerate(args)]))
+            self.wfile.flush()
+
+
+class FakeRespServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self):
+        super().__init__(("127.0.0.1", 0), FakeRespHandler)
+        self.kv = {}
+        self.jobs = {}     # id -> payload bytes
+        self.queue = []    # job ids
+        self.next_id = 0
+        self.lock = threading.Lock()
+        self.watching = {}
+
+    @staticmethod
+    def payload_index(args):
+        # which arg is a binary payload (disque ADDJOB body)
+        if args and args[0].upper() in (b"ADDJOB",):
+            return 2
+        return -1
+
+    @staticmethod
+    def _bulk(b):
+        if b is None:
+            return b"$-1\r\n"
+        if isinstance(b, str):
+            b = b.encode()
+        return b"$%d\r\n%s\r\n" % (len(b), b)
+
+    def dispatch(self, args) -> bytes:
+        cmd = args[0].upper()
+        with self.lock:
+            if cmd == "GET":
+                return self._bulk(self.kv.get(args[1]))
+            if cmd == "SET":
+                self.kv[args[1]] = args[2]
+                return b"+OK\r\n"
+            if cmd in ("WATCH", "UNWATCH", "MULTI"):
+                return b"+OK\r\n"
+            if cmd == "EXEC":
+                return b"*1\r\n+OK\r\n"
+            if cmd == "ADDJOB":
+                self.next_id += 1
+                jid = f"D-{self.next_id}"
+                self.jobs[jid] = args[2]
+                self.queue.append(jid)
+                return self._bulk(jid)
+            if cmd == "GETJOB":
+                if not self.queue:
+                    return b"*-1\r\n"
+                jid = self.queue.pop(0)
+                q = self._bulk("jepsen")
+                return (b"*1\r\n*3\r\n" + q + self._bulk(jid)
+                        + self._bulk(self.jobs[jid]))
+            if cmd == "ACKJOB":
+                return b":1\r\n"
+            return b"-ERR unknown command\r\n"
+
+
+@pytest.fixture()
+def fake_resp():
+    server = FakeRespServer()
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield f"127.0.0.1:{server.server_address[1]}"
+    server.shutdown()
+
+
+class TestRespClient:
+    def test_roundtrip_types(self, fake_resp):
+        host, port = fake_resp.rsplit(":", 1)
+        c = RespClient(host, int(port))
+        assert c.execute("SET", "k", 5) == "OK"
+        assert c.execute("GET", "k") == b"5"
+        assert c.execute("GET", "nope") is None
+        with pytest.raises(RespError):
+            c.execute("BOGUS")
+        outs = c.execute_many([("SET", "a", 1), ("GET", "a")])
+        assert outs == ["OK", b"1"]
+        c.close()
+
+
+class TestRaftisClient:
+    def test_register_ops(self, fake_resp):
+        c = raftis.RaftisClient().open({}, fake_resp)
+        assert c.invoke({}, op("read", None)).value is None
+        assert c.invoke({}, op("write", 2)).type == "ok"
+        got = c.invoke({}, op("read", None))
+        assert got.type == "ok" and got.value == 2
+        assert c.invoke({}, op("cas", (2, 7))).type == "ok"
+        assert c.invoke({}, op("cas", (3, 9))).type == "fail"
+
+    def test_down_node(self):
+        c = raftis.RaftisClient(timeout=0.3).open({}, "127.0.0.1:1")
+        assert c.invoke({}, op("read", None)).type == "fail"
+        assert c.invoke({}, op("write", 1)).type == "info"
+
+
+class TestDisqueClient:
+    def test_enqueue_dequeue(self, fake_resp):
+        c = disque.DisqueClient().open({}, fake_resp)
+        assert c.invoke({}, op("enqueue", {"a": 1})).type == "ok"
+        assert c.invoke({}, op("enqueue", 2)).type == "ok"
+        got = c.invoke({}, op("dequeue", None))
+        assert got.type == "ok" and got.value == {"a": 1}
+        assert c.invoke({}, op("dequeue", None)).value == 2
+        assert c.invoke({}, op("dequeue", None)).type == "fail"
+
+    def test_drain_writes_history(self, fake_resp):
+        import threading as _t
+        from jepsen_tpu.history import History
+        c = disque.DisqueClient().open({}, fake_resp)
+        for v in (10, 20, 30):
+            c.invoke({}, op("enqueue", v))
+        hist = History()
+        test = {"_history_lock": _t.Lock(), "_active_histories": [hist],
+                "start-time": 0}
+        out = c.invoke(test, op("drain", None, p=3))
+        assert out.type == "ok" and out.value == "exhausted"
+        vals = [o.value for o in hist if o.is_ok and o.f == "dequeue"]
+        assert vals == [10, 20, 30]
+        assert all(o.process == 3 for o in hist)
+
+
+class TestZookeeperSuite:
+    ZK_GET = """Connecting to n1:2181
+WATCHER::
+4
+cZxid = 0x100
+dataVersion = 7
+numChildren = 0
+"""
+
+    def test_client_read_parses_value_and_version(self):
+        t = dummy_test(**{"ssh": {"mode": "dummy", "dummy-responses": {
+            "get /jepsen": self.ZK_GET}}})
+        with control.session_pool(t):
+            c = zookeeper.ZKClient().open(t, "n1")
+            got = c.invoke(t, op("read", None))
+            assert got.type == "ok" and got.value == 4
+
+    def test_client_cas_uses_version(self):
+        t = dummy_test(**{"ssh": {"mode": "dummy", "dummy-responses": {
+            "get /jepsen": self.ZK_GET}}})
+        with control.session_pool(t):
+            c = zookeeper.ZKClient().open(t, "n1")
+            got = c.invoke(t, op("cas", (4, 9)))
+            assert got.type == "ok"
+            assert any("set /jepsen 9 7" in cmd for cmd in logs(t)["n1"])
+            # wrong expected value fails without setting
+            got = c.invoke(t, op("cas", (5, 9)))
+            assert got.type == "fail"
+
+    def test_db_setup_writes_configs(self):
+        t = dummy_test()
+        with control.session_pool(t):
+            zookeeper.ZKDB().setup(t, "n2")
+            cmds = logs(t)["n2"]
+            assert any("echo 1 > /etc/zookeeper/conf/myid" in c
+                       for c in cmds)
+            assert any("server.0=n1:2888:3888" in c and "zoo.cfg" in c
+                       for c in cmds)
+            assert any("service zookeeper restart" in c for c in cmds)
+
+    def test_structure(self):
+        t = zookeeper.zk_test({"time-limit": 1})
+        assert t["name"] == "zookeeper"
+        assert t["model"].value == 0
+
+
+class TestRegistry:
+    def test_registry_has_suites(self):
+        from jepsen_tpu import suites
+        reg = suites.registry()
+        for name in ("etcd", "zookeeper", "consul", "disque", "raftis"):
+            assert name in reg
+            assert callable(reg[name])
