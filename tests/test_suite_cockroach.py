@@ -1,0 +1,174 @@
+"""CockroachDB suite tests: SQL clients against scripted dummy control,
+nemesis composition/product logic, basic-test phase template."""
+
+import pytest
+
+from jepsen_tpu import control
+from jepsen_tpu import generator as gen
+from jepsen_tpu import independent
+from jepsen_tpu import nemesis as nem
+from jepsen_tpu.history import Op
+from jepsen_tpu.suites import cockroachdb as cr
+
+from test_nemesis import dummy_test, logs
+
+
+def op(f, v, p=0):
+    return Op(type="invoke", f=f, value=v, process=p, time=0)
+
+
+class TestSQL:
+    def test_tsv_parse_drops_header(self):
+        t = dummy_test(**{"ssh": {"mode": "dummy", "dummy-responses": {
+            "SELECT": "val\n3\n"}}})
+        with control.session_pool(t):
+            rows = cr.sql(t, "n1", "SELECT val FROM registers WHERE id = 0")
+            assert rows == [["3"]]
+
+    def test_retryable_error_retries(self):
+        t = dummy_test(**{"ssh": {"mode": "dummy", "dummy-responses": {
+            "UPDATE": (1, "", "restart transaction: retry transaction")}}})
+        with control.session_pool(t):
+            with pytest.raises(cr.SQLError) as ei:
+                cr.sql(t, "n1", "UPDATE x SET y = 1")
+            assert ei.value.retryable
+            # 3 attempts recorded
+            assert sum("UPDATE" in c for c in logs(t)["n1"]) == 3
+
+    def test_classify_indeterminate(self):
+        e = control.RemoteError("n1", "c", 1, "", "connection reset by peer")
+        assert cr.classify_error(e).indeterminate
+
+
+class TestRegisterClient:
+    def test_ops(self):
+        t = dummy_test(**{"ssh": {"mode": "dummy", "dummy-responses": {
+            "SELECT val": "val\n7\n",
+            "UPDATE registers SET val = 9 WHERE id = 0 AND val = 7":
+                "val\n9\n",
+            "UPDATE registers SET val = 9 WHERE id = 0 AND val = 5":
+                "val\n",
+        }}})
+        with control.session_pool(t):
+            c = cr.RegisterClient().open(t, "n1")
+            got = c.invoke(t, op("read", independent.tuple_(0, None)))
+            assert got.type == "ok" and got.value.value == 7
+            assert c.invoke(
+                t, op("write", independent.tuple_(0, 3))).type == "ok"
+            assert any("UPSERT INTO registers" in cmd
+                       for cmd in logs(t)["n1"])
+            assert c.invoke(
+                t, op("cas", independent.tuple_(0, (7, 9)))).type == "ok"
+            assert c.invoke(
+                t, op("cas", independent.tuple_(0, (5, 9)))).type == "fail"
+
+
+class TestBankClient:
+    def test_transfer_sql_shape(self):
+        t = dummy_test(**{"ssh": {"mode": "dummy", "dummy-responses": {
+            "SELECT balance": "balance\n10\n10\n10\n10\n10\n"}}})
+        with control.session_pool(t):
+            c = cr.BankSQLClient(5, 10).open(t, "n1")
+            got = c.invoke(t, op("read", None))
+            assert got.type == "ok" and got.value == [10] * 5
+            out = c.invoke(t, op("transfer",
+                                 {"from": 1, "to": 3, "amount": 4}))
+            assert out.type == "ok"
+            stmt = next(cmd for cmd in logs(t)["n1"] if "BEGIN" in cmd)
+            assert "balance - 4" in stmt and "id = 1" in stmt
+            assert "balance + 4" in stmt and "id = 3" in stmt
+            assert "balance >= 4" in stmt
+
+
+class TestNemesisLibrary:
+    def test_compose_routes_tagged_ops(self):
+        calls = []
+
+        class Rec(nem.Nemesis):
+            def __init__(self, name):
+                self.name = name
+
+            def invoke(self, t, o):
+                calls.append((self.name, o.f))
+                return o
+
+        m1 = {**cr.nemesis_single_gen(), "name": "parts",
+              "client": Rec("parts"), "clocks": False}
+        m2 = {**cr.nemesis_single_gen(), "name": "skew",
+              "client": Rec("skew"), "clocks": True}
+        merged = cr.compose_nemeses([m1, m2])
+        assert merged["name"] == "parts+skew"
+        assert merged["clocks"] is True
+        client = merged["client"].setup({})
+        out = client.invoke({}, op(("skew", "start"), None))
+        assert out.f == ("skew", "start")
+        assert calls == [("skew", "start")]
+        client.invoke({}, op(("parts", "stop"), None))
+        assert calls[-1] == ("parts", "stop")
+
+    def test_tagged_generator_wraps_f(self):
+        m = {**cr.nemesis_single_gen(), "name": "parts",
+             "client": nem.noop(), "clocks": False}
+        g = cr._TaggedGen("parts", gen.once({"type": "info", "f": "start"}))
+        o = g.op({"concurrency": 1, "nodes": ["n1"]}, "nemesis")
+        assert o.f == ("parts", "start")
+
+    def test_product_filters(self):
+        pairs = cr.nemesis_product(
+            ["parts", "small-skews", "none"],
+            ["parts", "big-skews"])
+        assert ("parts", "parts") not in pairs
+        assert ("small-skews", "big-skews") not in pairs  # double clocks
+        assert ("parts", "big-skews") in pairs
+        assert ("none", "parts") in pairs
+        # no duplicate unordered pairs
+        assert len({frozenset(p) for p in pairs}) == len(pairs)
+
+    def test_named_registry(self):
+        for name, ctor in cr.NEMESES.items():
+            m = ctor()
+            assert m["name"], name
+            assert "client" in m and "clocks" in m
+
+
+class TestSkewNemesis:
+    def test_bump_and_reset(self):
+        t = dummy_test()
+        with control.session_pool(t):
+            n = cr.small_skews()["client"]
+            out = n.invoke(t, op("start", None, p="nemesis"))
+            assert isinstance(out.value, dict) and out.value
+            bumped = [node for node, c in logs(t).items()
+                      if any("bump-time" in x for x in c)]
+            assert set(bumped) == set(out.value)
+            n.invoke(t, op("stop", None, p="nemesis"))
+            assert any("ntpdate" in c for c in logs(t)["n1"])
+
+
+class TestBasicTestTemplate:
+    def test_structure(self):
+        test = cr.register_test({"time-limit": 1, "nodes": ["n1", "n2"],
+                                 "concurrency": 5})
+        assert test["name"].startswith("cockroachdb-register")
+        assert isinstance(test["db"], cr.CockroachDB)
+        assert test["keyrange"] == {}
+
+    def test_bank_final_read_phase(self):
+        test = cr.bank_test({"time-limit": 1, "nemesis": cr.parts()})
+        assert "parts" in test["name"]
+        # generator is a phases wrapper with during + final
+        assert test["generator"] is not None
+
+    def test_db_lifecycle_commands(self):
+        t = dummy_test(**{"ssh": {"mode": "dummy", "dummy-responses": {
+            "stat ": (1, "", "nope"), "ls -A": "cockroach-v1.0.linux-amd64",
+            "dirname": "/opt"}}})
+        with control.session_pool(t):
+            db = cr.CockroachDB()
+            db.setup(t, "n1")
+            start_cmd = next(c for c in logs(t)["n1"]
+                             if "start-stop-daemon" in c)
+            assert "--join n1,n2,n3,n4,n5" in start_cmd
+            assert "--insecure" in start_cmd
+            db.teardown(t, "n1")
+            assert any("xargs kill -9" in c for c in logs(t)["n1"])
