@@ -153,7 +153,7 @@ def std_gen(client_gen, time_limit: float = 100):
                        gen.clients(client_gen, gen.seq(_nemesis_cycle()))),
         gen.nemesis(gen.once({"type": "info", "f": "stop"})),
         gen.clients(gen.time_limit(10, client_gen)),
-        gen.clients(gen.each(gen.once({"f": "drain"}))),
+        gen.clients(gen.each(lambda: gen.once({"f": "drain"}))),
     )
 
 

@@ -1,0 +1,258 @@
+"""RabbitMQ / hazelcast / galera suite tests against in-process fakes."""
+
+import json
+import socketserver
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from jepsen_tpu import control
+from jepsen_tpu.checker import UNKNOWN
+from jepsen_tpu.history import Op
+from jepsen_tpu.suites import galera, hazelcast, rabbitmq
+
+from test_nemesis import dummy_test, logs
+
+
+def op(f, v=None, p=0):
+    return Op(type="invoke", f=f, value=v, process=p, time=0)
+
+
+# ---------------------------------------------------------------------------
+# Fake RabbitMQ management API
+# ---------------------------------------------------------------------------
+
+
+class FakeRabbitHandler(BaseHTTPRequestHandler):
+    queues = {}
+    lock = threading.Lock()
+    drop_publishes = False
+
+    def log_message(self, *a):
+        pass
+
+    def _reply(self, code, obj):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_PUT(self):  # noqa: N802 — queue declare
+        self._reply(201, {})
+
+    def do_POST(self):  # noqa: N802
+        n = int(self.headers.get("Content-Length", 0))
+        payload = json.loads(self.rfile.read(n).decode())
+        path = urllib.parse.unquote(self.path)
+        with self.lock:
+            if path.endswith("/publish"):
+                if self.drop_publishes:
+                    return self._reply(200, {"routed": False})
+                q = self.queues.setdefault(payload["routing_key"], [])
+                q.append(payload["payload"])
+                return self._reply(200, {"routed": True})
+            if path.endswith("/get"):
+                qname = path.split("/")[-2]
+                q = self.queues.setdefault(qname, [])
+                if not q:
+                    return self._reply(200, [])
+                return self._reply(200, [{"payload": q.pop(0)}])
+        self._reply(404, {})
+
+
+@pytest.fixture()
+def fake_rabbit():
+    FakeRabbitHandler.queues = {}
+    FakeRabbitHandler.drop_publishes = False
+    server = ThreadingHTTPServer(("127.0.0.1", 0), FakeRabbitHandler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield f"127.0.0.1:{server.server_port}"
+    server.shutdown()
+
+
+class TestRabbitQueueClient:
+    def test_enqueue_dequeue_roundtrip(self, fake_rabbit):
+        c = rabbitmq.QueueClient().open({}, fake_rabbit)
+        assert c.invoke({}, op("enqueue", 41)).type == "ok"
+        got = c.invoke({}, op("dequeue"))
+        assert got.type == "ok" and got.value == 41
+        assert c.invoke({}, op("dequeue")).type == "fail"
+
+    def test_unrouted_publish_fails(self, fake_rabbit):
+        FakeRabbitHandler.drop_publishes = True
+        c = rabbitmq.QueueClient().open({}, fake_rabbit)
+        assert c.invoke({}, op("enqueue", 1)).type == "fail"
+
+    def test_drain_writes_history(self, fake_rabbit):
+        from jepsen_tpu.history import History
+        c = rabbitmq.QueueClient().open({}, fake_rabbit)
+        for v in (1, 2):
+            c.invoke({}, op("enqueue", v))
+        hist = History()
+        test = {"_history_lock": threading.Lock(),
+                "_active_histories": [hist]}
+        out = c.invoke(test, op("drain", p=2))
+        assert out.value == "exhausted"
+        assert [o.value for o in hist if o.is_ok] == [1, 2]
+
+    def test_down_broker(self):
+        c = rabbitmq.QueueClient(timeout=0.3).open({}, "127.0.0.1:1")
+        assert c.invoke({}, op("enqueue", 1)).type == "info"
+        assert c.invoke({}, op("dequeue")).type == "fail"
+
+    def test_semaphore_token_cycle(self, fake_rabbit):
+        a = rabbitmq.SemaphoreClient().open({"nodes": []}, fake_rabbit)
+        b = rabbitmq.SemaphoreClient().open({"nodes": []}, fake_rabbit)
+        assert a.invoke({}, op("acquire")).type == "ok"
+        assert b.invoke({}, op("acquire")).type == "fail"  # token taken
+        assert a.invoke({}, op("release")).type == "ok"
+        assert b.invoke({}, op("acquire")).type == "ok"
+
+
+# ---------------------------------------------------------------------------
+# Fake hazelcast shim
+# ---------------------------------------------------------------------------
+
+
+class FakeShim(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self):
+        class H(socketserver.StreamRequestHandler):
+            def handle(hs):
+                while True:
+                    line = hs.rfile.readline()
+                    if not line:
+                        return
+                    hs.wfile.write(
+                        (self.dispatch(line.decode().split()) + "\n")
+                        .encode())
+                    hs.wfile.flush()
+
+        super().__init__(("127.0.0.1", 0), H)
+        self.locks = {}
+        self.ids = 0
+        self.maps = {}
+        self.queues = {}
+        self.lock = threading.Lock()
+
+    def dispatch(self, t):
+        with self.lock:
+            if t[0] == "LOCK":
+                if self.locks.get(t[1]):
+                    return "FAIL"
+                self.locks[t[1]] = True
+                return "OK"
+            if t[0] == "UNLOCK":
+                if not self.locks.get(t[1]):
+                    return "FAIL"
+                self.locks[t[1]] = False
+                return "OK"
+            if t[0] == "ID":
+                self.ids += 1
+                return str(self.ids)
+            if t[0] == "MAPGET":
+                return self.maps.get((t[1], t[2]), "NIL")
+            if t[0] == "MAPPUT":
+                self.maps[(t[1], t[2])] = t[3]
+                return "OK"
+            if t[0] == "MAPCAS":
+                cur = self.maps.get((t[1], t[2]), "NIL")
+                if cur != t[3]:
+                    return "FAIL"
+                self.maps[(t[1], t[2])] = t[4]
+                return "OK"
+            if t[0] == "QOFFER":
+                self.queues.setdefault(t[1], []).append(t[2])
+                return "OK"
+            if t[0] == "QPOLL":
+                q = self.queues.setdefault(t[1], [])
+                return q.pop(0) if q else "NIL"
+            return "ERR"
+
+
+@pytest.fixture()
+def fake_shim():
+    server = FakeShim()
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield f"127.0.0.1:{server.server_address[1]}"
+    server.shutdown()
+
+
+class TestHazelcastWorkloads:
+    def test_lock_client(self, fake_shim):
+        a = hazelcast.LockClient().open({}, fake_shim)
+        b = hazelcast.LockClient().open({}, fake_shim)
+        assert a.invoke({}, op("acquire")).type == "ok"
+        assert b.invoke({}, op("acquire")).type == "fail"
+        assert a.invoke({}, op("release")).type == "ok"
+        assert b.invoke({}, op("acquire")).type == "ok"
+
+    def test_id_clients_unique(self, fake_shim):
+        c = hazelcast.IdClient().open({}, fake_shim)
+        ids = [c.invoke({}, op("generate")).value for _ in range(10)]
+        assert len(set(ids)) == 10
+
+    def test_map_add_read(self, fake_shim):
+        c = hazelcast.MapClient().open({}, fake_shim)
+        for v in (3, 1, 2):
+            assert c.invoke({}, op("add", v)).type == "ok"
+        got = c.invoke({}, op("read"))
+        assert got.value == [1, 2, 3]
+
+    def test_queue_client(self, fake_shim):
+        c = hazelcast.HZQueueClient().open({}, fake_shim)
+        assert c.invoke({}, op("enqueue", 5)).type == "ok"
+        assert c.invoke({}, op("dequeue")).value == 5
+        assert c.invoke({}, op("drain")).type == "fail"
+
+    def test_registry_structure(self):
+        w = hazelcast.workloads()
+        assert set(w) == {"crdt-map", "map", "lock", "queue",
+                          "atomic-ref-ids", "atomic-long-ids",
+                          "id-gen-ids"}
+        t = hazelcast.hazelcast_test({"workload": "lock",
+                                      "time-limit": 1})
+        assert t["name"] == "hazelcast-lock"
+
+    def test_down_shim(self):
+        c = hazelcast.LockClient(timeout=0.3).open({}, "127.0.0.1:1")
+        assert c.invoke({}, op("acquire")).type == "info"
+
+
+class TestGalera:
+    def test_dirty_reads_checker(self):
+        H = [op("write", 1).replace(type="fail"),
+             op("read").replace(type="ok", value=[1, 1]),
+             op("read").replace(type="ok", value=[2, 3])]
+        out = galera.DirtyReadsChecker().check({}, H)
+        assert out["valid"] is False
+        assert out["dirty-reads"] == [[1, 1]]
+        assert out["inconsistent-reads"] == [[2, 3]]
+
+    def test_dirty_reads_checker_clean(self):
+        H = [op("write", 1).replace(type="ok"),
+             op("read").replace(type="ok", value=[1, 1])]
+        out = galera.DirtyReadsChecker().check({}, H)
+        assert out["valid"] is True
+
+    def test_write_txn_sql_shape(self):
+        t = dummy_test()
+        with control.session_pool(t):
+            c = galera.DirtyReadsClient(2).open(t, "n1")
+            assert c.invoke(t, op("write", 7)).type == "ok"
+            stmt = next(cmd for cmd in logs(t)["n1"] if "UPDATE" in cmd)
+            assert "SERIALIZABLE" in stmt and "BEGIN" in stmt
+            assert "SET x = 7" in stmt and "COMMIT" in stmt
+
+    def test_read_parses(self):
+        t = dummy_test(**{"ssh": {"mode": "dummy", "dummy-responses": {
+            "SELECT x FROM dirty": "3\n3\n"}}})
+        with control.session_pool(t):
+            c = galera.DirtyReadsClient(2).open(t, "n1")
+            got = c.invoke(t, op("read"))
+            assert got.type == "ok" and got.value == [3, 3]
