@@ -1,0 +1,113 @@
+"""HTML timeline of per-process operation bars.
+
+Rebuild of jepsen.checker.timeline (jepsen/src/jepsen/checker/timeline.clj):
+one column per process, one box per invoke..complete pair (info ops extend
+to the end of the history), color by completion type, hover titles with
+op details, written to timeline.html in the store (timeline.clj:159-179)."""
+
+from __future__ import annotations
+
+import html as _html
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from jepsen_tpu.checker import Checker
+from jepsen_tpu.history import History, Op
+
+STYLESHEET = """
+body { font-family: sans-serif; }
+.ops { position: absolute; }
+.op { position: absolute; padding: 2px; border-radius: 2px;
+      overflow: hidden; font-size: 10px; }
+.op.ok   { background: #6DB6FE; }
+.op.info { background: #FEFF7F; }
+.op.fail { background: #FEA786; }
+"""
+
+COL_WIDTH = 100
+GUTTER = 106
+HEIGHT = 16
+
+
+def process_index(history: History) -> Dict[Any, int]:
+    """Process -> column, workers first then nemesis
+    (timeline.clj:146-151)."""
+    procs = sorted({o.process for o in history},
+                   key=lambda p: (not isinstance(p, int), str(p)))
+    return {p: i for i, p in enumerate(procs)}
+
+
+def pairs(history: History) -> List[Tuple[Op, Optional[Op]]]:
+    """(invocation, completion-or-None) pairs with sub-indices attached
+    via .index (timeline.clj:153-157 pairs + sub-index)."""
+    out = []
+    open_ops: Dict[Any, Tuple[int, Op]] = {}
+    for i, o in enumerate(history):
+        if o.is_invoke:
+            open_ops[o.process] = (i, o)
+        elif o.process in open_ops:
+            si, inv = open_ops.pop(o.process)
+            out.append((si, inv, i, o))
+    for si, inv in open_ops.values():
+        out.append((si, inv, None, None))
+    out.sort(key=lambda r: r[0])
+    return out
+
+
+def _title(op: Op, start: Op, stop: Optional[Op]) -> str:
+    lat = ((stop.time - start.time) / 1e6
+           if stop is not None and stop.time and start.time else None)
+    bits = [f"process {start.process}", f"f={start.f}",
+            f"value={start.value!r}"]
+    if stop is not None and stop.value != start.value:
+        bits.append(f"returned={stop.value!r}")
+    if lat is not None:
+        bits.append(f"{lat:.2f} ms")
+    if stop is not None and stop.error:
+        bits.append(f"error={stop.error}")
+    return " ".join(str(b) for b in bits)
+
+
+class HTMLTimeline(Checker):
+    """Writes timeline.html; always valid (timeline.clj html)."""
+
+    def check(self, test, history: History, opts=None):
+        opts = opts or {}
+        d = test.get("store-dir")
+        if not d:
+            return {"valid": True, "skipped": "no store dir"}
+        sub = opts.get("subdirectory") or []
+        outdir = os.path.join(d, *map(str, sub))
+        os.makedirs(outdir, exist_ok=True)
+
+        cols = process_index(history)
+        n = len(history)
+        divs = []
+        for si, inv, ei, comp in pairs(history):
+            typ = comp.type if comp is not None else "info"
+            top = HEIGHT * si
+            height = (HEIGHT * ((ei - si) if ei is not None
+                                else (n + 1 - si)))
+            left = GUTTER * cols[inv.process]
+            body = _html.escape(f"{inv.process} {inv.f} {inv.value!r}")
+            title = _html.escape(_title(inv, inv, comp))
+            divs.append(
+                f'<div class="op {typ}" title="{title}" '
+                f'style="width:{COL_WIDTH}px;left:{left}px;top:{top}px;'
+                f'height:{max(height, HEIGHT)}px">{body}</div>')
+
+        name = _html.escape(str(test.get("name", "test")))
+        key = opts.get("history-key")
+        page = (f"<html><head><style>{STYLESHEET}</style></head><body>"
+                f"<h1>{name}"
+                + (f" key {_html.escape(str(key))}" if key is not None
+                   else "")
+                + f'</h1><div class="ops">{"".join(divs)}</div>'
+                  f"</body></html>")
+        with open(os.path.join(outdir, "timeline.html"), "w") as f:
+            f.write(page)
+        return {"valid": True}
+
+
+def html() -> HTMLTimeline:
+    return HTMLTimeline()

@@ -1,0 +1,141 @@
+"""Auto-reconnecting connection wrappers.
+
+Rebuild of jepsen.reconnect (jepsen/src/jepsen/reconnect.clj): a Wrapper
+holds a connection behind a readers-writer discipline — many threads may
+use the current connection concurrently (with_conn), while open/close/
+reopen take the write side. An error inside with_conn closes and reopens
+the connection, then rethrows, so the *next* operation gets a fresh conn
+(reconnect.clj:92-129)."""
+
+from __future__ import annotations
+
+import logging
+import threading
+from contextlib import contextmanager
+from typing import Any, Callable, Optional
+
+log = logging.getLogger("jepsen.reconnect")
+
+
+class _RWLock:
+    """Readers-writer lock (writer-preferring enough for our use)."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._readers = 0
+        self._writer = False
+
+    @contextmanager
+    def read(self):
+        with self._cond:
+            while self._writer:
+                self._cond.wait()
+            self._readers += 1
+        try:
+            yield
+        finally:
+            with self._cond:
+                self._readers -= 1
+                self._cond.notify_all()
+
+    @contextmanager
+    def write(self):
+        with self._cond:
+            while self._writer or self._readers:
+                self._cond.wait()
+            self._writer = True
+        try:
+            yield
+        finally:
+            with self._cond:
+                self._writer = False
+                self._cond.notify_all()
+
+
+class Wrapper:
+    """Stateful reconnecting wrapper (reconnect.clj:16-31)."""
+
+    def __init__(self, open: Callable[[], Any],
+                 close: Callable[[Any], None],
+                 name: Optional[str] = None, log_reconnects: bool = False):
+        assert callable(open) and callable(close)
+        self._open = open
+        self._close = close
+        self.name = name
+        self.log_reconnects = log_reconnects
+        self._lock = _RWLock()
+        self._conn: Optional[Any] = None
+
+    @property
+    def conn(self):
+        return self._conn
+
+    def open(self) -> "Wrapper":
+        """Open a connection; no-op if one exists (reconnect.clj:54-66)."""
+        with self._lock.write():
+            if self._conn is None:
+                c = self._open()
+                if c is None:
+                    raise RuntimeError(
+                        f"Error opening connection for {self.name!r}: "
+                        f"open returned None")
+                self._conn = c
+        return self
+
+    def close(self) -> "Wrapper":
+        """Close the current connection, if any (reconnect.clj:68-75)."""
+        with self._lock.write():
+            if self._conn is not None:
+                try:
+                    self._close(self._conn)
+                finally:
+                    self._conn = None
+        return self
+
+    def reopen(self) -> "Wrapper":
+        """Close (best-effort) and open a fresh connection
+        (reconnect.clj:77-90)."""
+        with self._lock.write():
+            if self._conn is not None:
+                try:
+                    self._close(self._conn)
+                except Exception:  # noqa: BLE001
+                    pass
+                self._conn = None
+            self._conn = self._open()
+        return self
+
+    @contextmanager
+    def with_conn(self):
+        """Yield the current connection; on error, reopen and rethrow
+        (reconnect.clj:92-129)."""
+        with self._lock.read():
+            if self._conn is None:
+                need_open = True
+            else:
+                need_open = False
+        if need_open:
+            self.open()
+        with self._lock.read():
+            c = self._conn
+        try:
+            yield c
+        except Exception:
+            if self.log_reconnects:
+                log.warning("Encountered error with conn %r; reopening",
+                            self.name)
+            # only reopen if nobody else already swapped the conn
+            with self._lock.write():
+                if self._conn is c:
+                    try:
+                        self._close(c)
+                    except Exception:  # noqa: BLE001
+                        pass
+                    self._conn = self._open()
+            raise
+
+
+def wrapper(open: Callable[[], Any], close: Callable[[Any], None],
+            name: Optional[str] = None,
+            log_reconnects: bool = False) -> Wrapper:
+    return Wrapper(open, close, name, log_reconnects)

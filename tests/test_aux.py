@@ -1,0 +1,163 @@
+"""Aux subsystems: reconnect wrapper, HTML timeline, control.net, smartos,
+report, repl."""
+
+import threading
+
+import pytest
+
+from jepsen_tpu import control, reconnect, repl, report
+from jepsen_tpu.checker import timeline
+from jepsen_tpu.history import History, Op
+from jepsen_tpu.os import smartos
+
+from test_nemesis import dummy_test, logs
+
+
+class FlakyConn:
+    instances = []
+
+    def __init__(self):
+        self.closed = False
+        FlakyConn.instances.append(self)
+
+
+class TestReconnect:
+    def setup_method(self):
+        FlakyConn.instances = []
+
+    def wrapper(self):
+        return reconnect.wrapper(
+            open=FlakyConn,
+            close=lambda c: setattr(c, "closed", True),
+            name="test-conn")
+
+    def test_open_idempotent(self):
+        w = self.wrapper()
+        w.open()
+        c1 = w.conn
+        w.open()
+        assert w.conn is c1
+        assert len(FlakyConn.instances) == 1
+
+    def test_with_conn_lazily_opens(self):
+        w = self.wrapper()
+        with w.with_conn() as c:
+            assert isinstance(c, FlakyConn)
+
+    def test_error_reopens_and_rethrows(self):
+        w = self.wrapper()
+        w.open()
+        c1 = w.conn
+        with pytest.raises(RuntimeError):
+            with w.with_conn():
+                raise RuntimeError("boom")
+        assert c1.closed
+        assert w.conn is not c1
+        assert not w.conn.closed
+
+    def test_concurrent_error_reopens_once(self):
+        w = self.wrapper()
+        w.open()
+        c1 = w.conn
+        barrier = threading.Barrier(4)
+        errs = []
+
+        def use():
+            try:
+                with w.with_conn():
+                    barrier.wait(timeout=5)
+                    raise RuntimeError("boom")
+            except RuntimeError:
+                errs.append(1)
+
+        ts = [threading.Thread(target=use) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=10)
+        assert len(errs) == 4
+        # all four failures over the same conn trigger exactly one reopen
+        assert len(FlakyConn.instances) == 2
+        assert w.conn is not c1
+
+    def test_close(self):
+        w = self.wrapper()
+        w.open()
+        c = w.conn
+        w.close()
+        assert c.closed and w.conn is None
+
+
+class TestTimeline:
+    def test_writes_html(self, tmp_path):
+        h = History.of([
+            Op(type="invoke", f="write", value=1, process=0, time=0),
+            Op(type="invoke", f="read", value=None, process=1, time=10),
+            Op(type="ok", f="write", value=1, process=0, time=2_000_000),
+            Op(type="info", f="read", value=None, process=1,
+               time=3_000_000),
+        ])
+        out = timeline.html().check({"store-dir": str(tmp_path),
+                                     "name": "tl"}, h)
+        assert out["valid"] is True
+        page = (tmp_path / "timeline.html").read_text()
+        assert "op ok" in page and "op info" in page
+        assert "write" in page
+
+    def test_no_store_dir_skips(self):
+        out = timeline.html().check({}, History())
+        assert out["valid"] is True
+
+
+class TestControlNet:
+    def test_reachable(self):
+        t = dummy_test()
+        with control.session_pool(t):
+            from jepsen_tpu.control import net as cnet
+            assert cnet.reachable(t, "n1", "n2") is True
+            assert any("ping -w 1 -c 1 n2" in c for c in logs(t)["n1"])
+
+    def test_ip_parses_getent(self):
+        t = dummy_test(**{"ssh": {"mode": "dummy", "dummy-responses": {
+            "getent hosts": "192.168.1.7    n2.cluster"}}})
+        with control.session_pool(t):
+            from jepsen_tpu.control import net as cnet
+            assert cnet.ip(t, "n1", "n2") == "192.168.1.7"
+
+
+class TestSmartOS:
+    def test_installs_missing(self):
+        t = dummy_test(**{"ssh": {"mode": "dummy", "dummy-responses": {
+            "pkgin list": "wget-1.19.1 desc\ncurl-7.55 desc"}}})
+        with control.session_pool(t):
+            smartos.os().setup(t, "n1")
+            inst = next(c for c in logs(t)["n1"]
+                        if "pkgin -y install" in c)
+            assert "vim" in inst and "wget" not in inst
+
+
+class TestReportRepl:
+    def test_report_to_file(self, tmp_path):
+        test = {"store-dir": str(tmp_path)}
+        with report.to(test, "summary.txt"):
+            print("all good")
+        assert (tmp_path / "summary.txt").read_text() == "all good\n"
+
+    def test_repl_last_test_roundtrip(self, tmp_path):
+        from jepsen_tpu import core
+        from jepsen_tpu import generator as gen
+        from jepsen_tpu.checker.wgl import linearizable
+        from jepsen_tpu.models import CASRegister
+        from jepsen_tpu.testing import atom_test
+        t = atom_test(**{"store-root": str(tmp_path),
+                         "concurrency": 2,
+                         "checker": linearizable(CASRegister())})
+        t["generator"] = gen.clients(gen.limit(10, gen.cas_gen()))
+        core.run(t)
+        loaded = repl.last_test(str(tmp_path))
+        assert loaded is not None
+        assert loaded["results"]["valid"] is True
+        assert len(loaded["history"]) > 0
+        # offline recheck over the reloaded history
+        again = repl.recheck(loaded, linearizable(CASRegister()))
+        assert again["valid"] is True
