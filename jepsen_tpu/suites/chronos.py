@@ -1,0 +1,212 @@
+"""Chronos suite — job-scheduler correctness via constraint solving.
+
+Rebuild of chronos/src/jepsen/chronos/: jobs are registered with a start
+time, interval, count, epsilon (allowed lateness) and duration; the
+checker (chronos/checker.clj:20-210) computes, for each job, the target
+intervals that MUST have started by the final read, and asks whether the
+observed runs can satisfy every target with a *distinct* run whose start
+falls inside the target window.
+
+The reference solves this with the loco CSP solver ($distinct indices +
+interval membership). That constraint system is a *convex bipartite
+matching* — each target's feasible runs form a contiguous window of the
+time-sorted run list — for which the greedy algorithm (process targets by
+deadline, take the earliest unused feasible run) yields a maximum
+matching, so the greedy answer here is exactly the CSP's satisfiability
+answer, without a solver dependency."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from jepsen_tpu import client as client_ns
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker import Checker, compose
+from jepsen_tpu.history import Op
+from jepsen_tpu.testing import noop_test
+
+#: Seconds of deadline slack (checker.clj epsilon-forgiveness).
+EPSILON_FORGIVENESS = 5
+
+
+@dataclass(frozen=True)
+class Job:
+    """A scheduled job (chronos.clj jobs are maps with these keys)."""
+
+    name: int
+    start: float        # POSIX seconds
+    interval: float     # seconds between target begins
+    count: int          # how many runs we asked for
+    epsilon: float      # how late a run may begin
+    duration: float     # how long a run takes
+
+
+def job_targets(read_time: float, job: Job) -> List[Tuple[float, float]]:
+    """[(start, deadline)] for targets that must have *begun* by the read
+    (checker.clj:30-47): a run may start up to epsilon late and needs
+    duration to finish, so targets newer than read - epsilon - duration
+    are unconstrained."""
+    finish = read_time - job.epsilon - job.duration
+    out = []
+    t = job.start
+    for _ in range(job.count):
+        if t >= finish:
+            break
+        out.append((t, t + job.epsilon + EPSILON_FORGIVENESS))
+        t += job.interval
+    return out
+
+
+def split_runs(runs: Sequence[dict]) -> Tuple[List[dict], List[dict]]:
+    """(complete, incomplete) runs, each sorted by start
+    (checker.clj:59-76)."""
+    complete = sorted((r for r in runs if r.get("end") is not None),
+                      key=lambda r: r["start"])
+    incomplete = sorted((r for r in runs if r.get("end") is None),
+                        key=lambda r: r["start"])
+    return complete, incomplete
+
+
+def match_targets(targets: Sequence[Tuple[float, float]],
+                  runs: Sequence[dict]) -> Optional[Dict[int, dict]]:
+    """Maximum matching of targets to distinct runs with
+    start <= run.start <= deadline, or None if some target is
+    unsatisfiable. Greedy by deadline over time-sorted runs — exact for
+    this convex structure (see module docstring)."""
+    order = sorted(range(len(targets)), key=lambda i: targets[i][1])
+    runs = sorted(runs, key=lambda r: r["start"])
+    used = [False] * len(runs)
+    out: Dict[int, dict] = {}
+    for i in order:
+        lo, hi = targets[i]
+        chosen = None
+        for j, r in enumerate(runs):
+            if used[j] or r["start"] < lo:
+                continue
+            if r["start"] > hi:
+                break
+            chosen = j
+            break
+        if chosen is None:
+            return None
+        used[chosen] = True
+        out[i] = runs[chosen]
+    return out
+
+
+def job_solution(read_time: float, job: Job,
+                 runs: Sequence[dict]) -> Dict[str, Any]:
+    """Solve one job (checker.clj:122-188)."""
+    targets = job_targets(read_time, job)
+    complete, incomplete = split_runs(runs or [])
+    matching = match_targets(targets, complete)
+    if matching is None:
+        return {"valid": False, "job": job, "solution": None,
+                "extra": None, "complete": complete,
+                "incomplete": incomplete}
+    matched_ids = {id(r) for r in matching.values()}
+    extra = [r for r in complete if id(r) not in matched_ids]
+    return {"valid": True, "job": job,
+            "solution": {targets[i]: r for i, r in sorted(matching.items())},
+            "extra": extra, "complete": complete,
+            "incomplete": incomplete}
+
+
+def solution(read_time: float, jobs: Sequence[Job],
+             runs: Sequence[dict]) -> Dict[str, Any]:
+    """All jobs (checker.clj:190-210): runs grouped by job name."""
+    by_name: Dict[Any, List[dict]] = {}
+    for r in runs:
+        by_name.setdefault(r["name"], []).append(r)
+    solns = {job.name: job_solution(read_time, job,
+                                    by_name.get(job.name, []))
+             for job in jobs}
+    return {
+        "valid": all(s["valid"] for s in solns.values()),
+        "jobs": solns,
+        "extra": [r for s in solns.values() for r in (s["extra"] or [])],
+        "incomplete": [r for s in solns.values() for r in s["incomplete"]],
+        "read-time": read_time,
+    }
+
+
+class ChronosChecker(Checker):
+    """History checker: 'add-job' ok ops carry Job values; the final ok
+    'read' carries {'time': read_time, 'runs': [{'name','start','end'}]}
+    (chronos/checker.clj:212+)."""
+
+    def check(self, test, history, opts=None):
+        jobs = [op.value for op in history
+                if op.f == "add-job" and op.is_ok]
+        final = None
+        for op in history:
+            if op.f == "read" and op.is_ok and op.value is not None:
+                final = op.value
+        if final is None:
+            return {"valid": "unknown", "error": "runs were never read"}
+        out = solution(final["time"], jobs, final["runs"])
+        out["valid"] = bool(out["valid"])
+        return out
+
+
+def chronos_checker() -> ChronosChecker:
+    return ChronosChecker()
+
+
+class ChronosClient(client_ns.Client):
+    """Job registration over the chronos HTTP API
+    (chronos.clj add-job! posts ISO8601 schedules)."""
+
+    def __init__(self, node=None, port: int = 4400, timeout: float = 10.0):
+        self.node = node
+        self.port = port
+        self.timeout = timeout
+
+    def open(self, test, node):
+        return ChronosClient(node, self.port, self.timeout)
+
+    def _url(self, path):
+        node = str(self.node)
+        authority = node if ":" in node else f"{node}:{self.port}"
+        return f"http://{authority}{path}"
+
+    def invoke(self, test, op: Op) -> Op:
+        import time as _time
+        try:
+            if op.f == "add-job":
+                j: Job = op.value
+                body = json.dumps({
+                    "name": str(j.name),
+                    "schedule": f"R{j.count}/"
+                                f"{_iso(j.start)}/PT{int(j.interval)}S",
+                    "epsilon": f"PT{int(j.epsilon)}S",
+                    "command": f"sleep {int(j.duration)}",
+                }).encode()
+                req = urllib.request.Request(
+                    self._url("/scheduler/iso8601"), data=body,
+                    method="POST",
+                    headers={"Content-Type": "application/json"})
+                urllib.request.urlopen(req, timeout=self.timeout)
+                return op.replace(type="ok")
+            if op.f == "read":
+                with urllib.request.urlopen(
+                        self._url("/scheduler/jobs"),
+                        timeout=self.timeout) as resp:
+                    json.loads(resp.read().decode())
+                # run logs come from the run-capture files on nodes; the
+                # in-memory fake (tests) returns them directly
+                return op.replace(type="ok",
+                                  value={"time": _time.time(), "runs": []})
+            raise ValueError(f"unknown op {op.f!r}")
+        except (OSError, TimeoutError) as e:
+            crash = "fail" if op.f == "read" else "info"
+            return op.replace(type=crash, error=type(e).__name__)
+
+
+def _iso(posix: float) -> str:
+    import datetime
+    return (datetime.datetime.fromtimestamp(posix, datetime.timezone.utc)
+            .strftime("%Y-%m-%dT%H:%M:%SZ"))

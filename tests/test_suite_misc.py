@@ -1,0 +1,189 @@
+"""Chronos CSP checker, aerospike client/taxonomy, mongodb model/client."""
+
+import pytest
+
+from jepsen_tpu import control
+from jepsen_tpu.history import History, Op
+from jepsen_tpu.models.core import is_inconsistent
+from jepsen_tpu.suites import aerospike, chronos, mongodb
+
+from test_nemesis import dummy_test, logs
+
+
+def op(f, v=None, p=0):
+    return Op(type="invoke", f=f, value=v, process=p, time=0)
+
+
+class TestChronosChecker:
+    def job(self, **kw):
+        base = dict(name=0, start=100.0, interval=60.0, count=3,
+                    epsilon=10.0, duration=5.0)
+        base.update(kw)
+        return chronos.Job(**base)
+
+    def test_targets_cut_off_by_read_time(self):
+        j = self.job()
+        # read at 250: targets at 100 and 160 must have begun
+        # (235 - eps 10 - dur 5 = 235; 220 < 235 but 220 >= finish? no:)
+        ts = chronos.job_targets(250.0, j)
+        assert [t[0] for t in ts] == [100.0, 160.0, 220.0]
+        ts2 = chronos.job_targets(180.0, j)
+        assert [t[0] for t in ts2] == [100.0, 160.0]
+
+    def test_satisfied_job(self):
+        j = self.job()
+        runs = [{"name": 0, "start": 101.0, "end": 106.0},
+                {"name": 0, "start": 161.0, "end": 166.0},
+                {"name": 0, "start": 221.0, "end": 226.0}]
+        out = chronos.job_solution(300.0, j, runs)
+        assert out["valid"] is True
+        assert out["extra"] == []
+
+    def test_missing_run_invalid(self):
+        j = self.job()
+        runs = [{"name": 0, "start": 101.0, "end": 106.0},
+                {"name": 0, "start": 221.0, "end": 226.0}]
+        out = chronos.job_solution(300.0, j, runs)
+        assert out["valid"] is False
+
+    def test_incomplete_runs_dont_count(self):
+        j = self.job(count=1)
+        runs = [{"name": 0, "start": 101.0, "end": None}]
+        out = chronos.job_solution(200.0, j, runs)
+        assert out["valid"] is False
+        assert len(out["incomplete"]) == 1
+
+    def test_greedy_matching_is_maximal(self):
+        # two overlapping targets; a naive first-fit on target order could
+        # burn the only run that satisfies the tighter target
+        targets = [(0.0, 100.0), (0.0, 10.0)]
+        runs = [{"start": 5.0}, {"start": 50.0}]
+        m = chronos.match_targets(targets, runs)
+        assert m is not None
+        assert m[1]["start"] == 5.0   # tight target gets the early run
+        assert m[0]["start"] == 50.0
+
+    def test_history_checker(self):
+        j = self.job(count=1)
+        h = History.of([
+            op("add-job", j).replace(type="ok"),
+            op("read").replace(type="ok", value={
+                "time": 300.0,
+                "runs": [{"name": 0, "start": 102.0, "end": 107.0}]}),
+        ])
+        out = chronos.chronos_checker().check({}, h)
+        assert out["valid"] is True
+
+    def test_never_read_unknown(self):
+        out = chronos.chronos_checker().check({}, History())
+        assert out["valid"] == "unknown"
+
+
+AQL_ROW = """
++-------+
+| value |
++-------+
+| 3     |
++-------+
+{"gen": 7}
+"""
+
+
+class TestAerospike:
+    def test_cas_register_ops(self):
+        from jepsen_tpu import independent
+        t = dummy_test(**{"ssh": {"mode": "dummy", "dummy-responses": {
+            "SELECT value": AQL_ROW}}})
+        with control.session_pool(t):
+            c = aerospike.CasRegisterClient().open(t, "n1")
+            got = c.invoke(t, op("read", independent.tuple_(0, None)))
+            assert got.type == "ok" and got.value.value == 3
+            out = c.invoke(t, op("cas", independent.tuple_(0, (3, 5))))
+            assert out.type == "ok"
+            assert any("gen_equal = 7" in cmd for cmd in logs(t)["n1"])
+            out = c.invoke(t, op("cas", independent.tuple_(0, (4, 5))))
+            assert out.type == "fail"
+
+    def test_error_taxonomy(self):
+        e = RuntimeError("error: FAIL_GENERATION")
+        assert aerospike.with_errors(op("cas"), e).type == "fail"
+        e = RuntimeError("socket timeout")
+        assert aerospike.with_errors(op("read"), e).type == "fail"
+        assert aerospike.with_errors(op("write"), e).type == "info"
+        e = RuntimeError("record not found")
+        assert aerospike.with_errors(op("cas"), e).error == "not-found"
+
+    def test_roster_parsing(self):
+        t = dummy_test(**{"ssh": {"mode": "dummy", "dummy-responses": {
+            "roster:namespace": "roster=null:pending_roster=null:"
+                                "observed_nodes=BB9,BB8,BB7"}}})
+        with control.session_pool(t):
+            assert aerospike.observed_nodes(t, "n1") == "BB9,BB8,BB7"
+
+
+class TestMongoModel:
+    def test_transfer_steps(self):
+        m = mongodb.AccountsModel((10, 10))
+        m2 = m.step(op("transfer", {"from": 0, "to": 1, "amount": 4}))
+        assert m2.balances == (6, 14)
+        bad = m2.step(op("transfer", {"from": 0, "to": 1, "amount": 100}))
+        assert is_inconsistent(bad)
+
+    def test_read_steps(self):
+        m = mongodb.AccountsModel((5, 15))
+        assert m.step(op("read", [5, 15])) is m
+        assert is_inconsistent(m.step(op("read", [10, 10])))
+
+    def test_linearizable_with_accounts_model(self):
+        from jepsen_tpu.checker.wgl import check_model
+        h = History.of([
+            op("transfer", {"from": 0, "to": 1, "amount": 3}, p=0),
+            Op(type="ok", f="transfer", value=None, process=0, time=1),
+            op("read", None, p=1).replace(time=2),
+            Op(type="ok", f="read", value=[7, 13], process=1, time=3),
+        ])
+        assert check_model(h, mongodb.AccountsModel((10, 10)))["valid"] \
+            is True
+        h2 = History.of([
+            op("transfer", {"from": 0, "to": 1, "amount": 3}, p=0),
+            Op(type="ok", f="transfer", value=None, process=0, time=1),
+            op("read", None, p=1).replace(time=2),
+            Op(type="ok", f="read", value=[10, 10], process=1, time=3),
+        ])
+        assert check_model(h2, mongodb.AccountsModel((10, 10)))["valid"] \
+            is False
+
+
+class TestMongoClient:
+    def test_document_cas(self):
+        from jepsen_tpu import independent
+        t = dummy_test(**{"ssh": {"mode": "dummy", "dummy-responses": {
+            "find(": '[{"_id": 0, "value": 4}]',
+            "findAndModify": '{"_id": 0, "value": 4}',
+        }}})
+        with control.session_pool(t):
+            c = mongodb.DocumentCASClient().open(t, "n1")
+            got = c.invoke(t, op("read", independent.tuple_(0, None)))
+            assert got.type == "ok" and got.value.value == 4
+            out = c.invoke(t, op("cas", independent.tuple_(0, (4, 9))))
+            assert out.type == "ok"
+            assert c.invoke(
+                t, op("write", independent.tuple_(0, 5))).type == "ok"
+            wc = next(cmd for cmd in logs(t)["n1"] if "update(" in cmd)
+            assert 'writeConcern: {w: "majority"}' in wc
+
+    def test_transfer_ok_fail(self):
+        t = dummy_test(**{"ssh": {"mode": "dummy", "dummy-responses": {
+            "insertOne": "OK"}}})
+        with control.session_pool(t):
+            c = mongodb.TransferClient(2, 10).open(t, "n1")
+            out = c.invoke(t, op("transfer",
+                                 {"from": 0, "to": 1, "amount": 2}))
+            assert out.type == "ok"
+        t2 = dummy_test(**{"ssh": {"mode": "dummy", "dummy-responses": {
+            "insertOne": "FAIL"}}})
+        with control.session_pool(t2):
+            c = mongodb.TransferClient(2, 10).open(t2, "n1")
+            out = c.invoke(t2, op("transfer",
+                                  {"from": 0, "to": 1, "amount": 2}))
+            assert out.type == "fail"
