@@ -24,8 +24,27 @@ def registry() -> Dict[str, Callable[[dict], dict]]:
     import importlib
     for name, mod, attr in (
             ("rabbitmq", "rabbitmq", "rabbitmq_test"),
+            ("rabbitmq-mutex", "rabbitmq", "mutex_test"),
             ("hazelcast", "hazelcast", "hazelcast_test"),
-            ("cockroachdb", "cockroachdb", "register_test")):
+            ("cockroachdb", "cockroachdb", "register_test"),
+            ("cockroachdb-bank", "cockroachdb", "bank_test"),
+            ("cockroachdb-sets", "cockroachdb", "sets_test"),
+            ("galera", "galera", "dirty_reads_test"),
+            ("aerospike", "aerospike", "cas_register_test"),
+            ("aerospike-counter", "aerospike", "counter_test"),
+            ("mongodb", "mongodb", "document_cas_test"),
+            ("mongodb-transfer", "mongodb", "transfer_test"),
+            ("mongodb-rocks", "small", "mongodb_rocks_test"),
+            ("elasticsearch", "elasticsearch", "dirty_read_test"),
+            ("tidb", "sql_family", "tidb_bank_test"),
+            ("percona", "sql_family", "percona_dirty_reads_test"),
+            ("mysql-cluster", "sql_family", "mysql_cluster_bank_test"),
+            ("postgres-rds", "sql_family", "postgres_rds_bank_test"),
+            ("crate", "sql_family", "crate_version_divergence_test"),
+            ("logcabin", "small", "logcabin_test"),
+            ("robustirc", "small", "robustirc_test"),
+            ("rethinkdb", "small", "rethinkdb_test"),
+            ("ravendb", "small", "ravendb_test")):
         try:
             m = importlib.import_module(f"jepsen_tpu.suites.{mod}")
             out[name] = getattr(m, attr)

@@ -1,0 +1,205 @@
+"""Elasticsearch suite — sets and dirty reads.
+
+Rebuild of elasticsearch/src/jepsen/system/elasticsearch*: documents
+indexed over HTTP; the dirty-read checker (dirty_read.clj:106-157)
+compares normal reads against per-node *strong reads* taken after
+recovery: a read of a doc absent from every strong read is dirty, an
+acked write absent from all strong reads is lost, and nodes must agree."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Any, Set
+
+from jepsen_tpu import client as client_ns
+from jepsen_tpu import db as db_ns
+from jepsen_tpu import generator as gen
+from jepsen_tpu import nemesis
+from jepsen_tpu.checker import Checker, compose, set_checker
+from jepsen_tpu.history import Op
+from jepsen_tpu.os import debian
+from jepsen_tpu.testing import noop_test
+
+PORT = 9200
+INDEX = "jepsen"
+
+
+def _url(node, path):
+    node = str(node)
+    authority = node if ":" in node else f"{node}:{PORT}"
+    return f"http://{authority}{path}"
+
+
+class ESDB(db_ns.DB, db_ns.LogFiles):
+    def setup(self, test, node):
+        from jepsen_tpu import control
+        debian.install(test, node, ["elasticsearch"])
+        hosts = ", ".join(f'"{n}"' for n in test["nodes"])
+        cfg = (f"discovery.zen.ping.unicast.hosts: [{hosts}]\n"
+               f"network.host: 0.0.0.0\n"
+               f"cluster.name: jepsen\n")
+        with control.sudo():
+            control.execute(
+                test, node,
+                f"echo {control.escape(cfg)} >> "
+                f"/etc/elasticsearch/elasticsearch.yml")
+            control.exec(test, node, "service", "elasticsearch", "restart")
+
+    def teardown(self, test, node):
+        from jepsen_tpu import control
+        with control.sudo():
+            control.execute(test, node,
+                            "service elasticsearch stop || true")
+            control.execute(test, node,
+                            "rm -rf /var/lib/elasticsearch/* || true")
+
+    def log_files(self, test, node):
+        return ["/var/log/elasticsearch/jepsen.log"]
+
+
+class ESClient(client_ns.Client):
+    """write = index doc by id; read = get by id; strong-read = refresh +
+    match_all scan (dirty_read.clj client)."""
+
+    def __init__(self, node=None, timeout: float = 5.0):
+        self.node = node
+        self.timeout = timeout
+
+    def open(self, test, node):
+        return ESClient(node, self.timeout)
+
+    def _req(self, path, method="GET", payload=None):
+        body = json.dumps(payload).encode() if payload is not None else None
+        req = urllib.request.Request(_url(self.node, path), data=body,
+                                     method=method,
+                                     headers={"Content-Type":
+                                              "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return json.loads(resp.read().decode() or "null")
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "write":
+                out = self._req(
+                    f"/{INDEX}/doc/{int(op.value)}"
+                    "?consistency=quorum", "PUT", {"v": int(op.value)})
+                ok = out.get("created") or out.get("result") == "created" \
+                    or out.get("_version")
+                return op.replace(type="ok" if ok else "fail")
+            if op.f == "read":
+                try:
+                    out = self._req(f"/{INDEX}/doc/{int(op.value)}")
+                except urllib.error.HTTPError as e:
+                    if e.code == 404:
+                        return op.replace(type="fail", error="not-found")
+                    raise
+                return (op.replace(type="ok") if out.get("found")
+                        else op.replace(type="fail", error="not-found"))
+            if op.f == "strong-read":
+                self._req(f"/{INDEX}/_refresh", "POST")
+                out = self._req(f"/{INDEX}/_search?size=10000", "POST",
+                                {"query": {"match_all": {}}})
+                hits = out.get("hits", {}).get("hits", [])
+                vals = sorted(int(h["_id"]) for h in hits)
+                return op.replace(type="ok", value=set(vals))
+            raise ValueError(f"unknown op {op.f!r}")
+        except urllib.error.HTTPError as e:
+            crash = "fail" if op.f != "write" else "info"
+            return op.replace(type=crash, error=f"http-{e.code}")
+        except (TimeoutError, OSError) as e:
+            crash = "fail" if op.f != "write" else "info"
+            return op.replace(type=crash, error=type(e).__name__)
+
+
+class DirtyReadChecker(Checker):
+    """Strong-read set algebra (dirty_read.clj:106-157)."""
+
+    def check(self, test, history, opts=None):
+        ok = [o for o in history if o.is_ok]
+        writes = {o.value for o in ok if o.f == "write"}
+        reads = {o.value for o in ok if o.f == "read"}
+        strong = [set(o.value) for o in ok if o.f == "strong-read"
+                  and o.value is not None]
+        if not strong:
+            return {"valid": "unknown", "error": "no strong reads"}
+        on_all = set.intersection(*strong)
+        on_some = set.union(*strong)
+        dirty = reads - on_some
+        lost = writes - on_some
+        some_lost = writes - on_all
+        nodes_agree = on_all == on_some
+        return {
+            "valid": bool(nodes_agree and not dirty and not lost),
+            "nodes-agree": nodes_agree,
+            "read-count": len(reads),
+            "on-all-count": len(on_all),
+            "on-some-count": len(on_some),
+            "not-on-all": sorted(on_some - on_all, key=repr),
+            "dirty": sorted(dirty, key=repr),
+            "lost": sorted(lost, key=repr),
+            "some-lost": sorted(some_lost, key=repr),
+        }
+
+
+def dirty_read_checker() -> DirtyReadChecker:
+    return DirtyReadChecker()
+
+
+def dirty_read_test(opts: dict) -> dict:
+    """rw-generator probing in-flight writes, final strong read per client
+    (dirty_read.clj:159+)."""
+    import itertools
+    import random as _r
+    counter = itertools.count()
+    recent: list = []
+
+    def write(test, process):
+        v = next(counter)
+        recent.append(v)
+        del recent[:-100]
+        return {"type": "invoke", "f": "write", "value": v}
+
+    def read(test, process):
+        if not recent:
+            return {"type": "invoke", "f": "write", "value": next(counter)}
+        return {"type": "invoke", "f": "read",
+                "value": _r.choice(recent)}
+
+    test = noop_test()
+    test.update({
+        "name": "elasticsearch-dirty-read",
+        "os": debian.os(),
+        "db": ESDB(),
+        "client": ESClient(),
+        "nemesis": nemesis.partition_random_halves(),
+        "checker": compose({"dirty-read": dirty_read_checker()}),
+        "generator": gen.phases(
+            gen.time_limit(
+                opts.get("time-limit", 60),
+                gen.clients(gen.mix([write, read]),
+                            gen.seq(_nemesis_cycle()))),
+            gen.nemesis(gen.once({"type": "info", "f": "stop"})),
+            gen.sleep(10),
+            gen.clients(gen.each(
+                lambda: gen.once({"f": "strong-read", "value": None})))),
+    })
+    test.update({k: v for k, v in opts.items()
+                 if k in ("nodes", "concurrency", "ssh", "time-limit",
+                          "store-dir", "store-root", "net")})
+    return test
+
+
+def _nemesis_cycle():
+    while True:
+        yield gen.sleep(10)
+        yield gen.once({"type": "info", "f": "start"})
+        yield gen.sleep(10)
+        yield gen.once({"type": "info", "f": "stop"})
+
+
+def main(argv=None):
+    from jepsen_tpu import cli
+    cli.main(cli.merge_commands(cli.single_test_cmd(dirty_read_test),
+                                cli.serve_cmd()), argv)

@@ -1,0 +1,452 @@
+"""Small suites: logcabin, robustirc, rethinkdb, ravendb, mongodb-rocks.
+
+Reference counterparts:
+- logcabin/: linearizable CAS register over a Raft KV, driven with the
+  logcabin client binary (logcabin.clj)
+- robustirc/: a grow-only set written as IRC messages and read back from
+  the channel log (robustirc.clj:213-215) — the client here speaks the
+  IRC wire protocol over a stdlib socket
+- rethinkdb/: per-key document CAS with a write/read-acks matrix and a
+  reconfigure nemesis (rethinkdb.clj, document_cas.clj:146-148)
+- ravendb/: register over the HTTP document API (ravendb suite)
+- mongodb-rocks/: the mongodb document-cas test re-parameterized for the
+  RocksDB storage engine (mongodb_rocks.clj:5)
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import urllib.error
+import urllib.request
+from typing import Any, List, Optional
+
+from jepsen_tpu import client as client_ns
+from jepsen_tpu import control
+from jepsen_tpu import db as db_ns
+from jepsen_tpu import generator as gen
+from jepsen_tpu import nemesis
+from jepsen_tpu.checker import compose, perf, set_checker
+from jepsen_tpu.checker.wgl import linearizable
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.history import Op
+from jepsen_tpu.models import CASRegister
+from jepsen_tpu.suites import workloads as wl
+from jepsen_tpu.testing import noop_test
+
+# ---------------------------------------------------------------------------
+# LogCabin
+# ---------------------------------------------------------------------------
+
+
+class LogCabinClient(client_ns.Client):
+    """CAS register via the logcabin CLI's conditional write
+    (logcabin.clj client)."""
+
+    KEY = "/jepsen"
+
+    def __init__(self, node=None):
+        self.node = node
+
+    def open(self, test, node):
+        c = LogCabinClient()
+        c.node = node
+        return c
+
+    def _cli(self, test, *args, stdin=None):
+        cluster = ",".join(f"{n}:5254" for n in test["nodes"])
+        return control.exec(test, self.node, "logcabin",
+                            "--cluster", cluster, *args, stdin=stdin)
+
+    def invoke(self, test, op: Op) -> Op:
+        crash = "fail" if op.f == "read" else "info"
+        try:
+            if op.f == "read":
+                out = self._cli(test, "read", self.KEY)
+                v = int(out) if out.strip() else None
+                return op.replace(type="ok", value=v)
+            if op.f == "write":
+                self._cli(test, "write", self.KEY, stdin=str(op.value))
+                return op.replace(type="ok")
+            if op.f == "cas":
+                old, new = op.value
+                try:
+                    self._cli(test, "write", "--condition",
+                              f"{self.KEY}:{old}", self.KEY,
+                              stdin=str(new))
+                    return op.replace(type="ok")
+                except control.RemoteError:
+                    return op.replace(type="fail")
+            raise ValueError(f"unknown op {op.f!r}")
+        except control.RemoteError as e:
+            return op.replace(type=crash, error=str(e)[:80])
+
+
+def logcabin_test(opts: dict) -> dict:
+    test = noop_test()
+    test.update({
+        "name": "logcabin",
+        "client": LogCabinClient(),
+        "nemesis": nemesis.partition_random_halves(),
+        "model": CASRegister(),
+        "checker": compose({
+            "perf": perf(),
+            "linear": linearizable(CASRegister(),
+                                   backend=opts.get("backend", "cpu"))}),
+        "generator": gen.time_limit(
+            opts.get("time-limit", 60),
+            gen.clients(gen.stagger(1 / 10, wl.register_gen()),
+                        gen.seq(_cycle()))),
+    })
+    test.update({k: v for k, v in opts.items()
+                 if k in ("nodes", "concurrency", "ssh", "time-limit",
+                          "store-dir", "store-root", "net")})
+    return test
+
+
+# ---------------------------------------------------------------------------
+# RobustIRC
+# ---------------------------------------------------------------------------
+
+
+class IRCClient(client_ns.Client):
+    """Set-over-IRC: add = PRIVMSG an integer to the channel, read =
+    collect the channel backlog (robustirc.clj:213-215). Speaks minimal
+    IRC over a stdlib socket."""
+
+    CHANNEL = "#jepsen"
+
+    def __init__(self, node=None, port: int = 6667, timeout: float = 5.0):
+        self.node = node
+        self.port = port
+        self.timeout = timeout
+        self.sock: Optional[socket.socket] = None
+        self._rf = None
+        self.seen: List[int] = []
+
+    def open(self, test, node):
+        c = IRCClient(node, self.port, self.timeout)
+        return c
+
+    def _connect(self):
+        host = str(self.node)
+        if ":" in host:
+            host, port = host.rsplit(":", 1)
+        else:
+            port = self.port
+        self.sock = socket.create_connection((host, int(port)),
+                                             self.timeout)
+        self.sock.settimeout(self.timeout)
+        self._rf = self.sock.makefile("rb")
+        nick = f"jepsen{id(self) % 10000}"
+        self.sock.sendall(
+            f"NICK {nick}\r\nUSER {nick} 0 * :jepsen\r\n"
+            f"JOIN {self.CHANNEL}\r\n".encode())
+
+    def _pump(self, deadline_lines: int = 50):
+        """Read pending lines, answering PINGs and collecting channel
+        messages."""
+        for _ in range(deadline_lines):
+            try:
+                line = self._rf.readline()
+            except (TimeoutError, OSError):
+                return
+            if not line:
+                return
+            text = line.decode("utf-8", "replace").strip()
+            if text.startswith("PING"):
+                self.sock.sendall(
+                    ("PONG" + text[4:] + "\r\n").encode())
+            if f"PRIVMSG {self.CHANNEL}" in text:
+                payload = text.rsplit(":", 1)[-1].strip()
+                if payload.isdigit():
+                    self.seen.append(int(payload))
+
+    def close(self, test):
+        if self.sock:
+            try:
+                self.sock.close()
+            finally:
+                self.sock = None
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if self.sock is None:
+                self._connect()
+            if op.f == "add":
+                self.sock.sendall(
+                    f"PRIVMSG {self.CHANNEL} :{int(op.value)}\r\n"
+                    .encode())
+                return op.replace(type="ok")
+            if op.f == "read":
+                self._pump()
+                return op.replace(type="ok", value=sorted(set(self.seen)))
+            raise ValueError(f"unknown op {op.f!r}")
+        except (TimeoutError, OSError) as e:
+            self.close(test)
+            t = "fail" if op.f == "read" else "info"
+            return op.replace(type=t, error=type(e).__name__)
+
+
+def robustirc_test(opts: dict) -> dict:
+    import itertools
+    counter = itertools.count()
+
+    def add(test, process):
+        return {"type": "invoke", "f": "add", "value": next(counter)}
+
+    test = noop_test()
+    test.update({
+        "name": "robustirc",
+        "client": IRCClient(),
+        "nemesis": nemesis.partition_random_halves(),
+        "checker": compose({"set": set_checker()}),
+        "generator": gen.phases(
+            gen.time_limit(opts.get("time-limit", 60),
+                           gen.clients(gen.stagger(1 / 5, add),
+                                       gen.seq(_cycle()))),
+            gen.nemesis(gen.once({"type": "info", "f": "stop"})),
+            gen.sleep(10),
+            gen.clients(gen.each(
+                lambda: gen.once({"f": "read", "value": None})))),
+    })
+    test.update({k: v for k, v in opts.items()
+                 if k in ("nodes", "concurrency", "ssh", "time-limit",
+                          "store-dir", "store-root", "net")})
+    return test
+
+
+# ---------------------------------------------------------------------------
+# RethinkDB
+# ---------------------------------------------------------------------------
+
+
+class RethinkDB(db_ns.DB, db_ns.LogFiles):
+    """rethinkdb.clj db: apt install, join flags, admin over the first
+    node."""
+
+    def setup(self, test, node):
+        from jepsen_tpu.os import debian
+        debian.install(test, node, ["rethinkdb"])
+        joins = " ".join(f"--join {n}:29015" for n in test["nodes"]
+                         if n != node)
+        cu.start_daemon(test, node, "/usr/bin/rethinkdb",
+                        "--bind", "all", *joins.split(),
+                        logfile="/var/log/rethinkdb.log",
+                        pidfile="/var/run/rethinkdb.pid", chdir="/var/lib")
+
+    def teardown(self, test, node):
+        cu.stop_daemon(test, node, "/var/run/rethinkdb.pid",
+                       cmd="rethinkdb")
+        control.execute(test, node,
+                        "rm -rf /var/lib/rethinkdb_data || true")
+
+    def log_files(self, test, node):
+        return ["/var/log/rethinkdb.log"]
+
+
+def reconfigure_nemesis():
+    """rethinkdb.clj reconfigure nemesis: shuffle replicas/primaries via
+    the admin API on a random node."""
+    import random as _r
+
+    class Reconfigure(nemesis.Nemesis):
+        def invoke(self, test, op):
+            node = _r.choice(test["nodes"])
+            shards = _r.randrange(1, 5)
+            replicas = _r.randrange(1, len(test["nodes"]) + 1)
+            control.execute(
+                test, node,
+                f"rethinkdb admin --join {node}:29015 reconfigure "
+                f"jepsen.cas --shards {shards} --replicas {replicas} "
+                f"|| true")
+            return op.replace(type="info",
+                              value={"shards": shards,
+                                     "replicas": replicas})
+
+    return Reconfigure()
+
+
+class RethinkClient(client_ns.Client):
+    """Document CAS via ReQL executed with the driver on the *node* (the
+    control plane ships a short python snippet; document_cas.clj:146-148
+    does the same update-if-current logic via the JVM driver)."""
+
+    def __init__(self, node=None, write_acks: str = "majority"):
+        self.node = node
+        self.write_acks = write_acks
+
+    def open(self, test, node):
+        return RethinkClient(node, self.write_acks)
+
+    def _reql(self, test, expr: str) -> str:
+        script = (
+            "import json, rethinkdb as r\n"
+            f"c = r.connect('{self.node}', 28015)\n"
+            f"print(json.dumps({expr}))\n")
+        return control.execute(
+            test, self.node, f"python3 -c {control.escape(script)}")
+
+    def invoke(self, test, op: Op) -> Op:
+        crash = "fail" if op.f == "read" else "info"
+        try:
+            if op.f == "read":
+                out = self._reql(
+                    test, "r.db('jepsen').table('cas').get(0).run(c)")
+                doc = json.loads(out or "null")
+                return op.replace(type="ok",
+                                  value=doc.get("v") if doc else None)
+            if op.f == "write":
+                self._reql(
+                    test,
+                    "r.db('jepsen').table('cas').insert("
+                    f"{{'id': 0, 'v': {int(op.value)}}}, "
+                    "conflict='replace').run(c)")
+                return op.replace(type="ok")
+            if op.f == "cas":
+                old, new = op.value
+                out = self._reql(
+                    test,
+                    "r.db('jepsen').table('cas').get(0).update("
+                    f"lambda row: r.branch(row['v'].eq({int(old)}), "
+                    f"{{'v': {int(new)}}}, r.error('abort')), "
+                    "return_changes=True).run(c)")
+                res = json.loads(out or "{}")
+                return op.replace(
+                    type="ok" if res.get("replaced") else "fail")
+            raise ValueError(f"unknown op {op.f!r}")
+        except control.RemoteError as e:
+            return op.replace(type=crash, error=str(e)[:80])
+
+
+def rethinkdb_test(opts: dict) -> dict:
+    """Document CAS with the write/read-acks matrix (rethinkdb.clj,
+    document_cas.clj) and a reconfigure nemesis."""
+    test = noop_test()
+    test.update({
+        "name": f"rethinkdb-{opts.get('write-acks', 'majority')}",
+        "db": RethinkDB(),
+        "client": RethinkClient(write_acks=opts.get("write-acks",
+                                                    "majority")),
+        "nemesis": reconfigure_nemesis(),
+        "model": CASRegister(),
+        "checker": compose({
+            "perf": perf(),
+            "linear": linearizable(CASRegister(),
+                                   backend=opts.get("backend", "cpu"))}),
+        "generator": gen.time_limit(
+            opts.get("time-limit", 60),
+            gen.clients(gen.stagger(1 / 10, wl.register_gen()),
+                        gen.seq(_cycle()))),
+    })
+    test.update({k: v for k, v in opts.items()
+                 if k in ("nodes", "concurrency", "ssh", "time-limit",
+                          "store-dir", "store-root", "net")})
+    return test
+
+
+# ---------------------------------------------------------------------------
+# RavenDB
+# ---------------------------------------------------------------------------
+
+
+class RavenClient(client_ns.Client):
+    """Register over the RavenDB HTTP document API (ravendb suite)."""
+
+    def __init__(self, node=None, port: int = 8080, timeout: float = 5.0):
+        self.node = node
+        self.port = port
+        self.timeout = timeout
+
+    def open(self, test, node):
+        return RavenClient(node, self.port, self.timeout)
+
+    def _url(self, path):
+        node = str(self.node)
+        authority = node if ":" in node else f"{node}:{self.port}"
+        return f"http://{authority}{path}"
+
+    def invoke(self, test, op: Op) -> Op:
+        crash = "fail" if op.f == "read" else "info"
+        try:
+            if op.f == "read":
+                try:
+                    with urllib.request.urlopen(
+                            self._url("/databases/jepsen/docs?id=register"),
+                            timeout=self.timeout) as resp:
+                        doc = json.loads(resp.read().decode())
+                    return op.replace(type="ok",
+                                      value=doc.get("value"))
+                except urllib.error.HTTPError as e:
+                    if e.code == 404:
+                        return op.replace(type="ok", value=None)
+                    raise
+            if op.f == "write":
+                body = json.dumps({"value": op.value}).encode()
+                req = urllib.request.Request(
+                    self._url("/databases/jepsen/docs?id=register"),
+                    data=body, method="PUT",
+                    headers={"Content-Type": "application/json"})
+                urllib.request.urlopen(req, timeout=self.timeout)
+                return op.replace(type="ok")
+            raise ValueError(f"unknown op {op.f!r}")
+        except (urllib.error.URLError, TimeoutError, OSError) as e:
+            return op.replace(type=crash, error=type(e).__name__)
+
+
+def ravendb_test(opts: dict) -> dict:
+    test = noop_test()
+    test.update({
+        "name": "ravendb",
+        "client": RavenClient(),
+        "nemesis": nemesis.partition_random_halves(),
+        "model": CASRegister(),
+        "checker": compose({
+            "perf": perf(),
+            "linear": linearizable(CASRegister(),
+                                   backend=opts.get("backend", "cpu"))}),
+        "generator": gen.time_limit(
+            opts.get("time-limit", 60),
+            gen.clients(gen.stagger(1 / 10, gen.mix([wl.r, wl.w])),
+                        gen.seq(_cycle()))),
+    })
+    test.update({k: v for k, v in opts.items()
+                 if k in ("nodes", "concurrency", "ssh", "time-limit",
+                          "store-dir", "store-root", "net")})
+    return test
+
+
+# ---------------------------------------------------------------------------
+# MongoDB + RocksDB storage engine
+# ---------------------------------------------------------------------------
+
+
+def mongodb_rocks_test(opts: dict) -> dict:
+    """mongodb_rocks.clj: the document-cas test with storage engine
+    rocksdb."""
+    from jepsen_tpu.suites import mongodb
+
+    class RocksMongoDB(mongodb.MongoDB):
+        def setup(self, test, node):
+            from jepsen_tpu.os import debian as _d
+            _d.install(test, node, ["mongodb-org"])
+            conf = ("storage:\n  engine: rocksdb\n"
+                    "replication:\n  replSetName: jepsen\n")
+            with control.sudo():
+                control.execute(
+                    test, node,
+                    f"echo {control.escape(conf)} >> /etc/mongod.conf")
+                control.exec(test, node, "service", "mongod", "start")
+
+    test = mongodb.document_cas_test(opts)
+    test["name"] = "mongodb-rocks-document-cas"
+    test["db"] = RocksMongoDB()
+    return test
+
+
+def _cycle():
+    while True:
+        yield gen.sleep(5)
+        yield gen.once({"type": "info", "f": "start"})
+        yield gen.sleep(5)
+        yield gen.once({"type": "info", "f": "stop"})

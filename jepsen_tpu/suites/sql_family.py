@@ -1,0 +1,388 @@
+"""SQL-family suites sharing the mysql/psql CLI data plane: TiDB, Percona,
+MySQL Cluster (NDB), Postgres RDS, and CrateDB's HTTP SQL endpoint.
+
+Reference counterparts:
+- tidb/: cockroach-style bank/register/sets over the MySQL protocol
+  (tidb/src/tidb/*.clj — pd/tikv/tidb triple daemon, sql.clj retry client)
+- percona/: dirty-reads + set + bank (percona.clj:319-361,
+  percona/dirty_reads.clj:77) — identical shape to galera
+- mysql-cluster/: NDB bank/set (mysql_cluster.clj)
+- postgres-rds/: bank against a managed endpoint, no node setup
+  (postgres_rds.clj:238-293)
+- crate/: SQL over HTTP /_sql with version-divergence checking
+  (crate/version_divergence.clj:93-122)
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import urllib.request
+from typing import Any, List, Optional
+
+from jepsen_tpu import client as client_ns
+from jepsen_tpu import control
+from jepsen_tpu import db as db_ns
+from jepsen_tpu import generator as gen
+from jepsen_tpu import nemesis
+from jepsen_tpu.checker import Checker, compose, perf, set_checker
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.history import Op
+from jepsen_tpu.os import debian
+from jepsen_tpu.suites import galera
+from jepsen_tpu.suites import workloads as wl
+from jepsen_tpu.suites.cockroachdb import BankSQLClient, RegisterClient
+from jepsen_tpu.testing import noop_test
+
+# ---------------------------------------------------------------------------
+# TiDB (pd + tikv + tidb triple daemon; MySQL wire protocol)
+# ---------------------------------------------------------------------------
+
+TIDB_DIR = "/opt/tidb"
+
+
+class TiDB(db_ns.DB, db_ns.LogFiles):
+    """tidb/db.clj: three daemons per node — pd, tikv, tidb."""
+
+    def setup(self, test, node):
+        cu.install_archive(test, node,
+                           test.get("tarball",
+                                    "https://download.pingcap.org/"
+                                    "tidb-latest-linux-amd64.tar.gz"),
+                           TIDB_DIR)
+        initial = ",".join(f"pd{i}=http://{n}:2380"
+                           for i, n in enumerate(test["nodes"]))
+        pds = ",".join(f"{n}:2379" for n in test["nodes"])
+        i = test["nodes"].index(node)
+        cu.start_daemon(test, node, f"{TIDB_DIR}/bin/pd-server",
+                        "--name", f"pd{i}",
+                        "--client-urls", f"http://{node}:2379",
+                        "--peer-urls", f"http://{node}:2380",
+                        "--initial-cluster", initial,
+                        logfile=f"{TIDB_DIR}/pd.log",
+                        pidfile=f"{TIDB_DIR}/pd.pid", chdir=TIDB_DIR)
+        cu.start_daemon(test, node, f"{TIDB_DIR}/bin/tikv-server",
+                        "--pd", pds, "--addr", f"{node}:20160",
+                        "--data-dir", f"{TIDB_DIR}/tikv",
+                        logfile=f"{TIDB_DIR}/tikv.log",
+                        pidfile=f"{TIDB_DIR}/tikv.pid", chdir=TIDB_DIR)
+        cu.start_daemon(test, node, f"{TIDB_DIR}/bin/tidb-server",
+                        "--store", "tikv", "--path", pds,
+                        logfile=f"{TIDB_DIR}/tidb.log",
+                        pidfile=f"{TIDB_DIR}/tidb.pid", chdir=TIDB_DIR)
+
+    def teardown(self, test, node):
+        for d in ("tidb", "tikv", "pd"):
+            cu.stop_daemon(test, node, f"{TIDB_DIR}/{d}.pid",
+                           cmd=f"{d}-server")
+        control.exec(test, node, "rm", "-rf", f"{TIDB_DIR}/tikv")
+
+    def log_files(self, test, node):
+        return [f"{TIDB_DIR}/{d}.log" for d in ("pd", "tikv", "tidb")]
+
+
+class TiDBRegisterClient(RegisterClient):
+    """Registers over the mysql CLI instead of the cockroach CLI."""
+
+    def _sql(self, test, statement):
+        return galera.sql(test, self.node, statement)
+
+
+def tidb_bank_test(opts: dict) -> dict:
+    n = opts.get("accounts", 5)
+    starting = opts.get("starting-balance", 10)
+
+    class TiBank(BankSQLClient):
+        pass
+
+    test = noop_test()
+    test.update({
+        "name": "tidb-bank",
+        "db": TiDB(),
+        "client": TiBank(n, starting),
+        "nemesis": nemesis.partition_random_halves(),
+        "checker": compose({
+            "perf": perf(),
+            "bank": wl.bank_checker(n, n * starting)}),
+        "generator": gen.phases(
+            gen.time_limit(
+                opts.get("time-limit", 60),
+                gen.clients(
+                    gen.stagger(1 / 10, gen.mix(
+                        [wl.bank_read, wl.bank_diff_transfer(n)])),
+                    gen.seq(_cycle()))),
+            gen.nemesis(gen.once({"type": "info", "f": "stop"})),
+            gen.sleep(5),
+            gen.clients(gen.once({"f": "read", "value": None}))),
+    })
+    test.update({k: v for k, v in opts.items()
+                 if k in ("nodes", "concurrency", "ssh", "time-limit",
+                          "store-dir", "store-root", "net")})
+    return test
+
+
+# ---------------------------------------------------------------------------
+# Percona (galera-shaped: dirty reads + bank)
+# ---------------------------------------------------------------------------
+
+
+class PerconaDB(galera.GaleraDB):
+    """percona.clj: XtraDB cluster — wsrep like galera."""
+
+    def setup(self, test, node):
+        debian.install(test, node, ["percona-xtradb-cluster-56"])
+        super_cfg_node = galera.GaleraDB.setup
+        # same wsrep bootstrap as galera with percona package names
+        cluster = ",".join(str(n) for n in test["nodes"])
+        cnf = (f"[mysqld]\n"
+               f"wsrep_provider=/usr/lib/libgalera_smm.so\n"
+               f"wsrep_cluster_address=gcomm://{cluster}\n"
+               f"wsrep_node_address={node}\n")
+        with control.sudo():
+            control.execute(
+                test, node,
+                f"echo {control.escape(cnf)} > "
+                f"/etc/mysql/conf.d/percona.cnf")
+            if node == test["nodes"][0]:
+                control.execute(test, node,
+                                "service mysql bootstrap-pxc || "
+                                "service mysql start")
+            else:
+                control.exec(test, node, "service", "mysql", "start")
+
+
+def percona_dirty_reads_test(opts: dict) -> dict:
+    test = galera.dirty_reads_test(opts)
+    test["name"] = "percona-dirty-reads"
+    test["db"] = PerconaDB()
+    return test
+
+
+# ---------------------------------------------------------------------------
+# MySQL Cluster (NDB)
+# ---------------------------------------------------------------------------
+
+
+class MySQLClusterDB(db_ns.DB):
+    """mysql_cluster.clj: ndb_mgmd on the first node, ndbd + mysqld
+    elsewhere."""
+
+    def setup(self, test, node):
+        debian.install(test, node, ["mysql-cluster-community-server"])
+        first = test["nodes"][0]
+        with control.sudo():
+            if node == first:
+                control.exec(test, node, "ndb_mgmd", "-f",
+                             "/var/lib/mysql-cluster/config.ini")
+            control.exec(test, node, "ndbd",
+                         f"--ndb-connectstring={first}")
+            control.execute(test, node, "service mysql start || true")
+
+    def teardown(self, test, node):
+        with control.sudo():
+            control.execute(test, node, "service mysql stop || true")
+            control.execute(test, node, "pkill -9 ndbd || true")
+
+
+def mysql_cluster_bank_test(opts: dict) -> dict:
+    test = tidb_bank_test(opts)
+    test["name"] = "mysql-cluster-bank"
+    test["db"] = MySQLClusterDB()
+    return test
+
+
+# ---------------------------------------------------------------------------
+# Postgres RDS (managed; no node setup)
+# ---------------------------------------------------------------------------
+
+
+class PsqlBankClient(client_ns.Client):
+    """postgres_rds.clj:150-230: bank over psql against one managed
+    endpoint."""
+
+    def __init__(self, n: int = 5, starting: int = 10, node=None):
+        self.n = n
+        self.starting = starting
+        self.node = node
+
+    def open(self, test, node):
+        c = PsqlBankClient(self.n, self.starting)
+        c.node = node
+        return c
+
+    def _psql(self, test, statement) -> List[List[str]]:
+        endpoint = test.get("rds-endpoint", str(self.node))
+        out = control.execute(
+            test, self.node,
+            f"psql -h {control.escape(endpoint)} -U jepsen -d jepsen "
+            f"-t -A -F $'\\t' -c {control.escape(statement)}")
+        return [line.split("\t") for line in out.splitlines()
+                if line.strip()]
+
+    def setup(self, test):
+        node = test["nodes"][0]
+        c = self.open(test, node)
+        c._psql(test, "CREATE TABLE IF NOT EXISTS accounts "
+                      "(id INT PRIMARY KEY, balance BIGINT)")
+        for i in range(self.n):
+            c._psql(test, f"INSERT INTO accounts VALUES "
+                          f"({i}, {self.starting}) ON CONFLICT DO NOTHING")
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "read":
+                rows = self._psql(
+                    test, "SELECT balance FROM accounts ORDER BY id")
+                return op.replace(type="ok",
+                                  value=[int(r[0]) for r in rows])
+            if op.f == "transfer":
+                v = op.value
+                self._psql(
+                    test,
+                    "BEGIN ISOLATION LEVEL SERIALIZABLE; "
+                    f"UPDATE accounts SET balance = balance - {v['amount']}"
+                    f" WHERE id = {v['from']} AND balance >= {v['amount']};"
+                    f" UPDATE accounts SET balance = balance + "
+                    f"{v['amount']} WHERE id = {v['to']}; COMMIT;")
+                return op.replace(type="ok")
+            raise ValueError(f"unknown op {op.f!r}")
+        except control.RemoteError as e:
+            msg = f"{e.err or ''}"
+            if "serialize" in msg.lower() or "deadlock" in msg.lower():
+                return op.replace(type="fail", error="txn-abort")
+            t = "fail" if op.f == "read" else "info"
+            return op.replace(type=t, error=msg.strip()[:80])
+
+
+def postgres_rds_bank_test(opts: dict) -> dict:
+    """Bank against managed RDS: DB lifecycle is a noop
+    (postgres_rds.clj has no node setup)."""
+    n = opts.get("accounts", 5)
+    starting = opts.get("starting-balance", 10)
+    test = noop_test()
+    test.update({
+        "name": "postgres-rds-bank",
+        "db": db_ns.noop(),
+        "client": PsqlBankClient(n, starting),
+        "nemesis": None,
+        "checker": compose({
+            "perf": perf(),
+            "bank": wl.bank_checker(n, n * starting)}),
+        "generator": gen.time_limit(
+            opts.get("time-limit", 60),
+            gen.clients(gen.stagger(1 / 10, gen.mix(
+                [wl.bank_read, wl.bank_diff_transfer(n)])))),
+    })
+    test.update({k: v for k, v in opts.items()
+                 if k in ("nodes", "concurrency", "ssh", "time-limit",
+                          "store-dir", "store-root", "net",
+                          "rds-endpoint")})
+    return test
+
+
+# ---------------------------------------------------------------------------
+# CrateDB (SQL over HTTP; version divergence)
+# ---------------------------------------------------------------------------
+
+
+class CrateClient(client_ns.Client):
+    """crate/core.clj over the HTTP /_sql endpoint: versioned updates.
+    write carries (k, version-guess, value)."""
+
+    def __init__(self, node=None, timeout: float = 5.0):
+        self.node = node
+        self.timeout = timeout
+
+    def open(self, test, node):
+        return CrateClient(node, self.timeout)
+
+    def _sql(self, stmt: str, args=()):
+        node = str(self.node)
+        authority = node if ":" in node else f"{node}:4200"
+        req = urllib.request.Request(
+            f"http://{authority}/_sql",
+            data=json.dumps({"stmt": stmt, "args": list(args)}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return json.loads(resp.read().decode())
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "read":
+                out = self._sql("SELECT v, _version FROM jepsen.r "
+                                "WHERE id = ?", [0])
+                rows = out.get("rows") or []
+                val = rows[0] if rows else None
+                return op.replace(type="ok", value=val)
+            if op.f == "write":
+                out = self._sql(
+                    "INSERT INTO jepsen.r (id, v) VALUES (?, ?) "
+                    "ON DUPLICATE KEY UPDATE v = VALUES(v)",
+                    [0, int(op.value)])
+                return op.replace(
+                    type="ok" if out.get("rowcount") else "fail")
+            raise ValueError(f"unknown op {op.f!r}")
+        except urllib.error.HTTPError as e:
+            t = "fail" if op.f == "read" else "info"
+            return op.replace(type=t, error=f"http-{e.code}")
+        except (TimeoutError, OSError) as e:
+            t = "fail" if op.f == "read" else "info"
+            return op.replace(type=t, error=type(e).__name__)
+
+
+class VersionDivergenceChecker(Checker):
+    """crate/version_divergence.clj:93-122: no two reads may observe the
+    same _version with different values."""
+
+    def check(self, test, history, opts=None):
+        by_version = {}
+        divergent = []
+        for o in history:
+            if not (o.is_ok and o.f == "read") or not o.value:
+                continue
+            val, version = o.value[0], o.value[1]
+            if version in by_version and by_version[version] != val:
+                divergent.append({"version": version,
+                                  "values": sorted({by_version[version],
+                                                    val})})
+            else:
+                by_version[version] = val
+        return {"valid": not divergent,
+                "versions-seen": len(by_version),
+                "divergent": divergent}
+
+
+def crate_version_divergence_test(opts: dict) -> dict:
+    counter = itertools.count()
+
+    def write(test, process):
+        return {"type": "invoke", "f": "write", "value": next(counter)}
+
+    test = noop_test()
+    test.update({
+        "name": "crate-version-divergence",
+        "db": db_ns.noop(),
+        "client": CrateClient(),
+        "nemesis": nemesis.partition_random_halves(),
+        "checker": compose({
+            "version-divergence": VersionDivergenceChecker()}),
+        "generator": gen.time_limit(
+            opts.get("time-limit", 60),
+            gen.clients(
+                gen.mix([write,
+                         lambda t, p: {"type": "invoke", "f": "read",
+                                       "value": None}]),
+                gen.seq(_cycle()))),
+    })
+    test.update({k: v for k, v in opts.items()
+                 if k in ("nodes", "concurrency", "ssh", "time-limit",
+                          "store-dir", "store-root", "net")})
+    return test
+
+
+def _cycle():
+    while True:
+        yield gen.sleep(5)
+        yield gen.once({"type": "info", "f": "start"})
+        yield gen.sleep(5)
+        yield gen.once({"type": "info", "f": "stop"})

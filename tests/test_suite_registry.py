@@ -1,0 +1,112 @@
+"""Registry-wide smoke tests: every suite imports, builds a test map, and
+exposes the protocol objects; spot client tests for the thin suites."""
+
+import pytest
+
+from jepsen_tpu import control, suites
+from jepsen_tpu.checker import Checker
+from jepsen_tpu.client import Client
+from jepsen_tpu.generator import Generator
+from jepsen_tpu.history import Op
+
+from test_nemesis import dummy_test, logs
+
+
+def op(f, v=None, p=0):
+    return Op(type="invoke", f=f, value=v, process=p, time=0)
+
+
+ALL_SUITES = sorted([
+    "etcd", "zookeeper", "consul", "disque", "raftis", "rabbitmq",
+    "rabbitmq-mutex", "hazelcast", "cockroachdb", "cockroachdb-bank",
+    "cockroachdb-sets", "galera", "aerospike", "aerospike-counter",
+    "mongodb", "mongodb-transfer", "mongodb-rocks", "elasticsearch",
+    "tidb", "percona", "mysql-cluster", "postgres-rds", "crate",
+    "logcabin", "robustirc", "rethinkdb", "ravendb",
+])
+
+
+class TestRegistry:
+    def test_all_suites_registered(self):
+        reg = suites.registry()
+        missing = [s for s in ALL_SUITES if s not in reg]
+        assert not missing, f"missing suites: {missing}"
+
+    @pytest.mark.parametrize("name", ALL_SUITES)
+    def test_suite_builds_test_map(self, name):
+        reg = suites.registry()
+        test = reg[name]({"time-limit": 1, "nodes": ["n1", "n2", "n3"],
+                          "concurrency": 3})
+        assert isinstance(test.get("name"), str) and test["name"]
+        assert isinstance(test.get("client"), Client)
+        assert test.get("checker") is not None
+        assert test.get("generator") is not None
+
+
+class TestThinClients:
+    def test_logcabin_cas(self):
+        from jepsen_tpu.suites.small import LogCabinClient
+        t = dummy_test(**{"ssh": {"mode": "dummy", "dummy-responses": {
+            "logcabin --cluster": "7"}}})
+        with control.session_pool(t):
+            c = LogCabinClient().open(t, "n1")
+            got = c.invoke(t, op("read"))
+            assert got.type == "ok" and got.value == 7
+            assert c.invoke(t, op("cas", (7, 9))).type == "ok"
+            assert any("--condition /jepsen:7" in cmd
+                       for cmd in logs(t)["n1"])
+
+    def test_crate_version_divergence_checker(self):
+        from jepsen_tpu.suites.sql_family import VersionDivergenceChecker
+        h = [op("read").replace(type="ok", value=[1, 5]),
+             op("read").replace(type="ok", value=[2, 5])]
+        out = VersionDivergenceChecker().check({}, h)
+        assert out["valid"] is False
+        assert out["divergent"][0]["version"] == 5
+        h2 = [op("read").replace(type="ok", value=[1, 5]),
+              op("read").replace(type="ok", value=[1, 5]),
+              op("read").replace(type="ok", value=[2, 6])]
+        assert VersionDivergenceChecker().check({}, h2)["valid"] is True
+
+    def test_es_dirty_read_checker(self):
+        from jepsen_tpu.suites.elasticsearch import dirty_read_checker
+        h = [op("write", 1).replace(type="ok"),
+             op("write", 2).replace(type="ok"),
+             op("read", 3).replace(type="ok"),
+             op("strong-read").replace(type="ok", value={1, 2}),
+             op("strong-read").replace(type="ok", value={1, 2})]
+        out = dirty_read_checker().check({}, h)
+        assert out["valid"] is False          # read 3 never acknowledged
+        assert out["dirty"] == [3]
+        h2 = [op("write", 1).replace(type="ok"),
+              op("strong-read").replace(type="ok", value={1}),
+              op("strong-read").replace(type="ok", value={1, 2})]
+        out2 = dirty_read_checker().check({}, h2)
+        assert out2["valid"] is False         # nodes disagree
+        assert out2["nodes-agree"] is False
+
+    def test_psql_bank_transfer_shape(self):
+        from jepsen_tpu.suites.sql_family import PsqlBankClient
+        t = dummy_test(**{"ssh": {"mode": "dummy", "dummy-responses": {
+            "SELECT balance": "10\n10\n"}}})
+        with control.session_pool(t):
+            c = PsqlBankClient(2, 10).open(t, "n1")
+            got = c.invoke(t, op("read"))
+            assert got.value == [10, 10]
+            out = c.invoke(t, op("transfer",
+                                 {"from": 0, "to": 1, "amount": 3}))
+            assert out.type == "ok"
+            stmt = next(cmd for cmd in logs(t)["n1"] if "BEGIN" in cmd)
+            assert "SERIALIZABLE" in stmt
+
+    def test_rethink_cas_via_node_driver(self):
+        from jepsen_tpu.suites.small import RethinkClient
+        t = dummy_test(**{"ssh": {"mode": "dummy", "dummy-responses": {
+            "get(0).update": '{"replaced": 1}',
+            "get(0).run": '{"id": 0, "v": 3}',
+        }}})
+        with control.session_pool(t):
+            c = RethinkClient().open(t, "n1")
+            got = c.invoke(t, op("read"))
+            assert got.type == "ok" and got.value == 3
+            assert c.invoke(t, op("cas", (3, 4))).type == "ok"
