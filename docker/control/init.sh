@@ -1,0 +1,30 @@
+#!/bin/sh
+# Install the generated keypair, learn the nodes' host keys, then idle so
+# the operator can `docker exec -it jepsen-tpu-control bash` and run
+# suites (reference docker/control/init.sh).
+: "${SSH_PRIVATE_KEY?SSH_PRIVATE_KEY is empty; use up.sh}"
+: "${SSH_PUBLIC_KEY?SSH_PUBLIC_KEY is empty; use up.sh}"
+
+if [ ! -f ~/.ssh/known_hosts ]; then
+    mkdir -p -m 700 ~/.ssh
+    printf '%s\n' "$SSH_PRIVATE_KEY" | sed 's/↩/\n/g' > ~/.ssh/id_rsa
+    chmod 600 ~/.ssh/id_rsa
+    echo "$SSH_PUBLIC_KEY" > ~/.ssh/id_rsa.pub
+    : > ~/.ssh/known_hosts
+    for f in $(seq 1 5); do
+        ssh-keyscan -t rsa "n$f" >> ~/.ssh/known_hosts 2>/dev/null
+    done
+fi
+
+cat <<EOF
+Welcome to jepsen-tpu on Docker
+===============================
+
+Run \`docker exec -it jepsen-tpu-control bash\` in another terminal, then:
+
+    python -m jepsen_tpu.suites.etcd test --concurrency 2n
+    python -m jepsen_tpu.cli serve     # results browser on :8080
+
+EOF
+
+tail -f /dev/null

@@ -1,0 +1,7 @@
+#!/bin/sh
+# Install the control node's public key, then run sshd in the foreground.
+: "${ROOT_PUBLIC_KEY?ROOT_PUBLIC_KEY is empty; use up.sh}"
+mkdir -p -m 700 /root/.ssh
+echo "$ROOT_PUBLIC_KEY" > /root/.ssh/authorized_keys
+chmod 600 /root/.ssh/authorized_keys
+exec /usr/sbin/sshd -D
