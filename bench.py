@@ -20,15 +20,24 @@ sys.path.insert(0, __file__.rsplit("/", 1)[0])
 N_OPS = 10_000
 N_PROCS = 5
 TARGET_S = 60.0
-CAPACITY = 1024
+CAPACITY = None  # auto-escalation ladder
 
 
 def main():
-    from jepsen_tpu.checker.tpu import check_history_tpu
+    import jax
+
+    # Persistent compilation cache: driver re-runs skip the compile cost.
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          "/tmp/jepsen_tpu_jit_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+    except Exception:  # noqa: BLE001 (older jax)
+        pass
+
+    from jepsen_tpu.checker.tpu import (
+        check_history_tpu, pack_with_init, warm_ladder)
     from jepsen_tpu.models import CASRegister
     from jepsen_tpu.testing import simulate_register_history
-
-    import jax
 
     dev = jax.devices()[0]
     print(f"# device: {dev.platform} {getattr(dev, 'device_kind', '')}",
@@ -42,18 +51,22 @@ def main():
           file=sys.stderr)
 
     # Warm-up: same op count => same padded bucket => shared compilation.
+    # Compile every escalation rung the timed check could touch.
     t0 = time.time()
     warm = simulate_register_history(N_OPS, n_procs=N_PROCS, n_vals=16,
                                      seed=7, crash_p=0.002)
-    r = check_history_tpu(warm, CASRegister(), capacity=CAPACITY)
-    print(f"# warm-up (incl. compile): {time.time()-t0:.1f}s -> {r['valid']}",
-          file=sys.stderr)
+    packed, kernel = pack_with_init(warm, CASRegister())
+    warm_ladder(packed, kernel, rungs=3)
+    r = check_history_tpu(warm, CASRegister())
+    print(f"# warm-up (incl. compiles): {time.time()-t0:.1f}s -> "
+          f"{r['valid']}", file=sys.stderr)
 
     t0 = time.time()
     result = check_history_tpu(history, CASRegister(), capacity=CAPACITY)
     dt = time.time() - t0
     print(f"# check: valid={result['valid']} levels={result.get('levels')} "
           f"in {dt:.2f}s", file=sys.stderr)
+    _secondary_metrics()
     if result["valid"] is not True:
         # A wrong or unknown verdict on a valid-by-construction history is a
         # bench failure, not a number.
@@ -69,6 +82,36 @@ def main():
         "vs_baseline": round(TARGET_S / dt, 2),
     }))
     return 0
+
+
+def _secondary_metrics():
+    """BASELINE.md's secondary configs, reported on stderr (the driver
+    contract is one JSON line for the headline metric)."""
+    import time as _t
+
+    from jepsen_tpu.checker.tpu import check_keyed_tpu
+    from jepsen_tpu.models import CASRegister
+    from jepsen_tpu.testing import simulate_register_history
+
+    # config 5: multi-key batched checking (the independent axis)
+    keyed = {k: simulate_register_history(200, n_procs=5, n_vals=8,
+                                          seed=1000 + k, crash_p=0.002)
+             for k in range(50)}
+    t0 = _t.time()
+    out = check_keyed_tpu(keyed, CASRegister())
+    dt = _t.time() - t0
+    ok = sum(1 for r in out["results"].values() if r["valid"] is True)
+    print(f"# secondary: 50 keys x 200 ops batched: {ok}/50 valid "
+          f"in {dt:.2f}s (incl. compile)", file=sys.stderr)
+
+    # config 2: single 2k-op history
+    h = simulate_register_history(2000, n_procs=5, n_vals=8, seed=3,
+                                  crash_p=0.002)
+    from jepsen_tpu.checker.tpu import check_history_tpu
+    t0 = _t.time()
+    r = check_history_tpu(h, CASRegister())
+    print(f"# secondary: 2k-op history: {r['valid']} in "
+          f"{_t.time()-t0:.2f}s (incl. compile)", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -62,8 +62,6 @@ except ImportError:  # pragma: no cover
     HAVE_JAX = False
 
 
-#: Default frontier capacity (configurations kept per level, per key).
-DEFAULT_CAPACITY = 2048
 #: Candidate window width: max offset from the frontier an op may be
 #: linearized at. Bounded below by the history's max concurrency.
 WINDOW = 32
@@ -94,7 +92,8 @@ def _trailing_ones(m):
     return lax.population_count(low - jnp.uint32(1)).astype(jnp.int32)
 
 
-def _search_fn(step, n: int, n_cr: int, capacity: int, window: int):
+def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
+               fail_fast: bool = False):
     """Build the single-key search. ``n`` is the (static, padded) length of
     the *required* section — ops with finite return, sorted by return index.
     ``n_cr`` is the (static, padded) width of the *crashed* section — 'info'
@@ -126,7 +125,12 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int):
 
         def active(c):
             k, mask, cmask, state, alive, done, ovf, wovf, level, best = c
-            return (~done) & jnp.any(alive) & (level <= n + CR)
+            out = (~done) & jnp.any(alive) & (level <= n + CR)
+            if fail_fast:
+                # ladder mode: an overflowed run will be re-run at the next
+                # rung anyway, so stop paying for levels immediately
+                out = out & ~(ovf | wovf)
+            return out
 
         def body(c):
             k, mask, cmask, state, alive, done, ovf, wovf, level, best = c
@@ -188,29 +192,29 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int):
             done2 = done | jnp.any(fv & (fk >= n_required))
             best2 = jnp.maximum(best, jnp.max(jnp.where(fv, fk, 0)))
 
-            # -- dedup: lexsort by (invalid, k, mask, cmask, state) -------
-            inval = (~fv).astype(jnp.int32)
-            inval, fk, fm, fcm, fs = lax.sort(
-                (inval, fk, fm, fcm, fs), num_keys=5)
+            # -- dedup: one lexsort; invalid rows sink via the packed
+            # (invalid, k) leading key (k < 2^30 always: int32 indices) ----
+            key1 = jnp.where(fv, fk, fk + jnp.int32(1 << 30))
+            key1, fk, fm, fcm, fs = lax.sort(
+                (key1, fk, fm, fcm, fs), num_keys=5)
+            fv = key1 < (1 << 30)
             same_prev = jnp.concatenate([
                 jnp.zeros(1, bool),
                 (fk[1:] == fk[:-1]) & (fm[1:] == fm[:-1])
                 & (fcm[1:] == fcm[:-1]) & (fs[1:] == fs[:-1])
-                & (inval[1:] == 0) & (inval[:-1] == 0),
+                & fv[1:] & fv[:-1],
             ])
-            uniq = (inval == 0) & ~same_prev
-            u = jnp.sum(uniq.astype(jnp.int32))
-            ovf2 = ovf | (u > C)
+            uniq = fv & ~same_prev
 
-            # -- compact unique survivors to the front, keep first C ------
-            inval2 = (~uniq).astype(jnp.int32)
-            inval2, fk, fm, fcm, fs = lax.sort(
-                (inval2, fk, fm, fcm, fs), num_keys=1)
+            # -- keep the first C rows as-is: dup rows inside the prefix
+            # just occupy dead slots (they expand to nothing). Conservative
+            # overflow: any unique row past C may have been lost ----------
+            ovf2 = ovf | jnp.any(uniq[C:])
             k3 = fk[:C]
             m3 = fm[:C]
             cm3 = fcm[:C]
             s3 = fs[:C]
-            a3 = inval2[:C] == 0
+            a3 = uniq[:C]
 
             new = (k3, m3, cm3, s3, a3, done2, ovf2, wovf2,
                    level + 1, best2)
@@ -220,7 +224,7 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int):
 
         out = lax.while_loop(active, body, carry0)
         done, ovf, wovf, level, best = out[5], out[6], out[7], out[8], out[9]
-        return done, ~(ovf | wovf), best, level
+        return done, ovf, wovf, best, level
 
     return search
 
@@ -237,24 +241,26 @@ def _kernel_key(kernel: KernelSpec) -> int:
 
 
 @functools.lru_cache(maxsize=32)
-def _jit_single(kernel_id: int, capacity: int, window: int):
+def _jit_single(kernel_id: int, capacity: int, window: int,
+                fail_fast: bool = False):
     kernel = _KERNELS_BY_ID[kernel_id]
 
     def single(f, v1, v2, inv, ret, sm, cf, cv1, cv2, cinv, nr, ini):
         search = _search_fn(kernel.step, f.shape[0], cf.shape[0],
-                            capacity, window)
+                            capacity, window, fail_fast)
         return search(f, v1, v2, inv, ret, sm, cf, cv1, cv2, cinv, nr, ini)
 
     return jax.jit(single)
 
 
 @functools.lru_cache(maxsize=32)
-def _jit_batch(kernel_id: int, capacity: int, window: int):
+def _jit_batch(kernel_id: int, capacity: int, window: int,
+               fail_fast: bool = False):
     kernel = _KERNELS_BY_ID[kernel_id]
 
     def batched(f, v1, v2, inv, ret, sm, cf, cv1, cv2, cinv, nr, ini):
         search = _search_fn(kernel.step, f.shape[1], cf.shape[1],
-                            capacity, window)
+                            capacity, window, fail_fast)
         return jax.vmap(search)(
             f, v1, v2, inv, ret, sm, cf, cv1, cv2, cinv, nr, ini)
 
@@ -318,11 +324,11 @@ def _check_window(window: int) -> None:
             f"width would silently corrupt the search")
 
 
-def _result(done: bool, clean: bool, best_k: int, levels: int,
+def _result(done: bool, ovf: bool, wovf: bool, best_k: int, levels: int,
             p: Optional[PackedHistory] = None) -> Dict[str, Any]:
     if done:
         return {"valid": True, "levels": levels, "backend": "tpu"}
-    if clean:
+    if not (ovf or wovf):
         out = {"valid": False, "levels": levels,
                "max-linearized-prefix": best_k, "backend": "tpu"}
         if p is not None and p.ops and best_k < len(p.ops):
@@ -330,15 +336,29 @@ def _result(done: bool, clean: bool, best_k: int, levels: int,
             out["frontier-op"] = inv_op.to_dict() if inv_op else None
         return out
     return {"valid": UNKNOWN, "levels": levels,
-            "error": "frontier capacity or window exhausted",
+            "error": ("frontier capacity exhausted" if ovf
+                      else "candidate window exceeded"),
+            "capacity-overflow": bool(ovf),
+            "window-overflow": bool(wovf),
             "backend": "tpu"}
 
 
+#: Auto-escalation ladder for capacity=None: most real frontiers are tiny,
+#: so start small (per-level sort cost scales with capacity x window) and
+#: only climb when the search overflows.
+ESCALATION = ((256, 16), (1024, 32), (4096, 32), (16384, 32))
+
+
 def check_packed_tpu(p: PackedHistory, kernel: KernelSpec,
-                     capacity: int = DEFAULT_CAPACITY,
-                     window: int = WINDOW) -> Dict[str, Any]:
-    """Check one packed single-key history on the default JAX backend."""
-    _check_window(window)
+                     capacity: Optional[int] = None,
+                     window: Optional[int] = WINDOW) -> Dict[str, Any]:
+    """Check one packed single-key history on the default JAX backend.
+
+    capacity=None auto-escalates through ESCALATION, retrying on
+    capacity overflow (and on window overflow while the window can still
+    grow)."""
+    if window is not None:
+        _check_window(window)
     if p.n_required == 0:
         return {"valid": True, "levels": 0, "backend": "tpu"}
     cr = _crash_width(p.n - p.n_required)
@@ -348,20 +368,53 @@ def check_packed_tpu(p: PackedHistory, kernel: KernelSpec,
         return {"valid": UNKNOWN, "backend": "tpu",
                 "error": f"{p.n - p.n_required} crashed ops exceed the "
                          f"crashed-set width {CRASH_MAX}"}
-    fn = _jit_single(_kernel_key(kernel), capacity, window)
-    done, clean, best, levels = fn(*(cols[c] for c in _COLS))
-    return _result(bool(done), bool(clean), int(best), int(levels), p)
+    if capacity is not None:
+        _check_window(window or WINDOW)
+        ladder = ((capacity, window or WINDOW),)
+    else:
+        ladder = ESCALATION
+    out: Dict[str, Any] = {}
+    for i, (cap, win) in enumerate(ladder):
+        fail_fast = i < len(ladder) - 1
+        fn = _jit_single(_kernel_key(kernel), cap, win, fail_fast)
+        done, ovf, wovf, best, levels = fn(*(cols[c] for c in _COLS))
+        out = _result(bool(done), bool(ovf), bool(wovf), int(best),
+                      int(levels), p)
+        if out["valid"] is not UNKNOWN:
+            return out
+        if bool(wovf) and win >= WINDOW and not bool(ovf):
+            return out  # a bigger frontier won't fix a window overflow
+    return out
+
+
+def warm_ladder(p: PackedHistory, kernel: KernelSpec,
+                rungs: Optional[int] = None) -> None:
+    """Compile (and once-execute) every escalation rung for this history's
+    padded shape, so a later timed check pays no compile cost regardless
+    of how far it escalates."""
+    cr = _crash_width(p.n - p.n_required)
+    cols = (None if cr is None
+            else _split_packed(p, _bucket(p.n_required), cr))
+    if cols is None:
+        return
+    ladder = ESCALATION[:rungs] if rungs else ESCALATION
+    for i, (cap, win) in enumerate(ladder):
+        fail_fast = i < len(ESCALATION) - 1
+        fn = _jit_single(_kernel_key(kernel), cap, win, fail_fast)
+        jax.block_until_ready(fn(*(cols[c] for c in _COLS)))
 
 
 def check_history_tpu(history: History, model: Model,
-                      capacity: int = DEFAULT_CAPACITY,
-                      window: int = WINDOW) -> Optional[Dict[str, Any]]:
+                      capacity: Optional[int] = None,
+                      window: Optional[int] = WINDOW
+                      ) -> Optional[Dict[str, Any]]:
     """Entry point used by LinearizableChecker(backend='tpu').
 
     Returns None when the model has no single-word integer kernel (the
     caller then uses the generic CPU object search).
     """
-    _check_window(window)
+    if window is not None:
+        _check_window(window)
     try:
         pk = pack_with_init(history, model)
     except ValueError:  # op f unsupported by the integer kernel
@@ -373,8 +426,8 @@ def check_history_tpu(history: History, model: Model,
 
 
 def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
-                    capacity: int = DEFAULT_CAPACITY,
-                    window: int = WINDOW,
+                    capacity: Optional[int] = None,
+                    window: Optional[int] = WINDOW,
                     mesh: Optional["jax.sharding.Mesh"] = None,
                     axis: str = "keys") -> Dict[str, Any]:
     """Check a {key: history} map batched on device — the independent-key
@@ -384,8 +437,11 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
 
     With a mesh, key-batch arrays are sharded over ``axis`` and XLA's SPMD
     partitioner runs each shard's searches on its own device over ICI.
+    capacity=None escalates the whole batch through ESCALATION, re-running
+    only keys whose searches overflowed.
     """
-    _check_window(window)
+    if window is not None:
+        _check_window(window)
     kernel = kernel_spec_for(model)
     if kernel is None:
         raise ValueError(f"model {model!r} has no integer kernel")
@@ -425,7 +481,15 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
             continue
         rows.append((key, cols))
 
-    if rows:
+    if capacity is not None:
+        _check_window(window or WINDOW)
+        ladder = ((capacity, window or WINDOW),)
+    else:
+        ladder = ESCALATION
+
+    for step, (cap, win) in enumerate(ladder):
+        if not rows:
+            break
         arrays = [np.stack([cols[c] for _, cols in rows]) for c in _COLS]
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -437,12 +501,21 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
                     [a, np.repeat(a[-1:], pad, axis=0)]) for a in arrays]
             sh_row = NamedSharding(mesh, P(axis))
             arrays = [jax.device_put(a, sh_row) for a in arrays]
-        fn = _jit_batch(_kernel_key(kernel), capacity, window)
-        done, clean, best, levels = (np.asarray(x) for x in fn(*arrays))
-        for r, (key, _) in enumerate(rows):
-            results[key] = _result(bool(done[r]), bool(clean[r]),
-                                   int(best[r]), int(levels[r]),
-                                   packed[key])
+        fn = _jit_batch(_kernel_key(kernel), cap, win,
+                        step < len(ladder) - 1)
+        done, ovf, wovf, best, levels = (np.asarray(x)
+                                         for x in fn(*arrays))
+        retry = []
+        last_rung = step == len(ladder) - 1
+        for r, (key, cols) in enumerate(rows):
+            res = _result(bool(done[r]), bool(ovf[r]), bool(wovf[r]),
+                          int(best[r]), int(levels[r]), packed[key])
+            escalatable = bool(ovf[r]) or (bool(wovf[r]) and win < WINDOW)
+            if res["valid"] is UNKNOWN and escalatable and not last_rung:
+                retry.append((key, cols))
+            else:
+                results[key] = res
+        rows = retry
     valid = True
     for r in results.values():
         if r["valid"] is False:
