@@ -267,8 +267,10 @@ class IndependentChecker(Checker):
                 results[k] = r
 
         self._write_artifacts(test, keyed, results, opts)
+        # UNKNOWN is truthy in the reference (independent.clj:287-293):
+        # only definitively-invalid keys are failures.
         failures = [k for k, r in results.items()
-                    if r.get("valid") is not True]
+                    if r.get("valid") is False]
         return {
             "valid": merge_valid(r.get("valid", UNKNOWN)
                                  for r in results.values()),

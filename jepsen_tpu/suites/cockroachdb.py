@@ -551,14 +551,29 @@ class BankSQLClient(SQLClient):
             return op.replace(type="ok", value=[int(r[0]) for r in rows])
         if op.f == "transfer":
             v = op.value
-            stmt = (
-                "BEGIN; "
-                f"UPDATE accounts SET balance = balance - {v['amount']} "
-                f"WHERE id = {v['from']} AND balance >= {v['amount']}; "
-                f"UPDATE accounts SET balance = balance + {v['amount']} "
-                f"WHERE id = {v['to']}; COMMIT;")
-            sql(test, self.node, stmt)
-            return op.replace(type="ok")
+            frm, to, amt = int(v["from"]), int(v["to"]), int(v["amount"])
+            # One atomic statement: debit + credit guarded by the source
+            # balance. RETURNING exposes the affected row count, so an
+            # overdraw (guard matches nothing -> 0 rows) maps to a
+            # determinate fail instead of silently minting the credit
+            # (bank.clj:55-79 reads balances and aborts on overdraw).
+            if frm == to:
+                # Net-zero self-transfer: the two-row CASE would apply only
+                # the debit branch to the single matched row. Keep it a
+                # pure guarded touch so the balance is unchanged.
+                rows = sql(
+                    test, self.node,
+                    f"UPDATE accounts SET balance = balance "
+                    f"WHERE id = {frm} AND balance >= {amt} RETURNING id")
+            else:
+                rows = sql(
+                    test, self.node,
+                    f"UPDATE accounts SET balance = balance + "
+                    f"CASE WHEN id = {frm} THEN {-amt} ELSE {amt} END "
+                    f"WHERE id IN ({frm}, {to}) AND {amt} <= "
+                    f"(SELECT balance FROM accounts WHERE id = {frm}) "
+                    f"RETURNING id")
+            return op.replace(type="ok" if rows else "fail")
         raise ValueError(f"unknown op {op.f!r}")
 
 

@@ -147,10 +147,13 @@ class QueueClient(RabbitClient):
             return op.replace(type="fail" if op.f != "enqueue" else "info",
                               error=f"http-{e.code}")
         except (TimeoutError, OSError) as e:
-            # enqueue may or may not have landed; dequeue with no ack is
-            # redelivered, so it's a safe fail (rabbitmq.clj:102-109)
-            t = "fail" if op.f in ("dequeue", "drain") else "info"
-            return op.replace(type=t, error=type(e).__name__)
+            # All transport errors are indeterminate here: enqueue may or
+            # may not have landed, and the management-API get acks (removes)
+            # the message before the response travels back — a lost response
+            # means the message may be gone yet unobserved, so a determinate
+            # 'fail' would be unsound (unlike rabbitmq.clj:102-109, whose
+            # AMQP client leaves the delivery un-acked and redeliverable).
+            return op.replace(type="info", error=type(e).__name__)
 
 
 class SemaphoreClient(RabbitClient):

@@ -16,6 +16,7 @@ Reference counterparts:
 from __future__ import annotations
 
 import json
+import re
 import socket
 import urllib.error
 import urllib.request
@@ -75,8 +76,18 @@ class LogCabinClient(client_ns.Client):
                               f"{self.KEY}:{old}", self.KEY,
                               stdin=str(new))
                     return op.replace(type="ok")
-                except control.RemoteError:
-                    return op.replace(type="fail")
+                except control.RemoteError as e:
+                    # Only LogCabin's exact condition-mismatch message is a
+                    # determinate fail; transport/timeout errors may have
+                    # applied the write and must stay indeterminate
+                    # (logcabin.clj:152-154 anchors the same message and
+                    # :236-240 rethrows everything unmatched).
+                    msg = f"{e.err or ''} {e.out or ''}"
+                    if re.search(
+                            r"LogCabin::Client::Exception: Path '.*' has "
+                            r"value '.*', not '.*' as required", msg):
+                        return op.replace(type="fail")
+                    raise
             raise ValueError(f"unknown op {op.f!r}")
         except control.RemoteError as e:
             return op.replace(type=crash, error=str(e)[:80])
@@ -305,13 +316,31 @@ class RethinkClient(client_ns.Client):
                 return op.replace(type="ok")
             if op.f == "cas":
                 old, new = op.value
-                out = self._reql(
-                    test,
-                    "r.db('jepsen').table('cas').get(0).update("
-                    f"lambda row: r.branch(row['v'].eq({int(old)}), "
-                    f"{{'v': {int(new)}}}, r.error('abort')), "
-                    "return_changes=True).run(c)")
+                try:
+                    out = self._reql(
+                        test,
+                        "r.db('jepsen').table('cas').get(0).update("
+                        f"lambda row: r.branch(row['v'].eq({int(old)}), "
+                        f"{{'v': {int(new)}}}, r.error('abort')), "
+                        "return_changes=True).run(c)")
+                except control.RemoteError as e:
+                    # Only the deliberate r.error('abort') — surfaced by the
+                    # driver as a ReqlUserError — is a determinate fail.
+                    # A bare 'abort' substring would also match OS-level
+                    # 'connection abort' transport errors, which must stay
+                    # indeterminate.
+                    if "ReqlUserError" in f"{e.err or ''} {e.out or ''}":
+                        return op.replace(type="fail")
+                    raise
+                # ReQL may collect update-function errors into the result
+                # instead of raising: errors>0 + first_error 'abort' is the
+                # same determinate precondition failure.
                 res = json.loads(out or "{}")
+                if res.get("errors"):
+                    if "abort" in str(res.get("first_error", "")):
+                        return op.replace(type="fail")
+                    return op.replace(type="info",
+                                      error=str(res.get("first_error"))[:80])
                 return op.replace(
                     type="ok" if res.get("replaced") else "fail")
             raise ValueError(f"unknown op {op.f!r}")

@@ -151,6 +151,25 @@ class TestLiftedChecker:
         assert out["results"][3]["valid"] is True
         assert out["failures"] == [2]
 
+    def test_unknown_keys_are_not_failures(self):
+        # UNKNOWN is truthy in the reference (independent.clj:287-293):
+        # capacity-limited keys must not be misreported as failures.
+        class _Tri(Checker):
+            def check(self, test, history, opts=None):
+                n = len(history)
+                return {"valid": (True if n == 1 else
+                                  False if n == 2 else "unknown")}
+
+        rows = []
+        for k in (1, 2, 3):
+            for v in range(k):
+                rows.append(Op(type="invoke", f="x", value=ind.KV(k, v),
+                               process=0, time=len(rows)))
+        out = ind.checker(_Tri()).check(
+            {"name": "independent-unknown-test"}, History.of(rows))
+        assert out["results"][3]["valid"] == "unknown"
+        assert out["failures"] == [2]
+
     def test_tpu_batched_linearizable(self, tmp_path):
         import random
 

@@ -66,7 +66,8 @@ class TestRegisterClient:
 class TestBankClient:
     def test_transfer_sql_shape(self):
         t = dummy_test(**{"ssh": {"mode": "dummy", "dummy-responses": {
-            "SELECT balance": "balance\n10\n10\n10\n10\n10\n"}}})
+            "SELECT balance": "balance\n10\n10\n10\n10\n10\n",
+            "UPDATE accounts": "id\n1\n3\n"}}})
         with control.session_pool(t):
             c = cr.BankSQLClient(5, 10).open(t, "n1")
             got = c.invoke(t, op("read", None))
@@ -74,10 +75,24 @@ class TestBankClient:
             out = c.invoke(t, op("transfer",
                                  {"from": 1, "to": 3, "amount": 4}))
             assert out.type == "ok"
-            stmt = next(cmd for cmd in logs(t)["n1"] if "BEGIN" in cmd)
-            assert "balance - 4" in stmt and "id = 1" in stmt
-            assert "balance + 4" in stmt and "id = 3" in stmt
-            assert "balance >= 4" in stmt
+            stmt = next(cmd for cmd in logs(t)["n1"]
+                        if "UPDATE accounts" in cmd)
+            # one atomic guarded statement, not an unconditional credit
+            assert "CASE WHEN id = 1 THEN -4 ELSE 4" in stmt
+            assert "id IN (1, 3)" in stmt
+            assert "4 <= (SELECT balance" in stmt
+            assert "RETURNING id" in stmt
+
+    def test_transfer_overdraw_is_determinate_fail(self):
+        # Guard matched no rows (insufficient funds): RETURNING is empty,
+        # the op must be a determinate fail, never 'ok'.
+        t = dummy_test(**{"ssh": {"mode": "dummy", "dummy-responses": {
+            "UPDATE accounts": "id\n"}}})
+        with control.session_pool(t):
+            c = cr.BankSQLClient(5, 10).open(t, "n1")
+            out = c.invoke(t, op("transfer",
+                                 {"from": 1, "to": 3, "amount": 99}))
+            assert out.type == "fail"
 
 
 class TestNemesisLibrary:

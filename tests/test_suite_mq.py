@@ -101,7 +101,10 @@ class TestRabbitQueueClient:
     def test_down_broker(self):
         c = rabbitmq.QueueClient(timeout=0.3).open({}, "127.0.0.1:1")
         assert c.invoke({}, op("enqueue", 1)).type == "info"
-        assert c.invoke({}, op("dequeue")).type == "fail"
+        # dequeue transport errors are indeterminate: the mgmt-API get acks
+        # the message server-side before the response arrives, so a lost
+        # response may have consumed a message we never observed
+        assert c.invoke({}, op("dequeue")).type == "info"
 
     def test_semaphore_token_cycle(self, fake_rabbit):
         a = rabbitmq.SemaphoreClient().open({"nodes": []}, fake_rabbit)

@@ -56,6 +56,36 @@ class TestThinClients:
             assert any("--condition /jepsen:7" in cmd
                        for cmd in logs(t)["n1"])
 
+    def test_logcabin_cas_error_taxonomy(self):
+        from jepsen_tpu.suites.small import LogCabinClient
+        # condition mismatch reported by the CLI -> determinate fail
+        t = dummy_test(**{"ssh": {"mode": "dummy", "dummy-responses": {
+            "--condition": (1, "", "Exiting due to LogCabin::Client::"
+                            "Exception: Path '/jepsen' has value '8', "
+                            "not '7' as required")}}})
+        with control.session_pool(t):
+            c = LogCabinClient().open(t, "n1")
+            assert c.invoke(t, op("cas", (7, 9))).type == "fail"
+        # transport error: the write may have applied -> indeterminate
+        t2 = dummy_test(**{"ssh": {"mode": "dummy", "dummy-responses": {
+            "--condition": (1, "", "connection timed out")}}})
+        with control.session_pool(t2):
+            c = LogCabinClient().open(t2, "n1")
+            assert c.invoke(t2, op("cas", (7, 9))).type == "info"
+
+    def test_rethink_cas_abort_is_fail(self):
+        from jepsen_tpu.suites.small import RethinkClient
+        t = dummy_test(**{"ssh": {"mode": "dummy", "dummy-responses": {
+            "python3 -c": (1, "", "rethinkdb.errors.ReqlUserError: abort")}}})
+        with control.session_pool(t):
+            c = RethinkClient().open(t, "n1")
+            assert c.invoke(t, op("cas", (1, 2))).type == "fail"
+        t2 = dummy_test(**{"ssh": {"mode": "dummy", "dummy-responses": {
+            "python3 -c": (1, "", "connection refused")}}})
+        with control.session_pool(t2):
+            c = RethinkClient().open(t2, "n1")
+            assert c.invoke(t2, op("cas", (1, 2))).type == "info"
+
     def test_crate_version_divergence_checker(self):
         from jepsen_tpu.suites.sql_family import VersionDivergenceChecker
         h = [op("read").replace(type="ok", value=[1, 5]),
