@@ -7,24 +7,65 @@ needs output truncation because results can take hours; the driver target is
 "10k-op history checked in < 60 s on TPU". vs_baseline = 60 / seconds, so
 1.0 == on-target, higher is better.
 
-Prints exactly one JSON line:
+Contract: prints EXACTLY one JSON line on stdout
   {"metric": ..., "value": ..., "unit": "s", "vs_baseline": ...}
+no matter what — TPU init failure, hang, or SIGTERM. Structure:
+
+* orchestrator (this process, never imports jax): runs the measurement in a
+  child subprocess so a hung/sick TPU plugin can be timed out and killed,
+  retries TPU init once with backoff, then falls back to the CPU backend
+  (pinning jax_platforms=cpu — the env var alone can be overridden by an
+  ambient TPU plugin). A failure still emits a parseable record with an
+  "error" field.
+* child (JEPSEN_BENCH_CHILD=tpu|cpu): does the actual synth/warm-up/timed
+  check and prints the JSON line, which the orchestrator relays.
 """
 
 import json
+import os
+import signal
+import subprocess
 import sys
 import time
 
-sys.path.insert(0, __file__.rsplit("/", 1)[0])
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
 
 N_OPS = 10_000
 N_PROCS = 5
 TARGET_S = 60.0
-CAPACITY = None  # auto-escalation ladder
+METRIC = "cas-register-10k-op-linearize"
+# Overall wall budget for the orchestrator; env-tunable for slower drivers.
+BUDGET_S = float(os.environ.get("JEPSEN_BENCH_BUDGET_S", "1200"))
+
+_emitted = False
 
 
-def main():
+def emit(value, vs_baseline, **extra):
+    """Print the single contract line (at most once)."""
+    global _emitted
+    if _emitted:
+        return
+    _emitted = True
+    rec = {"metric": METRIC, "value": value, "unit": "s",
+           "vs_baseline": vs_baseline}
+    rec.update(extra)
+    print(json.dumps(rec))
+    sys.stdout.flush()
+
+
+# ---------------------------------------------------------------------------
+# Child: the actual measurement (runs with a known-good backend)
+# ---------------------------------------------------------------------------
+
+
+def child_main(platform: str) -> int:
     import jax
+
+    if platform == "cpu":
+        # The env var alone is insufficient: an ambient TPU plugin (axon)
+        # can re-register itself; the config update is authoritative.
+        jax.config.update("jax_platforms", "cpu")
 
     # Persistent compilation cache: driver re-runs skip the compile cost.
     try:
@@ -62,24 +103,28 @@ def main():
           f"{r['valid']}", file=sys.stderr)
 
     t0 = time.time()
-    result = check_history_tpu(history, CASRegister(), capacity=CAPACITY)
+    result = check_history_tpu(history, CASRegister())
     dt = time.time() - t0
     print(f"# check: valid={result['valid']} levels={result.get('levels')} "
           f"in {dt:.2f}s", file=sys.stderr)
-    _secondary_metrics()
+    try:
+        _secondary_metrics()
+    except Exception as e:  # noqa: BLE001 — secondary must not eat the line
+        print(f"# secondary metrics failed: {e!r}", file=sys.stderr)
     if result["valid"] is not True:
         # A wrong or unknown verdict on a valid-by-construction history is a
         # bench failure, not a number.
-        print(json.dumps({"metric": "cas-register-10k-op-linearize",
-                          "value": None, "unit": "s", "vs_baseline": 0,
+        print(json.dumps({"metric": METRIC, "value": None, "unit": "s",
+                          "vs_baseline": 0, "platform": dev.platform,
                           "error": f"verdict {result['valid']!r}"}))
         return 1
 
     print(json.dumps({
-        "metric": "cas-register-10k-op-linearize",
+        "metric": METRIC,
         "value": round(dt, 3),
         "unit": "s",
         "vs_baseline": round(TARGET_S / dt, 2),
+        "platform": dev.platform,
     }))
     return 0
 
@@ -89,7 +134,7 @@ def _secondary_metrics():
     contract is one JSON line for the headline metric)."""
     import time as _t
 
-    from jepsen_tpu.checker.tpu import check_keyed_tpu
+    from jepsen_tpu.checker.tpu import check_history_tpu, check_keyed_tpu
     from jepsen_tpu.models import CASRegister
     from jepsen_tpu.testing import simulate_register_history
 
@@ -107,12 +152,90 @@ def _secondary_metrics():
     # config 2: single 2k-op history
     h = simulate_register_history(2000, n_procs=5, n_vals=8, seed=3,
                                   crash_p=0.002)
-    from jepsen_tpu.checker.tpu import check_history_tpu
     t0 = _t.time()
     r = check_history_tpu(h, CASRegister())
     print(f"# secondary: 2k-op history: {r['valid']} in "
           f"{_t.time()-t0:.2f}s (incl. compile)", file=sys.stderr)
 
 
+# ---------------------------------------------------------------------------
+# Orchestrator
+# ---------------------------------------------------------------------------
+
+
+def _run_child(platform: str, timeout: float):
+    """Run one measurement child. Returns (record | None, note)."""
+    env = dict(os.environ)
+    env["JEPSEN_BENCH_CHILD"] = platform
+    if platform == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+    print(f"# bench: trying platform={platform} (timeout {timeout:.0f}s)",
+          file=sys.stderr)
+    try:
+        pr = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired as e:
+        tail = (e.stderr or "")[-2000:] if isinstance(e.stderr, str) else ""
+        print(tail, file=sys.stderr)
+        return None, f"{platform}: timeout after {timeout:.0f}s"
+    except Exception as e:  # noqa: BLE001
+        return None, f"{platform}: spawn failed: {e!r}"
+    sys.stderr.write(pr.stderr[-4000:] if pr.stderr else "")
+    for line in reversed((pr.stdout or "").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), f"{platform}: ok"
+            except json.JSONDecodeError:
+                continue
+    return None, f"{platform}: no JSON line (rc={pr.returncode})"
+
+
+def main() -> int:
+    deadline = time.time() + BUDGET_S
+    notes = []
+
+    def on_term(signum, frame):  # driver timeout: still leave a record
+        emit(None, 0, error=f"killed by signal {signum}; " + "; ".join(notes))
+        sys.exit(1)
+
+    signal.signal(signal.SIGTERM, on_term)
+    signal.signal(signal.SIGINT, on_term)
+
+    # TPU attempts (sandboxed: a hung plugin init gets killed, not us),
+    # with one backoff retry — transient UNAVAILABLE at init is common.
+    for attempt in range(2):
+        remaining = deadline - time.time()
+        if remaining < 120:
+            notes.append("tpu: out of budget")
+            break
+        rec, note = _run_child("tpu", min(480.0, remaining - 90))
+        notes.append(note)
+        if rec is not None and rec.get("value") is not None:
+            emit(rec["value"], rec["vs_baseline"],
+                 platform=rec.get("platform", "tpu"))
+            return 0
+        if attempt == 0:
+            time.sleep(5)
+
+    # CPU fallback: the same measurement on the host backend — slower but
+    # always records a real number.
+    remaining = deadline - time.time()
+    if remaining > 60:
+        rec, note = _run_child("cpu", remaining - 30)
+        notes.append(note)
+        if rec is not None and rec.get("value") is not None:
+            emit(rec["value"], rec["vs_baseline"], platform="cpu",
+                 note="tpu unavailable; cpu-backend fallback")
+            return 0
+
+    emit(None, 0, error="; ".join(notes))
+    return 1
+
+
 if __name__ == "__main__":
+    plat = os.environ.get("JEPSEN_BENCH_CHILD")
+    if plat:
+        sys.exit(child_main(plat))
     sys.exit(main())
