@@ -7,38 +7,41 @@ is :mod:`jepsen_tpu.checker.wgl`).
 
 Design
 ------
-A WGL configuration is ``(k, mask, state)``: ops ``[0, k)`` in return order
-are linearized, ``mask`` bit *o* marks op ``k+o`` as additionally
-linearized, ``state`` is the model state as one int32 (see
-:class:`jepsen_tpu.models.core.KernelSpec`). The crucial structural fact is
-that **every successor linearizes exactly one more operation**, so the
-search DAG is leveled: a configuration reachable in L moves is reachable
-*only* in L moves. Level-synchronous BFS therefore needs no global visited
-set — deduplicating within each frontier (a sort + adjacent-compare, which
-XLA maps onto the TPU's sort unit) gives the same pruning the CPU oracle
-gets from its hash set.
+A WGL configuration is ``(k, mask, cmask, state)``: ops ``[0, k)`` in
+return order are linearized, ``mask`` bit *o* marks op ``k+o`` as
+additionally linearized, ``cmask`` marks taken crashed ops, ``state`` is
+the model state as one int32 (see
+:class:`jepsen_tpu.models.core.KernelSpec`).
 
-Each level is a fixed-shape tensor program:
+The search is a **best-first pool search** (see :func:`_search_fn`): a pool
+of C configurations lives in sorted device arrays, deepest first. Each
+iteration is a fixed-shape tensor program:
 
-1. expand: ``[C] configs × [W] window offsets -> [C*W]`` candidate
-   successors through the model's branchless integer step kernel (vmapped —
-   thousands of model states per vector lane),
+1. expand the top E pool rows: ``[E] configs × [W] window offsets (+ [CR]
+   crashed ops) -> [E*(W+CR)]`` candidate successors through the model's
+   branchless integer step kernel (vmapped — thousands of model states per
+   vector lane),
 2. detect completion (any successor with ``k >= n_required``),
-3. sort ``[C*W]`` rows lexicographically by (validity, k, mask, state),
-   mark adjacent duplicates, compact survivors to the front,
-4. keep the first C as the next frontier.
+3. merge successors with the unexpanded pool remainder, sort
+   lexicographically by (depth, mask, state, |cmask|, cmask) — XLA maps
+   this onto the TPU sort unit — mark adjacent duplicates and
+   subset-dominated crashed variants,
+4. keep the first C rows as the next pool.
 
-The whole search is one ``lax.while_loop`` under ``jit``; histories are the
-int32 columns of :class:`jepsen_tpu.ops.encode.PackedHistory`. Independent
-keys (the data-parallel axis of reference independent.clj:65-219) batch via
-``vmap`` and shard across a ``jax.sharding.Mesh`` — per-key validity is
-combined host-side (logical AND), counterexamples gathered per key.
+Unexpanded pool rows are the backtrack stack, so the search behaves like a
+massively-parallel DFS: valid histories complete in ~n iterations even
+when the reachable configuration space dwarfs C. The whole search is one
+``lax.while_loop`` under ``jit``; histories are the int32 columns of
+:class:`jepsen_tpu.ops.encode.PackedHistory`. Independent keys (the
+data-parallel axis of reference independent.clj:65-219) batch via ``vmap``
+and shard across a ``jax.sharding.Mesh`` — per-key validity is combined
+host-side (logical AND), counterexamples gathered per key.
 
 Soundness: a found witness proves linearizability outright. An exhausted
-search proves non-linearizability only if neither capacity (frontier > C
-unique configs) nor window (a candidate beyond offset W) overflowed;
-otherwise the result is "unknown" and the caller falls back to the exact
-CPU search.
+search proves non-linearizability only if the pool never truncated (no
+unique config dropped past C) and no candidate ever fell beyond the W
+window; otherwise the result is "unknown", the ladder escalates, and the
+caller finally falls back to the exact CPU search.
 """
 
 from __future__ import annotations
@@ -93,7 +96,7 @@ def _trailing_ones(m):
 
 
 def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
-               fail_fast: bool = False):
+               expand: Optional[int] = None):
     """Build the single-key search. ``n`` is the (static, padded) length of
     the *required* section — ops with finite return, sorted by return index.
     ``n_cr`` is the (static, padded) width of the *crashed* section — 'info'
@@ -102,13 +105,52 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
     candidate set and so can't live in the offset window.
 
     Returns a function
-      (f, v1, v2, inv, ret, sufmin, cf, cv1, cv2, cinv, n_required,
-       init_state) -> (done, exhausted_clean, best_k, levels)
+      (f, v1, v2, inv, ret, sufmin, cf, cv1, cv2, cinv, cps, n_required,
+       init_state) -> (done, lossy, wovf, best_k, levels)
     of jnp scalars. Pure jnp — safe under jit, vmap, and shard_map.
+
+    ``cps[j]`` is the index of the previous crashed op identical to j
+    (same f/v1/v2), or -1: used for the canonical-order pruning below.
+
+    The search is a *best-first pool search*: a pool of C configurations is
+    kept sorted deepest-first; each iteration expands only the top
+    ``expand`` rows (E) and merges their successors back into the pool — a
+    massively-parallel DFS whose unexpanded pool rows are the backtrack
+    stack. ``expand=None`` sets E=C, which degenerates to exact
+    level-synchronous BFS (every pool row expands every level). When a
+    merge produces more than C unique configurations the deepest C survive
+    and ``lossy`` is set; the search keeps going rather than aborting,
+    because a completion witness found by a truncated pool is still a
+    witness. Soundness of the three outcomes: ``done`` proves
+    linearizability outright; pool death with ``lossy`` and ``wovf`` both
+    false is an exhaustive refutation; anything else is UNKNOWN and the
+    caller escalates capacity / falls back to the exact CPU search.
+
+    Two sound prunings keep the crashed-op pool small (2^crashed subsets
+    otherwise — the cmask axis):
+
+    * canonical order among identical crashed ops — if an earlier
+      identical crashed op is available and untaken, taking this one is
+      redundant (any witness can swap the two occurrences);
+    * subset dominance — of two configs with equal (k, mask, state), the
+      one whose taken-crashed set is a subset of the other's can do
+      everything the other can (crashed ops are optional), so the
+      superset config is pruned. The lexsort groups equal (k, mask,
+      state) rows with cmasks in ascending popcount, and each row is
+      tested against its group's first few rows (the likeliest
+      dominators) — a bounded, fixed-shape approximation that only ever
+      prunes genuinely dominated rows.
     """
     C, W, CR = capacity, window, n_cr
+    E = min(expand or C, C)
+    LEADERS = 8  # group-prefix rows tested as dominators
+    MAXK = jnp.int32(1 << 30)
+    #: iteration budget: the witness path alone needs ~n+CR expansions, and
+    #: best-first backtracking re-expands some configs (no global visited
+    #: set); past this the run reports UNKNOWN rather than spin.
+    LMAX = 2 * (n + CR) + 256
 
-    def search(f, v1, v2, inv, ret, sufmin, cf, cv1, cv2, cinv,
+    def search(f, v1, v2, inv, ret, sufmin, cf, cv1, cv2, cinv, cps,
                n_required, init_state):
         offs = jnp.arange(W, dtype=jnp.int32)          # [W]
         coffs = jnp.arange(CR, dtype=jnp.int32)        # [CR]
@@ -118,113 +160,153 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
         cmask0 = jnp.zeros(C, jnp.uint32)
         state0 = jnp.full(C, 0, jnp.int32) + init_state
         alive0 = jnp.arange(C) == 0
-        # (k, mask, cmask, state, alive, done, ovf, wovf, level, best_k)
+        # (k, mask, cmask, state, alive, done, lossy, wovf, level, best_k)
         carry0 = (k0, mask0, cmask0, state0, alive0,
                   n_required == 0, jnp.bool_(False), jnp.bool_(False),
                   jnp.int32(0), jnp.int32(0))
 
         def active(c):
-            k, mask, cmask, state, alive, done, ovf, wovf, level, best = c
-            out = (~done) & jnp.any(alive) & (level <= n + CR)
-            if fail_fast:
-                # ladder mode: an overflowed run will be re-run at the next
-                # rung anyway, so stop paying for levels immediately
-                out = out & ~(ovf | wovf)
-            return out
+            k, mask, cmask, state, alive, done, lossy, wovf, level, best = c
+            return (~done) & jnp.any(alive) & (level <= LMAX)
 
         def body(c):
-            k, mask, cmask, state, alive, done, ovf, wovf, level, best = c
+            k, mask, cmask, state, alive, done, lossy, wovf, level, best = c
 
-            # -- window-overflow probe on the live frontier ----------------
-            kc = jnp.clip(k, 0, n - 1)
-            ret_k = ret[kc]                                     # [C]
-            beyond = sufmin[jnp.clip(k + W, 0, n)]              # [C]
-            wovf2 = wovf | jnp.any(alive & (beyond < ret_k))
+            # -- select the top-E pool rows for expansion (the pool is
+            # sorted deepest-first; invalid rows sank in the merge sort) --
+            k_e, m_e = k[:E], mask[:E]
+            cm_e, s_e, a_e = cmask[:E], state[:E], alive[:E]
 
-            # -- expand required ops: [C, W] successor grid ---------------
-            j = k[:, None] + offs[None, :]                      # [C, W]
+            # -- window-overflow probe on the expanded rows ---------------
+            kc = jnp.clip(k_e, 0, n - 1)
+            ret_k = ret[kc]                                     # [E]
+            beyond = sufmin[jnp.clip(k_e + W, 0, n)]            # [E]
+            wovf2 = wovf | jnp.any(a_e & (beyond < ret_k))
+
+            # -- expand required ops: [E, W] successor grid ---------------
+            j = k_e[:, None] + offs[None, :]                    # [E, W]
             jc = jnp.clip(j, 0, n - 1)
-            cand = (alive[:, None]
+            cand = (a_e[:, None]
                     & (j < n)
                     & (inv[jc] < ret_k[:, None])
-                    & (((mask[:, None] >> offs.astype(jnp.uint32)[None, :])
+                    & (((m_e[:, None] >> offs.astype(jnp.uint32)[None, :])
                         & jnp.uint32(1)) == 0))
-            s2, ok = step(state[:, None], f[jc], v1[jc], v2[jc])
+            s2, ok = step(s_e[:, None], f[jc], v1[jc], v2[jc])
             valid = cand & ok
 
             # frontier advance for o == 0: skip runs of already-linearized
-            m1 = mask >> jnp.uint32(1)
-            t = _trailing_ones(m1)                              # [C]
-            k_adv = k + 1 + t
+            m1 = m_e >> jnp.uint32(1)
+            t = _trailing_ones(m1)                              # [E]
+            k_adv = k_e + 1 + t
             m_adv = jnp.where(t >= 32, jnp.uint32(0),
                               m1 >> jnp.minimum(t, 31).astype(jnp.uint32))
 
             is0 = offs[None, :] == 0                            # [1, W]
-            k2 = jnp.where(is0, k_adv[:, None], k[:, None])
+            k2 = jnp.where(is0, k_adv[:, None], k_e[:, None])
             bit = jnp.uint32(1) << offs.astype(jnp.uint32)[None, :]
-            m2 = jnp.where(is0, m_adv[:, None], mask[:, None] | bit)
-            cm2 = jnp.broadcast_to(cmask[:, None], (C, W))
+            m2 = jnp.where(is0, m_adv[:, None], m_e[:, None] | bit)
+            cm2 = jnp.broadcast_to(cm_e[:, None], (E, W))
             s2 = s2.astype(jnp.int32)
 
-            # -- expand crashed ops: [C, CR] successor grid ---------------
+            # -- expand crashed ops: [E, CR] successor grid ---------------
             # A crashed op is a candidate once invoked before the frontier
             # op's return; it stays one until taken (pad rows: cinv=RET_INF).
-            ccand = (alive[:, None]
+            ccand = (a_e[:, None]
                      & (cinv[None, :] < ret_k[:, None])
-                     & (((cmask[:, None]
+                     & (((cm_e[:, None]
                           >> coffs.astype(jnp.uint32)[None, :])
                          & jnp.uint32(1)) == 0))
-            cs2, cok = step(state[:, None], cf[None, :], cv1[None, :],
+            if CR:
+                # canonical order: skip a crashed op whose earlier identical
+                # twin is available and untaken
+                prevc = jnp.clip(cps, 0, CR - 1)                 # [CR]
+                prev_avail = cinv[prevc][None, :] < ret_k[:, None]
+                prev_taken = (((cm_e[:, None]
+                                >> prevc.astype(jnp.uint32)[None, :])
+                               & jnp.uint32(1)) == 1)
+                redundant = ((cps >= 0)[None, :]
+                             & prev_avail & ~prev_taken)
+                ccand = ccand & ~redundant
+            cs2, cok = step(s_e[:, None], cf[None, :], cv1[None, :],
                             cv2[None, :])
             cvalid = ccand & cok
-            ck2 = jnp.broadcast_to(k[:, None], (C, CR))
-            cmm2 = jnp.broadcast_to(mask[:, None], (C, CR))
+            ck2 = jnp.broadcast_to(k_e[:, None], (E, CR))
+            cmm2 = jnp.broadcast_to(m_e[:, None], (E, CR))
             cbit = jnp.uint32(1) << coffs.astype(jnp.uint32)[None, :]
-            ccm2 = cmask[:, None] | cbit
-            cs2 = jnp.broadcast_to(cs2.astype(jnp.int32), (C, CR))
+            ccm2 = cm_e[:, None] | cbit
+            cs2 = jnp.broadcast_to(cs2.astype(jnp.int32), (E, CR))
 
-            # -- flatten both grids + completion check --------------------
-            fk = jnp.concatenate([k2.reshape(-1), ck2.reshape(-1)])
-            fm = jnp.concatenate([m2.reshape(-1), cmm2.reshape(-1)])
-            fcm = jnp.concatenate([cm2.reshape(-1), ccm2.reshape(-1)])
-            fs = jnp.concatenate([s2.reshape(-1), cs2.reshape(-1)])
-            fv = jnp.concatenate([valid.reshape(-1), cvalid.reshape(-1)])
+            # -- flatten both grids, append the unexpanded pool remainder,
+            # and check completion ----------------------------------------
+            fk = jnp.concatenate([k2.reshape(-1), ck2.reshape(-1), k[E:]])
+            fm = jnp.concatenate([m2.reshape(-1), cmm2.reshape(-1),
+                                  mask[E:]])
+            fcm = jnp.concatenate([cm2.reshape(-1), ccm2.reshape(-1),
+                                   cmask[E:]])
+            fs = jnp.concatenate([s2.reshape(-1), cs2.reshape(-1),
+                                  state[E:]])
+            fv = jnp.concatenate([valid.reshape(-1), cvalid.reshape(-1),
+                                  alive[E:]])
             done2 = done | jnp.any(fv & (fk >= n_required))
             best2 = jnp.maximum(best, jnp.max(jnp.where(fv, fk, 0)))
 
-            # -- dedup: one lexsort; invalid rows sink via the packed
-            # (invalid, k) leading key (k < 2^30 always: int32 indices) ----
-            key1 = jnp.where(fv, fk, fk + jnp.int32(1 << 30))
-            key1, fk, fm, fcm, fs = lax.sort(
-                (key1, fk, fm, fcm, fs), num_keys=5)
-            fv = key1 < (1 << 30)
-            same_prev = jnp.concatenate([
+            # -- dedup + dominance: one lexsort; the deepest configurations
+            # sort first (beam keeps them on truncation) and invalid rows
+            # sink past MAXK; cmask sorts last, by popcount, so each
+            # (k, mask, state) group leads with its fewest-crashed-taken
+            # configs ------------------------------------------------------
+            key1 = jnp.where(fv, MAXK - fk, MAXK + 1 + fk)
+            pc = lax.population_count(fcm).astype(jnp.int32)
+            key1, fm, fs, pc, fcm = lax.sort(
+                (key1, fm, fs, pc, fcm), num_keys=5)
+            fv = key1 <= MAXK
+            fk = jnp.where(fv, MAXK - key1, key1 - (MAXK + 1))
+            same_grp = jnp.concatenate([
                 jnp.zeros(1, bool),
-                (fk[1:] == fk[:-1]) & (fm[1:] == fm[:-1])
-                & (fcm[1:] == fcm[:-1]) & (fs[1:] == fs[:-1])
-                & fv[1:] & fv[:-1],
+                (key1[1:] == key1[:-1]) & (fm[1:] == fm[:-1])
+                & (fs[1:] == fs[:-1]) & fv[1:] & fv[:-1],
             ])
-            uniq = fv & ~same_prev
+            dup = same_grp & jnp.concatenate(
+                [jnp.zeros(1, bool), fcm[1:] == fcm[:-1]])
+            dominated = jnp.zeros(fv.shape, bool)
+            if CR:
+                iota = jnp.arange(fv.shape[0], dtype=jnp.int32)
+                # index of this row's group start (latest non-member row)
+                g = lax.cummax(jnp.where(same_grp, jnp.int32(0), iota))
+                for p in range(LEADERS):
+                    li = jnp.minimum(g + p, iota.shape[0] - 1)
+                    lead = ((key1[li] == key1) & (fm[li] == fm)
+                            & (fs[li] == fs) & (li < iota) & fv)
+                    subset = (fcm & fcm[li]) == fcm[li]
+                    dominated = dominated | (lead & subset)
+            uniq = fv & ~dup & ~dominated
 
-            # -- keep the first C rows as-is: dup rows inside the prefix
-            # just occupy dead slots (they expand to nothing). Conservative
-            # overflow: any unique row past C may have been lost ----------
-            ovf2 = ovf | jnp.any(uniq[C:])
+            # -- pool truncation: keep the first C rows (the deepest
+            # unique configs; dup/dominated rows inside the prefix occupy
+            # dead slots). A unique row past C was dropped: the search is
+            # now lossy — keep going (done is still sound), but pool
+            # death no longer refutes ------------------------------------
+            lossy2 = lossy | jnp.any(uniq[C:])
             k3 = fk[:C]
             m3 = fm[:C]
             cm3 = fcm[:C]
             s3 = fs[:C]
             a3 = uniq[:C]
 
-            new = (k3, m3, cm3, s3, a3, done2, ovf2, wovf2,
+            new = (k3, m3, cm3, s3, a3, done2, lossy2, wovf2,
                    level + 1, best2)
             # Masked update: lanes finished under vmap must not mutate.
             act = active(c)
             return tuple(jnp.where(act, nw, old) for nw, old in zip(new, c))
 
         out = lax.while_loop(active, body, carry0)
-        done, ovf, wovf, level, best = out[5], out[6], out[7], out[8], out[9]
-        return done, ovf, wovf, best, level
+        alive_out, done = out[4], out[5]
+        lossy, wovf = out[6], out[7]
+        level, best = out[8], out[9]
+        # Stopped at the iteration budget with work left: incomplete, so a
+        # non-done outcome must not read as a refutation.
+        lossy = lossy | (~done & jnp.any(alive_out))
+        return done, lossy, wovf, best, level
 
     return search
 
@@ -242,27 +324,28 @@ def _kernel_key(kernel: KernelSpec) -> int:
 
 @functools.lru_cache(maxsize=32)
 def _jit_single(kernel_id: int, capacity: int, window: int,
-                fail_fast: bool = False):
+                expand: Optional[int] = None):
     kernel = _KERNELS_BY_ID[kernel_id]
 
-    def single(f, v1, v2, inv, ret, sm, cf, cv1, cv2, cinv, nr, ini):
+    def single(f, v1, v2, inv, ret, sm, cf, cv1, cv2, cinv, cps, nr, ini):
         search = _search_fn(kernel.step, f.shape[0], cf.shape[0],
-                            capacity, window, fail_fast)
-        return search(f, v1, v2, inv, ret, sm, cf, cv1, cv2, cinv, nr, ini)
+                            capacity, window, expand)
+        return search(f, v1, v2, inv, ret, sm, cf, cv1, cv2, cinv, cps,
+                      nr, ini)
 
     return jax.jit(single)
 
 
 @functools.lru_cache(maxsize=32)
 def _jit_batch(kernel_id: int, capacity: int, window: int,
-               fail_fast: bool = False):
+               expand: Optional[int] = None):
     kernel = _KERNELS_BY_ID[kernel_id]
 
-    def batched(f, v1, v2, inv, ret, sm, cf, cv1, cv2, cinv, nr, ini):
+    def batched(f, v1, v2, inv, ret, sm, cf, cv1, cv2, cinv, cps, nr, ini):
         search = _search_fn(kernel.step, f.shape[1], cf.shape[1],
-                            capacity, window, fail_fast)
+                            capacity, window, expand)
         return jax.vmap(search)(
-            f, v1, v2, inv, ret, sm, cf, cv1, cv2, cinv, nr, ini)
+            f, v1, v2, inv, ret, sm, cf, cv1, cv2, cinv, cps, nr, ini)
 
     return jax.jit(batched)
 
@@ -288,6 +371,17 @@ def _split_packed(p: PackedHistory, breq: int, cr: int) -> Optional[dict]:
     from jepsen_tpu.models.core import NIL_ID
     inf = int(RET_INF)
     inv_req = pad(p.inv[:nr], breq, inf)
+    # cps[j]: previous crashed op with identical (f, v1, v2), or -1 —
+    # drives the canonical-order pruning (identical crashed ops are
+    # interchangeable, so only the lowest available untaken one may be
+    # linearized first).
+    cps = np.full(cr, -1, dtype=np.int32)
+    seen: dict = {}
+    for j in range(n_cr):
+        key = (int(p.f[nr + j]), int(p.v1[nr + j]), int(p.v2[nr + j]))
+        if key in seen:
+            cps[j] = seen[key]
+        seen[key] = j
     return {
         "f": pad(p.f[:nr], breq, 0),
         "v1": pad(p.v1[:nr], breq, NIL_ID),
@@ -299,13 +393,17 @@ def _split_packed(p: PackedHistory, breq: int, cr: int) -> Optional[dict]:
         "cv1": pad(p.v1[nr:], cr, NIL_ID),
         "cv2": pad(p.v2[nr:], cr, NIL_ID),
         "cinv": pad(p.inv[nr:], cr, inf),
+        "cps": cps,
         "nr": np.int32(nr),
-        "ini": np.int32(p.init_state),
+        # two's-complement view: a state word with the sign bit set (e.g.
+        # queue nibble 7 count >= 8) must wrap, not raise OverflowError
+        "ini": np.asarray(int(p.init_state) & 0xFFFFFFFF,
+                          np.uint32).view(np.int32)[()],
     }
 
 
 _COLS = ("f", "v1", "v2", "inv", "ret", "sm", "cf", "cv1", "cv2", "cinv",
-         "nr", "ini")
+         "cps", "nr", "ini")
 
 
 def _crash_width(n_cr: int) -> Optional[int]:
@@ -324,11 +422,11 @@ def _check_window(window: int) -> None:
             f"width would silently corrupt the search")
 
 
-def _result(done: bool, ovf: bool, wovf: bool, best_k: int, levels: int,
+def _result(done: bool, lossy: bool, wovf: bool, best_k: int, levels: int,
             p: Optional[PackedHistory] = None) -> Dict[str, Any]:
     if done:
         return {"valid": True, "levels": levels, "backend": "tpu"}
-    if not (ovf or wovf):
+    if not (lossy or wovf):
         out = {"valid": False, "levels": levels,
                "max-linearized-prefix": best_k, "backend": "tpu"}
         if p is not None and p.ops and best_k < len(p.ops):
@@ -336,17 +434,20 @@ def _result(done: bool, ovf: bool, wovf: bool, best_k: int, levels: int,
             out["frontier-op"] = inv_op.to_dict() if inv_op else None
         return out
     return {"valid": UNKNOWN, "levels": levels,
-            "error": ("frontier capacity exhausted" if ovf
+            "error": ("beam truncated the frontier" if lossy
                       else "candidate window exceeded"),
-            "capacity-overflow": bool(ovf),
+            "capacity-overflow": bool(lossy),
             "window-overflow": bool(wovf),
             "backend": "tpu"}
 
 
-#: Auto-escalation ladder for capacity=None: most real frontiers are tiny,
-#: so start small (per-level sort cost scales with capacity x window) and
-#: only climb when the search overflows.
-ESCALATION = ((256, 16), (1024, 32), (4096, 32), (16384, 32))
+#: Auto-escalation ladder for capacity=None: (capacity, window, expand)
+#: rungs. Best-first rungs (expand < capacity) find witnesses cheaply —
+#: for most *valid* histories the first rung completes regardless of
+#: reachable-space size, since unexpanded pool rows double as the
+#: backtrack stack. Bigger rungs refute exhaustively (pool death with no
+#: truncation) or recover witnesses a narrow pool greedily dropped.
+ESCALATION = ((1024, 32, 64), (4096, 32, 256), (16384, 32, 1024))
 
 
 def check_packed_tpu(p: PackedHistory, kernel: KernelSpec,
@@ -370,19 +471,18 @@ def check_packed_tpu(p: PackedHistory, kernel: KernelSpec,
                          f"crashed-set width {CRASH_MAX}"}
     if capacity is not None:
         _check_window(window or WINDOW)
-        ladder = ((capacity, window or WINDOW),)
+        ladder = ((capacity, window or WINDOW, None),)
     else:
         ladder = ESCALATION
     out: Dict[str, Any] = {}
-    for i, (cap, win) in enumerate(ladder):
-        fail_fast = i < len(ladder) - 1
-        fn = _jit_single(_kernel_key(kernel), cap, win, fail_fast)
-        done, ovf, wovf, best, levels = fn(*(cols[c] for c in _COLS))
-        out = _result(bool(done), bool(ovf), bool(wovf), int(best),
+    for cap, win, exp in ladder:
+        fn = _jit_single(_kernel_key(kernel), cap, win, exp)
+        done, lossy, wovf, best, levels = fn(*(cols[c] for c in _COLS))
+        out = _result(bool(done), bool(lossy), bool(wovf), int(best),
                       int(levels), p)
         if out["valid"] is not UNKNOWN:
             return out
-        if bool(wovf) and win >= WINDOW and not bool(ovf):
+        if bool(wovf) and win >= WINDOW and not bool(lossy):
             return out  # a bigger frontier won't fix a window overflow
     return out
 
@@ -397,10 +497,13 @@ def warm_ladder(p: PackedHistory, kernel: KernelSpec,
             else _split_packed(p, _bucket(p.n_required), cr))
     if cols is None:
         return
+    # n_required=0 completes at level 0: the call compiles (and caches)
+    # the rung for this padded shape without paying a full search.
+    cols = dict(cols)
+    cols["nr"] = np.int32(0)
     ladder = ESCALATION[:rungs] if rungs else ESCALATION
-    for i, (cap, win) in enumerate(ladder):
-        fail_fast = i < len(ESCALATION) - 1
-        fn = _jit_single(_kernel_key(kernel), cap, win, fail_fast)
+    for cap, win, exp in ladder:
+        fn = _jit_single(_kernel_key(kernel), cap, win, exp)
         jax.block_until_ready(fn(*(cols[c] for c in _COLS)))
 
 
@@ -483,11 +586,11 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
 
     if capacity is not None:
         _check_window(window or WINDOW)
-        ladder = ((capacity, window or WINDOW),)
+        ladder = ((capacity, window or WINDOW, None),)
     else:
         ladder = ESCALATION
 
-    for step, (cap, win) in enumerate(ladder):
+    for step, (cap, win, exp) in enumerate(ladder):
         if not rows:
             break
         arrays = [np.stack([cols[c] for _, cols in rows]) for c in _COLS]
@@ -501,16 +604,15 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
                     [a, np.repeat(a[-1:], pad, axis=0)]) for a in arrays]
             sh_row = NamedSharding(mesh, P(axis))
             arrays = [jax.device_put(a, sh_row) for a in arrays]
-        fn = _jit_batch(_kernel_key(kernel), cap, win,
-                        step < len(ladder) - 1)
-        done, ovf, wovf, best, levels = (np.asarray(x)
-                                         for x in fn(*arrays))
+        fn = _jit_batch(_kernel_key(kernel), cap, win, exp)
+        done, lossy, wovf, best, levels = (np.asarray(x)
+                                           for x in fn(*arrays))
         retry = []
         last_rung = step == len(ladder) - 1
         for r, (key, cols) in enumerate(rows):
-            res = _result(bool(done[r]), bool(ovf[r]), bool(wovf[r]),
+            res = _result(bool(done[r]), bool(lossy[r]), bool(wovf[r]),
                           int(best[r]), int(levels[r]), packed[key])
-            escalatable = bool(ovf[r]) or (bool(wovf[r]) and win < WINDOW)
+            escalatable = bool(lossy[r]) or (bool(wovf[r]) and win < WINDOW)
             if res["valid"] is UNKNOWN and escalatable and not last_rung:
                 retry.append((key, cols))
             else:

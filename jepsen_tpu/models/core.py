@@ -290,6 +290,9 @@ F_WRITE = 1
 F_CAS = 2
 F_ACQUIRE = 3
 F_RELEASE = 4
+F_ADD = 5
+F_ENQUEUE = 6
+F_DEQUEUE = 7
 
 #: Interned id for None / "don't care" values.
 NIL_ID = -1
@@ -312,6 +315,16 @@ class KernelSpec:
     #: Map a model *instance* to its packed initial state, given an interner
     #: fn (value -> id). None means init_state is instance-independent.
     pack_init: Optional[Callable] = None
+    #: Kernel-specific op-value encoding:
+    #: (f_code, f, inv_value, ok_value, intern_fn) -> (v1, v2). May raise
+    #: ValueError when a value does not fit the word encoding (the caller
+    #: then falls back to the generic object search). None = default
+    #: interning (jepsen_tpu.ops.encode._op_values).
+    encode_op: Optional[Callable] = None
+    #: Post-pack whole-history validation: (PackedHistory) -> None, raising
+    #: ValueError when the packed history violates a kernel capacity
+    #: invariant (e.g. queue per-value counts exceeding the nibble width).
+    validate: Optional[Callable] = None
 
 
 def _cas_register_step(state, f, v1, v2):
@@ -341,6 +354,128 @@ def _noop_step(state, f, v1, v2):
     return state, (f == f)  # always ok, shape-matching
 
 
+# --- grow-only set: state = presence bitmask over <= 31 interned ids -------
+#
+# add's v1 is the element's bit POSITION; read's v1 is the whole read set as
+# a bitMASK (or NIL_ID for a don't-care read), so consistency is one integer
+# compare. Both encodings are produced by _set_encode below.
+
+SET_MAX_IDS = 31  # ids 0..30: bitmask stays positive in int32
+
+
+def _set_step(state, f, v1, v2):
+    is_add = f == F_ADD
+    is_read = f == F_READ
+    sh = v1 * (v1 >= 0)           # NIL (-1) -> harmless shift of 0
+    bit = (state * 0 + 1) << sh   # 1 in state's dtype/shape
+    read_ok = (v1 == NIL_ID) | (state == v1)
+    ok = is_add | (is_read & read_ok)
+    state2 = state | (bit * is_add)
+    return state2, ok
+
+
+def _set_encode(f_code, f, inv_value, ok_value, intern):
+    if f_code == F_ADD:
+        if inv_value is None:
+            # NIL_ID would alias bit 0 (the first interned element)
+            raise ValueError("set kernel: nil add value")
+        i = intern(inv_value)
+        if i >= SET_MAX_IDS:
+            raise ValueError(
+                f"set kernel: more than {SET_MAX_IDS} distinct elements")
+        return i, NIL_ID
+    # read: completion value (the observed set) wins; encode as bitmask
+    val = ok_value if ok_value is not None else inv_value
+    if val is None:
+        return NIL_ID, NIL_ID
+    m = 0
+    for e in val:
+        i = intern(e)
+        if i >= SET_MAX_IDS:
+            raise ValueError(
+                f"set kernel: more than {SET_MAX_IDS} distinct elements")
+        m |= 1 << i
+    return m, NIL_ID
+
+
+def _set_pack_init(model, intern):
+    m = 0
+    for e in model.items:
+        i = intern(e)
+        if i >= SET_MAX_IDS:
+            raise ValueError(
+                f"set kernel: more than {SET_MAX_IDS} distinct elements")
+        m |= 1 << i
+    return m
+
+
+# --- unordered queue: state = packed per-value pending counts --------------
+#
+# 8 interned values x 4-bit counts. Enqueue increments a nibble, dequeue
+# decrements it when positive. Capacity invariants (<= 8 distinct values,
+# <= 15 simultaneous pending of one value) are enforced by _uqueue_encode /
+# _uqueue_validate; violations raise ValueError, and the caller falls back
+# to the generic object search.
+
+UQUEUE_MAX_IDS = 8
+UQUEUE_MAX_COUNT = 15
+
+
+def _uqueue_step(state, f, v1, v2):
+    is_enq = f == F_ENQUEUE
+    is_deq = f == F_DEQUEUE
+    sh = (v1 * (v1 >= 0)) * 4
+    unit = (state * 0 + 1) << sh
+    cnt = (state >> sh) & 15
+    deq_ok = is_deq & (v1 >= 0) & (cnt > 0)
+    ok = is_enq | deq_ok
+    state2 = state + unit * is_enq - unit * deq_ok
+    return state2, ok
+
+
+def _uqueue_encode(f_code, f, inv_value, ok_value, intern):
+    val = (ok_value if (f_code == F_DEQUEUE and ok_value is not None)
+           else inv_value)
+    if val is None:
+        # e.g. a crashed dequeue whose removed element is unknowable —
+        # the word encoding cannot express "some element"
+        raise ValueError("queue kernel: nil op value")
+    i = intern(val)
+    if i >= UQUEUE_MAX_IDS:
+        raise ValueError(
+            f"queue kernel: more than {UQUEUE_MAX_IDS} distinct values")
+    return i, NIL_ID
+
+
+def _uqueue_pack_init(model, intern):
+    s = 0
+    for v in model.pending:
+        if v is None:
+            raise ValueError("queue kernel: nil pending value")
+        i = intern(v)
+        if i >= UQUEUE_MAX_IDS:
+            raise ValueError(
+                f"queue kernel: more than {UQUEUE_MAX_IDS} distinct values")
+        if ((s >> (4 * i)) & 15) >= UQUEUE_MAX_COUNT:
+            raise ValueError("queue kernel: initial pending count overflow")
+        s += 1 << (4 * i)
+    return s
+
+
+def _uqueue_validate(packed):
+    """Nibble counts must never overflow: initial pending + total enqueues
+    per value <= 15 (dequeues only lower them)."""
+    counts = [(int(packed.init_state) >> (4 * i)) & 15
+              for i in range(UQUEUE_MAX_IDS)]
+    for fc, v in zip(packed.f.tolist(), packed.v1.tolist()):
+        if fc == F_ENQUEUE and v >= 0:
+            counts[v] += 1
+    if max(counts, default=0) > UQUEUE_MAX_COUNT:
+        raise ValueError(
+            f"queue kernel: more than {UQUEUE_MAX_COUNT} enqueues of one "
+            f"value would overflow the count nibble")
+
+
 CAS_REGISTER_KERNEL = KernelSpec(
     name="cas-register",
     init_state=NIL_ID,
@@ -365,15 +500,38 @@ NOOP_KERNEL = KernelSpec(
     f_codes={},
 )
 
+SET_KERNEL = KernelSpec(
+    name="set",
+    init_state=0,
+    step=_set_step,
+    f_codes={"add": F_ADD, "read": F_READ},
+    pack_init=_set_pack_init,
+    encode_op=_set_encode,
+)
+
+UNORDERED_QUEUE_KERNEL = KernelSpec(
+    name="unordered-queue",
+    init_state=0,
+    step=_uqueue_step,
+    f_codes={"enqueue": F_ENQUEUE, "dequeue": F_DEQUEUE},
+    pack_init=_uqueue_pack_init,
+    encode_op=_uqueue_encode,
+    validate=_uqueue_validate,
+)
+
 
 def kernel_spec_for(model: Model) -> Optional[KernelSpec]:
     """Return the integer KernelSpec for a model instance, or None if the
-    model's state does not fit the single-word encoding (sets/queues use the
-    dedicated fold checkers instead of linearization search)."""
+    model's state does not fit the single-word encoding (FIFOQueue needs an
+    ordered state and uses the object search / fold checkers instead)."""
     if isinstance(model, CASRegister):
         return CAS_REGISTER_KERNEL
     if isinstance(model, Mutex):
         return MUTEX_KERNEL
     if isinstance(model, NoOp):
         return NOOP_KERNEL
+    if isinstance(model, SetModel):
+        return SET_KERNEL
+    if isinstance(model, UnorderedQueue):
+        return UNORDERED_QUEUE_KERNEL
     return None

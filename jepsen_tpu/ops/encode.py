@@ -148,6 +148,12 @@ def pack_history(history: Sequence[Op], kernel: KernelSpec,
     return index (RET_INF last, tie-broken by invocation index).
     """
     intern = intern or _Interner()
+    if kernel.encode_op is not None:
+        def encode(fc, f, inv_value, ok_value):
+            return kernel.encode_op(fc, f, inv_value, ok_value, intern.id)
+    else:
+        def encode(fc, f, inv_value, ok_value):
+            return _op_values(fc, f, inv_value, ok_value, intern)
     pending: Dict[Any, Tuple[int, Op]] = {}
     rows = []  # (inv_idx, ret_idx, f, v1, v2, process, inv_op, comp_op)
 
@@ -166,12 +172,11 @@ def pack_history(history: Sequence[Op], kernel: KernelSpec,
             if o.is_info:
                 if fc == F_READ:
                     continue  # crashed read constrains nothing
-                v1, v2 = _op_values(fc, inv_op.f, inv_op.value, None, intern)
+                v1, v2 = encode(fc, inv_op.f, inv_op.value, None)
                 rows.append((inv_ev, int(RET_INF), fc, v1, v2,
                              inv_op.process, inv_op, o))
             else:  # ok
-                v1, v2 = _op_values(fc, inv_op.f, inv_op.value, o.value,
-                                    intern)
+                v1, v2 = encode(fc, inv_op.f, inv_op.value, o.value)
                 rows.append((inv_ev, ev, fc, v1, v2, inv_op.process,
                              inv_op, o))
     # invocations with no completion at all == crashed (same as info)
@@ -179,7 +184,7 @@ def pack_history(history: Sequence[Op], kernel: KernelSpec,
         fc = kernel.f_codes.get(inv_op.f)
         if fc is None or fc == F_READ:
             continue
-        v1, v2 = _op_values(fc, inv_op.f, inv_op.value, None, intern)
+        v1, v2 = encode(fc, inv_op.f, inv_op.value, None)
         rows.append((inv_ev, int(RET_INF), fc, v1, v2, inv_op.process,
                      inv_op, None))
 
@@ -228,6 +233,8 @@ def pack_with_init(history: Sequence[Op], model,
             if kernel.pack_init is not None else kernel.init_state)
     packed = pack_history(history, kernel, intern)
     packed.init_state = init
+    if kernel.validate is not None:
+        kernel.validate(packed)  # raises ValueError on capacity violations
     return packed, kernel
 
 

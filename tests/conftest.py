@@ -21,3 +21,19 @@ try:
     jax.config.update("jax_platforms", "cpu")
 except ImportError:  # pragma: no cover
     pass
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: full-scale (10k-op) checker runs; deselect with "
+        "-m 'not slow'")
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("-m"):
+        return
+    import pytest as _pytest
+    skip = _pytest.mark.skip(reason="slow: run with -m slow")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)

@@ -175,3 +175,252 @@ class TestKeyedBatch:
         keyed = self._keyed(rng, 5)
         out = check_keyed_tpu(keyed, CASRegister(), capacity=256, mesh=mesh)
         assert set(out["results"]) == set(keyed)
+
+
+# ---------------------------------------------------------------------------
+# Set / UnorderedQueue integer kernels (device path for non-register models)
+# ---------------------------------------------------------------------------
+
+from jepsen_tpu.checker.wgl import check_model          # noqa: E402
+from jepsen_tpu.models import SetModel, UnorderedQueue  # noqa: E402
+
+
+def random_set_history(rng, n_procs=3, n_ops=8, n_vals=4, crash_p=0.1,
+                       corrupt_p=0.3):
+    """Random concurrent grow-only-set history. Reads return a snapshot of
+    the elements applied so far, randomly corrupted with corrupt_p."""
+    h = History()
+    free = list(range(n_procs))
+    open_ops = {}
+    applied = set()
+    ops_left = n_ops
+    t = 0
+    while (ops_left > 0 and free) or open_ops:
+        if free and ops_left > 0 and (not open_ops or rng.random() < 0.5):
+            p = rng.choice(free)
+            free.remove(p)
+            ops_left -= 1
+            if rng.random() < 0.6:
+                op = Op(type="invoke", f="add", value=rng.randrange(n_vals),
+                        process=p, time=t)
+            else:
+                op = Op(type="invoke", f="read", value=None, process=p,
+                        time=t)
+            h.append(op)
+            open_ops[p] = op
+        else:
+            p = rng.choice(list(open_ops))
+            inv = open_ops.pop(p)
+            r = rng.random()
+            if r < crash_p and inv.f == "add":
+                h.append(Op(type="info", f=inv.f, value=inv.value,
+                            process=p, time=t))
+            else:
+                if inv.f == "add":
+                    applied.add(inv.value)
+                    h.append(Op(type="ok", f="add", value=inv.value,
+                                process=p, time=t))
+                else:
+                    snap = set(applied)
+                    if rng.random() < corrupt_p:
+                        flip = rng.randrange(n_vals)
+                        snap ^= {flip}
+                    h.append(Op(type="ok", f="read", value=sorted(snap),
+                                process=p, time=t))
+                free.append(p)
+        t += 1
+    return h
+
+
+def random_queue_history(rng, n_procs=3, n_ops=8, n_vals=4, crash_p=0.1,
+                         corrupt_p=0.2):
+    """Random concurrent unordered-queue history: enqueues of small values,
+    dequeues of a pending (or, with corrupt_p, arbitrary) value."""
+    import collections
+    h = History()
+    free = list(range(n_procs))
+    open_ops = {}
+    pending = collections.Counter()
+    ops_left = n_ops
+    t = 0
+    while (ops_left > 0 and free) or open_ops:
+        if free and ops_left > 0 and (not open_ops or rng.random() < 0.5):
+            p = rng.choice(free)
+            free.remove(p)
+            ops_left -= 1
+            if rng.random() < 0.6 or not +pending:
+                op = Op(type="invoke", f="enqueue",
+                        value=rng.randrange(n_vals), process=p, time=t)
+            else:
+                op = Op(type="invoke", f="dequeue", value=None, process=p,
+                        time=t)
+            h.append(op)
+            open_ops[p] = op
+        else:
+            p = rng.choice(list(open_ops))
+            inv = open_ops.pop(p)
+            r = rng.random()
+            if r < crash_p and inv.f == "enqueue":
+                h.append(Op(type="info", f=inv.f, value=inv.value,
+                            process=p, time=t))
+            else:
+                if inv.f == "enqueue":
+                    pending[inv.value] += 1
+                    h.append(Op(type="ok", f="enqueue", value=inv.value,
+                                process=p, time=t))
+                else:
+                    live = sorted(v for v, c in pending.items() if c > 0)
+                    if live and rng.random() >= corrupt_p:
+                        v = rng.choice(live)
+                        pending[v] -= 1
+                    else:
+                        v = rng.randrange(n_vals)
+                    h.append(Op(type="ok", f="dequeue", value=v,
+                                process=p, time=t))
+                free.append(p)
+        t += 1
+    return h
+
+
+class TestSetKernel:
+    def test_valid_and_invalid_golden(self):
+        ok = H((0, "invoke", "add", 1), (0, "ok", "add", 1),
+               (1, "invoke", "read", None), (1, "ok", "read", [1]))
+        assert check_history_tpu(ok, SetModel())["valid"] is True
+        bad = H((0, "invoke", "add", 1), (0, "ok", "add", 1),
+                (1, "invoke", "read", None), (1, "ok", "read", [2]))
+        assert check_history_tpu(bad, SetModel())["valid"] is False
+
+    def test_concurrent_add_read_race(self):
+        # read overlapping the add may see either set
+        h = H((0, "invoke", "add", 1),
+              (1, "invoke", "read", None), (1, "ok", "read", []),
+              (0, "ok", "add", 1),
+              (2, "invoke", "read", None), (2, "ok", "read", [1]))
+        assert check_history_tpu(h, SetModel())["valid"] is True
+
+    def test_initial_items_in_model_instance(self):
+        h = H((0, "invoke", "read", None), (0, "ok", "read", [7]))
+        assert check_history_tpu(h, SetModel({7}))["valid"] is True
+        assert check_history_tpu(h, SetModel({8}))["valid"] is False
+
+    def test_random_golden_vs_object_search(self):
+        rng = random.Random(5)
+        decided = valid = invalid = 0
+        for _ in range(150):
+            h = random_set_history(rng)
+            want = check_model(h, SetModel())["valid"]
+            got = check_history_tpu(h, SetModel(), capacity=512)["valid"]
+            assert got is want or got is UNKNOWN, (want, got, list(h))
+            decided += got is not UNKNOWN
+            valid += want is True and got is True
+            invalid += want is False and got is False
+        # the device path must actually decide (in both directions), not
+        # hide behind UNKNOWN
+        assert decided > 100 and valid and invalid
+
+    def test_too_many_elements_falls_back(self):
+        rows = []
+        for v in range(40):
+            rows += [(0, "invoke", "add", v), (0, "ok", "add", v)]
+        h = H(*rows)
+        assert check_history_tpu(h, SetModel()) is None
+        # facade still answers via the object search
+        assert linearizable(SetModel(), backend="tpu").check(
+            {}, h)["valid"] is True
+
+
+class TestUnorderedQueueKernel:
+    def test_valid_and_invalid_golden(self):
+        ok = H((0, "invoke", "enqueue", 3), (0, "ok", "enqueue", 3),
+               (1, "invoke", "dequeue", None), (1, "ok", "dequeue", 3))
+        assert check_history_tpu(ok, UnorderedQueue())["valid"] is True
+        bad = H((0, "invoke", "enqueue", 3), (0, "ok", "enqueue", 3),
+                (1, "invoke", "dequeue", None), (1, "ok", "dequeue", 4))
+        assert check_history_tpu(bad, UnorderedQueue())["valid"] is False
+
+    def test_unordered_either_element(self):
+        h = H((0, "invoke", "enqueue", 1), (0, "ok", "enqueue", 1),
+              (1, "invoke", "enqueue", 2), (1, "ok", "enqueue", 2),
+              (2, "invoke", "dequeue", None), (2, "ok", "dequeue", 2),
+              (3, "invoke", "dequeue", None), (3, "ok", "dequeue", 1))
+        assert check_history_tpu(h, UnorderedQueue())["valid"] is True
+
+    def test_double_dequeue_invalid(self):
+        h = H((0, "invoke", "enqueue", 1), (0, "ok", "enqueue", 1),
+              (1, "invoke", "dequeue", None), (1, "ok", "dequeue", 1),
+              (2, "invoke", "dequeue", None), (2, "ok", "dequeue", 1))
+        assert check_history_tpu(h, UnorderedQueue())["valid"] is False
+
+    def test_crashed_enqueue_may_apply(self):
+        h = H((0, "invoke", "enqueue", 5), (0, "info", "enqueue", 5),
+              (1, "invoke", "dequeue", None), (1, "ok", "dequeue", 5))
+        assert check_history_tpu(h, UnorderedQueue())["valid"] is True
+
+    def test_random_golden_vs_object_search(self):
+        rng = random.Random(9)
+        decided = valid = invalid = 0
+        for _ in range(150):
+            h = random_queue_history(rng)
+            want = check_model(h, UnorderedQueue())["valid"]
+            got = check_history_tpu(h, UnorderedQueue(),
+                                    capacity=512)["valid"]
+            assert got is want or got is UNKNOWN, (want, got, list(h))
+            decided += got is not UNKNOWN
+            valid += want is True and got is True
+            invalid += want is False and got is False
+        assert decided > 100 and valid and invalid
+
+    def test_crashed_dequeue_falls_back(self):
+        # a crashed dequeue's removed element is unknowable: no word
+        # encoding; the facade answers via the object search
+        h = H((0, "invoke", "enqueue", 1), (0, "ok", "enqueue", 1),
+              (1, "invoke", "dequeue", None), (1, "info", "dequeue", None))
+        assert check_history_tpu(h, UnorderedQueue()) is None
+        assert linearizable(UnorderedQueue(), backend="tpu").check(
+            {}, h)["valid"] is True
+
+    def test_count_nibble_overflow_falls_back(self):
+        rows = []
+        for i in range(17):
+            rows += [(0, "invoke", "enqueue", 9), (0, "ok", "enqueue", 9)]
+        h = H(*rows)
+        assert check_history_tpu(h, UnorderedQueue()) is None
+        assert linearizable(UnorderedQueue(), backend="tpu").check(
+            {}, h)["valid"] is True
+
+
+class TestScale:
+    """North-star scale coverage (VERDICT r1: device path must be exercised
+    beyond toy sizes in CI; the full 10k rung hides behind -m slow)."""
+
+    def test_1k_valid_history_device_path(self):
+        from jepsen_tpu.testing import simulate_register_history
+        h = simulate_register_history(1000, n_procs=5, n_vals=16, seed=42,
+                                      crash_p=0.002)
+        r = check_history_tpu(h, CASRegister())
+        assert r["valid"] is True
+
+    def test_1k_corrupted_history_detected(self):
+        from jepsen_tpu.testing import simulate_register_history
+        h = simulate_register_history(1000, n_procs=5, n_vals=16, seed=42,
+                                      crash_p=0.002)
+        # corrupt one read completion to an impossible value
+        rows = list(h)
+        for i in range(len(rows) - 1, -1, -1):
+            o = rows[i]
+            if o.type == "ok" and o.f == "read" and o.value is not None:
+                rows[i] = o.replace(value=(o.value + 1) % 16)
+                break
+        r = check_history_tpu(History.of(rows), CASRegister())
+        # a corrupted read near the end is either refuted outright or
+        # pushed past every rung (unknown); it must never verify
+        assert r["valid"] is not True
+
+    @pytest.mark.slow
+    def test_10k_valid_history_device_path(self):
+        from jepsen_tpu.testing import simulate_register_history
+        h = simulate_register_history(10_000, n_procs=5, n_vals=16, seed=42,
+                                      crash_p=0.002)
+        r = check_history_tpu(h, CASRegister())
+        assert r["valid"] is True

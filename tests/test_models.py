@@ -133,3 +133,43 @@ class TestKernels:
         assert kernel_spec_for(CASRegister()) is CAS_REGISTER_KERNEL
         assert kernel_spec_for(Mutex()) is MUTEX_KERNEL
         assert kernel_spec_for(FIFOQueue()) is None
+
+
+class TestKernelEncodingEdges:
+    """Regressions: word-encoding edge cases must fall back (ValueError ->
+    object search), never silently alias or corrupt state."""
+
+    def test_set_add_none_falls_back(self):
+        from jepsen_tpu.checker.tpu import check_history_tpu
+        from jepsen_tpu.checker.wgl import check_model, linearizable
+        from jepsen_tpu.history import History, Op
+        rows = [Op(type="invoke", f="add", value=None, process=0, time=0),
+                Op(type="ok", f="add", value=None, process=0, time=1),
+                Op(type="invoke", f="read", value=None, process=1, time=2),
+                Op(type="ok", f="read", value=["x"], process=1, time=3)]
+        h = History.of(rows)
+        assert check_history_tpu(h, SetModel()) is None
+        got = linearizable(SetModel(), backend="tpu").check({}, h)["valid"]
+        assert got is check_model(h, SetModel())["valid"]
+
+    def test_uqueue_init_pending_overflow_falls_back(self):
+        from jepsen_tpu.checker.tpu import check_history_tpu
+        from jepsen_tpu.history import History, Op
+        rows = [Op(type="invoke", f="dequeue", value=None, process=0,
+                   time=0),
+                Op(type="ok", f="dequeue", value="a", process=0, time=1)]
+        h = History.of(rows)
+        assert check_history_tpu(h, UnorderedQueue(("a",) * 16)) is None
+
+    def test_uqueue_sign_bit_init_state_no_crash(self):
+        # value id 7 with 8+ initial pendings sets the int32 sign bit; the
+        # packed conversion must wrap, not raise OverflowError
+        from jepsen_tpu.checker.tpu import check_history_tpu
+        from jepsen_tpu.history import History, Op
+        pending = tuple("abcdefg") + ("h",) * 8
+        rows = [Op(type="invoke", f="dequeue", value=None, process=0,
+                   time=0),
+                Op(type="ok", f="dequeue", value="h", process=0, time=1)]
+        h = History.of(rows)
+        r = check_history_tpu(h, UnorderedQueue(pending))
+        assert r["valid"] is True
