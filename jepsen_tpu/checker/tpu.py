@@ -65,9 +65,12 @@ except ImportError:  # pragma: no cover
     HAVE_JAX = False
 
 
-#: Candidate window width: max offset from the frontier an op may be
-#: linearized at. Bounded below by the history's max concurrency.
+#: Default candidate window width: max offset from the frontier an op may
+#: be linearized at. Bounded below by the history's max concurrency. The
+#: multi-word mask representation supports windows up to MAX_WINDOW; the
+#: escalation ladder widens the window together with the pool.
 WINDOW = 32
+MAX_WINDOW = 128
 
 
 def _bucket(n: int, lo: int = 16) -> int:
@@ -143,6 +146,8 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
     """
     C, W, CR = capacity, window, n_cr
     E = min(expand or C, C)
+    MW = (W + 31) // 32           # mask words (window bits)
+    MC = (CR + 31) // 32          # crashed-mask words
     LEADERS = 8  # group-prefix rows tested as dominators
     MAXK = jnp.int32(1 << 30)
     #: iteration budget: the witness path alone needs ~n+CR expansions, and
@@ -150,14 +155,55 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
     #: set); past this the run reports UNKNOWN rather than spin.
     LMAX = 2 * (n + CR) + 256
 
+    # Static bit matrices: bitmat[o, w] has bit (o mod 32) set iff offset o
+    # lives in word w — one uint32 AND/OR against them tests/sets any bit of
+    # a multi-word mask without dynamic shifts.
+    bitmat = np.zeros((max(W, 1), max(MW, 1)), dtype=np.uint32)
+    for o in range(W):
+        bitmat[o, o >> 5] = np.uint32(1) << np.uint32(o & 31)
+    cbitmat = np.zeros((max(CR, 1), max(MC, 1)), dtype=np.uint32)
+    for o in range(CR):
+        cbitmat[o, o >> 5] = np.uint32(1) << np.uint32(o & 31)
+
+    def _shr1(m):
+        """Whole-mask right shift by one bit: [*, MW] -> [*, MW]."""
+        parts = []
+        for w in range(MW):
+            lo = m[..., w] >> jnp.uint32(1)
+            if w + 1 < MW:
+                lo = lo | (m[..., w + 1] << jnp.uint32(31))
+            parts.append(lo)
+        return jnp.stack(parts, axis=-1)
+
+    def _trailing_ones_multi(m):
+        """Trailing one-bits across the whole [*, MW] mask."""
+        tw = [_trailing_ones(m[..., w]) for w in range(MW)]
+        t = tw[0]
+        for w in range(1, MW):
+            t = jnp.where(t == 32 * w, 32 * w + tw[w], t)
+        return t
+
+    def _shr_by(m, t):
+        """Whole-mask right shift by a per-row amount t in [0, 32*MW]."""
+        mpad = jnp.concatenate(
+            [m, jnp.zeros(m.shape[:-1] + (1,), jnp.uint32)], axis=-1)
+        ws = (t >> 5)[:, None]
+        bs = (t & 31).astype(jnp.uint32)[:, None]
+        widx = jnp.arange(MW, dtype=jnp.int32)[None, :]
+        a = jnp.take_along_axis(mpad, jnp.clip(widx + ws, 0, MW), axis=-1)
+        b = jnp.take_along_axis(mpad, jnp.clip(widx + ws + 1, 0, MW),
+                                axis=-1)
+        hi = jnp.where(bs > 0, b << jnp.minimum(
+            jnp.uint32(32) - bs, jnp.uint32(31)), jnp.uint32(0))
+        return (a >> bs) | hi
+
     def search(f, v1, v2, inv, ret, sufmin, cf, cv1, cv2, cinv, cps,
                n_required, init_state):
         offs = jnp.arange(W, dtype=jnp.int32)          # [W]
-        coffs = jnp.arange(CR, dtype=jnp.int32)        # [CR]
 
         k0 = jnp.zeros(C, jnp.int32)
-        mask0 = jnp.zeros(C, jnp.uint32)
-        cmask0 = jnp.zeros(C, jnp.uint32)
+        mask0 = jnp.zeros((C, MW), jnp.uint32)
+        cmask0 = jnp.zeros((C, max(MC, 1)), jnp.uint32)
         state0 = jnp.full(C, 0, jnp.int32) + init_state
         alive0 = jnp.arange(C) == 0
         # (k, mask, cmask, state, alive, done, lossy, wovf, level, best_k)
@@ -186,88 +232,113 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
             # -- expand required ops: [E, W] successor grid ---------------
             j = k_e[:, None] + offs[None, :]                    # [E, W]
             jc = jnp.clip(j, 0, n - 1)
+            already = jnp.any(
+                (m_e[:, None, :] & bitmat[None, :, :]) != 0, axis=-1)
             cand = (a_e[:, None]
                     & (j < n)
                     & (inv[jc] < ret_k[:, None])
-                    & (((m_e[:, None] >> offs.astype(jnp.uint32)[None, :])
-                        & jnp.uint32(1)) == 0))
+                    & ~already)
             s2, ok = step(s_e[:, None], f[jc], v1[jc], v2[jc])
             valid = cand & ok
 
             # frontier advance for o == 0: skip runs of already-linearized
-            m1 = m_e >> jnp.uint32(1)
-            t = _trailing_ones(m1)                              # [E]
+            m1 = _shr1(m_e)
+            t = _trailing_ones_multi(m1)                        # [E]
             k_adv = k_e + 1 + t
-            m_adv = jnp.where(t >= 32, jnp.uint32(0),
-                              m1 >> jnp.minimum(t, 31).astype(jnp.uint32))
+            m_adv = _shr_by(m1, t)
 
             is0 = offs[None, :] == 0                            # [1, W]
             k2 = jnp.where(is0, k_adv[:, None], k_e[:, None])
-            bit = jnp.uint32(1) << offs.astype(jnp.uint32)[None, :]
-            m2 = jnp.where(is0, m_adv[:, None], m_e[:, None] | bit)
-            cm2 = jnp.broadcast_to(cm_e[:, None], (E, W))
+            m2 = jnp.where(is0[:, :, None], m_adv[:, None, :],
+                           m_e[:, None, :] | bitmat[None, :, :])  # [E,W,MW]
+            cm2 = jnp.broadcast_to(cm_e[:, None, :],
+                                   (E, W, max(MC, 1)))
             s2 = s2.astype(jnp.int32)
 
             # -- expand crashed ops: [E, CR] successor grid ---------------
             # A crashed op is a candidate once invoked before the frontier
             # op's return; it stays one until taken (pad rows: cinv=RET_INF).
-            ccand = (a_e[:, None]
-                     & (cinv[None, :] < ret_k[:, None])
-                     & (((cm_e[:, None]
-                          >> coffs.astype(jnp.uint32)[None, :])
-                         & jnp.uint32(1)) == 0))
             if CR:
+                ctaken = jnp.any(
+                    (cm_e[:, None, :] & cbitmat[None, :, :]) != 0, axis=-1)
+                ccand = (a_e[:, None]
+                         & (cinv[None, :] < ret_k[:, None])
+                         & ~ctaken)
                 # canonical order: skip a crashed op whose earlier identical
                 # twin is available and untaken
                 prevc = jnp.clip(cps, 0, CR - 1)                 # [CR]
                 prev_avail = cinv[prevc][None, :] < ret_k[:, None]
-                prev_taken = (((cm_e[:, None]
-                                >> prevc.astype(jnp.uint32)[None, :])
-                               & jnp.uint32(1)) == 1)
+                pw = prevc >> 5                                  # [CR]
+                pb = (prevc & 31).astype(jnp.uint32)
+                prev_taken = ((jnp.take(cm_e, pw, axis=1)
+                               >> pb[None, :]) & jnp.uint32(1)) == 1
                 redundant = ((cps >= 0)[None, :]
                              & prev_avail & ~prev_taken)
                 ccand = ccand & ~redundant
-            cs2, cok = step(s_e[:, None], cf[None, :], cv1[None, :],
-                            cv2[None, :])
-            cvalid = ccand & cok
-            ck2 = jnp.broadcast_to(k_e[:, None], (E, CR))
-            cmm2 = jnp.broadcast_to(m_e[:, None], (E, CR))
-            cbit = jnp.uint32(1) << coffs.astype(jnp.uint32)[None, :]
-            ccm2 = cm_e[:, None] | cbit
-            cs2 = jnp.broadcast_to(cs2.astype(jnp.int32), (E, CR))
+                cs2, cok = step(s_e[:, None], cf[None, :], cv1[None, :],
+                                cv2[None, :])
+                cvalid = ccand & cok
+                ck2 = jnp.broadcast_to(k_e[:, None], (E, CR))
+                cmm2 = jnp.broadcast_to(m_e[:, None, :], (E, CR, MW))
+                ccm2 = cm_e[:, None, :] | cbitmat[None, :, :]
+                cs2 = jnp.broadcast_to(cs2.astype(jnp.int32), (E, CR))
+                crash_rows = [
+                    (ck2.reshape(-1), cmm2.reshape(-1, MW),
+                     ccm2.reshape(-1, max(MC, 1)), cs2.reshape(-1),
+                     cvalid.reshape(-1))]
+            else:
+                crash_rows = []
 
             # -- flatten both grids, append the unexpanded pool remainder,
             # and check completion ----------------------------------------
-            fk = jnp.concatenate([k2.reshape(-1), ck2.reshape(-1), k[E:]])
-            fm = jnp.concatenate([m2.reshape(-1), cmm2.reshape(-1),
-                                  mask[E:]])
-            fcm = jnp.concatenate([cm2.reshape(-1), ccm2.reshape(-1),
-                                   cmask[E:]])
-            fs = jnp.concatenate([s2.reshape(-1), cs2.reshape(-1),
-                                  state[E:]])
-            fv = jnp.concatenate([valid.reshape(-1), cvalid.reshape(-1),
-                                  alive[E:]])
+            segs = ([(k2.reshape(-1), m2.reshape(-1, MW),
+                      cm2.reshape(-1, max(MC, 1)), s2.reshape(-1),
+                      valid.reshape(-1))]
+                    + crash_rows
+                    + [(k[E:], mask[E:], cmask[E:], state[E:], alive[E:])])
+            fk = jnp.concatenate([s[0] for s in segs])
+            fm = jnp.concatenate([s[1] for s in segs])
+            fcm = jnp.concatenate([s[2] for s in segs])
+            fs = jnp.concatenate([s[3] for s in segs])
+            fv = jnp.concatenate([s[4] for s in segs])
             done2 = done | jnp.any(fv & (fk >= n_required))
             best2 = jnp.maximum(best, jnp.max(jnp.where(fv, fk, 0)))
 
             # -- dedup + dominance: one lexsort; the deepest configurations
-            # sort first (beam keeps them on truncation) and invalid rows
-            # sink past MAXK; cmask sorts last, by popcount, so each
+            # sort first (truncation keeps them) and invalid rows sink past
+            # MAXK; cmask words sort last, by popcount, so each
             # (k, mask, state) group leads with its fewest-crashed-taken
             # configs ------------------------------------------------------
             key1 = jnp.where(fv, MAXK - fk, MAXK + 1 + fk)
-            pc = lax.population_count(fcm).astype(jnp.int32)
-            key1, fm, fs, pc, fcm = lax.sort(
-                (key1, fm, fs, pc, fcm), num_keys=5)
+            fmw = [fm[:, w] for w in range(MW)]
+            fcmw = [fcm[:, w] for w in range(MC)]
+            if MC:
+                pc = fcmw[0] * 0
+                for w in range(MC):
+                    pc = pc + lax.population_count(fcmw[w])
+                terms = ([key1] + fmw + [fs, pc.astype(jnp.int32)] + fcmw)
+            else:
+                terms = [key1] + fmw + [fs]
+            sorted_terms = lax.sort(tuple(terms), num_keys=len(terms))
+            key1 = sorted_terms[0]
+            fmw = list(sorted_terms[1:1 + MW])
+            fs = sorted_terms[1 + MW]
+            fcmw = list(sorted_terms[3 + MW:]) if MC else []
             fv = key1 <= MAXK
             fk = jnp.where(fv, MAXK - key1, key1 - (MAXK + 1))
-            same_grp = jnp.concatenate([
-                jnp.zeros(1, bool),
-                (key1[1:] == key1[:-1]) & (fm[1:] == fm[:-1])
-                & (fs[1:] == fs[:-1]) & fv[1:] & fv[:-1],
-            ])
-            dup = same_grp & jnp.concatenate(
-                [jnp.zeros(1, bool), fcm[1:] == fcm[:-1]])
+
+            def _eq_prev(a):
+                return a[1:] == a[:-1]
+
+            grp_eq = _eq_prev(key1) & _eq_prev(fs)
+            for w in range(MW):
+                grp_eq = grp_eq & _eq_prev(fmw[w])
+            same_grp = jnp.concatenate(
+                [jnp.zeros(1, bool), grp_eq & fv[1:] & fv[:-1]])
+            cm_eq = jnp.ones(same_grp.shape[0] - 1, bool)
+            for w in range(MC):
+                cm_eq = cm_eq & _eq_prev(fcmw[w])
+            dup = same_grp & jnp.concatenate([jnp.zeros(1, bool), cm_eq])
             dominated = jnp.zeros(fv.shape, bool)
             if CR:
                 iota = jnp.arange(fv.shape[0], dtype=jnp.int32)
@@ -275,9 +346,14 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
                 g = lax.cummax(jnp.where(same_grp, jnp.int32(0), iota))
                 for p in range(LEADERS):
                     li = jnp.minimum(g + p, iota.shape[0] - 1)
-                    lead = ((key1[li] == key1) & (fm[li] == fm)
-                            & (fs[li] == fs) & (li < iota) & fv)
-                    subset = (fcm & fcm[li]) == fcm[li]
+                    lead = ((key1[li] == key1) & (fs[li] == fs)
+                            & (li < iota) & fv)
+                    subset = jnp.ones(fv.shape, bool)
+                    for w in range(MW):
+                        lead = lead & (fmw[w][li] == fmw[w])
+                    for w in range(MC):
+                        subset = subset & (
+                            (fcmw[w] & fcmw[w][li]) == fcmw[w][li])
                     dominated = dominated | (lead & subset)
             uniq = fv & ~dup & ~dominated
 
@@ -288,8 +364,11 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
             # death no longer refutes ------------------------------------
             lossy2 = lossy | jnp.any(uniq[C:])
             k3 = fk[:C]
-            m3 = fm[:C]
-            cm3 = fcm[:C]
+            m3 = jnp.stack([w_[:C] for w_ in fmw], axis=-1)
+            if MC:
+                cm3 = jnp.stack([w_[:C] for w_ in fcmw], axis=-1)
+            else:
+                cm3 = cmask
             s3 = fs[:C]
             a3 = uniq[:C]
 
@@ -350,8 +429,8 @@ def _jit_batch(kernel_id: int, capacity: int, window: int,
     return jax.jit(batched)
 
 
-#: Max crashed ('info') ops per key: the crashed-set bitmask is uint32.
-CRASH_MAX = 32
+#: Max crashed ('info') ops per key (the crashed-set mask is two words).
+CRASH_MAX = 64
 
 
 def _split_packed(p: PackedHistory, breq: int, cr: int) -> Optional[dict]:
@@ -416,10 +495,10 @@ def _crash_width(n_cr: int) -> Optional[int]:
 
 
 def _check_window(window: int) -> None:
-    if window > 32:
+    if window > MAX_WINDOW:
         raise ValueError(
-            f"window {window} > 32: masks are uint32; shifts past the word "
-            f"width would silently corrupt the search")
+            f"window {window} > {MAX_WINDOW}: wider windows need more mask "
+            f"words than the search carries")
 
 
 def _result(done: bool, lossy: bool, wovf: bool, best_k: int, levels: int,
@@ -447,7 +526,7 @@ def _result(done: bool, lossy: bool, wovf: bool, best_k: int, levels: int,
 #: reachable-space size, since unexpanded pool rows double as the
 #: backtrack stack. Bigger rungs refute exhaustively (pool death with no
 #: truncation) or recover witnesses a narrow pool greedily dropped.
-ESCALATION = ((1024, 32, 64), (4096, 32, 256), (16384, 32, 1024))
+ESCALATION = ((1024, 32, 64), (4096, 64, 256), (16384, 128, 1024))
 
 
 def check_packed_tpu(p: PackedHistory, kernel: KernelSpec,
@@ -482,7 +561,7 @@ def check_packed_tpu(p: PackedHistory, kernel: KernelSpec,
                       int(levels), p)
         if out["valid"] is not UNKNOWN:
             return out
-        if bool(wovf) and win >= WINDOW and not bool(lossy):
+        if bool(wovf) and win >= MAX_WINDOW and not bool(lossy):
             return out  # a bigger frontier won't fix a window overflow
     return out
 
@@ -612,7 +691,8 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
         for r, (key, cols) in enumerate(rows):
             res = _result(bool(done[r]), bool(lossy[r]), bool(wovf[r]),
                           int(best[r]), int(levels[r]), packed[key])
-            escalatable = bool(lossy[r]) or (bool(wovf[r]) and win < WINDOW)
+            escalatable = (bool(lossy[r])
+                           or (bool(wovf[r]) and win < MAX_WINDOW))
             if res["valid"] is UNKNOWN and escalatable and not last_rung:
                 retry.append((key, cols))
             else:
