@@ -98,6 +98,41 @@ def _trailing_ones(m):
     return lax.population_count(low - jnp.uint32(1)).astype(jnp.int32)
 
 
+def _shr1_multi(m, MW: int):
+    """Whole-mask right shift by one bit: [*, MW] -> [*, MW]."""
+    parts = []
+    for w in range(MW):
+        lo = m[..., w] >> jnp.uint32(1)
+        if w + 1 < MW:
+            lo = lo | (m[..., w + 1] << jnp.uint32(31))
+        parts.append(lo)
+    return jnp.stack(parts, axis=-1)
+
+
+def _trailing_ones_mw(m, MW: int):
+    """Trailing one-bits across the whole [*, MW] mask."""
+    tw = [_trailing_ones(m[..., w]) for w in range(MW)]
+    t = tw[0]
+    for w in range(1, MW):
+        t = jnp.where(t == 32 * w, 32 * w + tw[w], t)
+    return t
+
+
+def _shr_by_mw(m, t, MW: int):
+    """Whole-mask right shift by a per-row amount t in [0, 32*MW]."""
+    mpad = jnp.concatenate(
+        [m, jnp.zeros(m.shape[:-1] + (1,), jnp.uint32)], axis=-1)
+    ws = (t >> 5)[:, None]
+    bs = (t & 31).astype(jnp.uint32)[:, None]
+    widx = jnp.arange(MW, dtype=jnp.int32)[None, :]
+    a = jnp.take_along_axis(mpad, jnp.clip(widx + ws, 0, MW), axis=-1)
+    b = jnp.take_along_axis(mpad, jnp.clip(widx + ws + 1, 0, MW),
+                            axis=-1)
+    hi = jnp.where(bs > 0, b << jnp.minimum(
+        jnp.uint32(32) - bs, jnp.uint32(31)), jnp.uint32(0))
+    return (a >> bs) | hi
+
+
 def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
                expand: Optional[int] = None):
     """Build the single-key search. ``n`` is the (static, padded) length of
@@ -108,9 +143,12 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
     candidate set and so can't live in the offset window.
 
     Returns a function
-      (f, v1, v2, inv, ret, sufmin, cf, cv1, cv2, cinv, cps, n_required,
-       init_state) -> (done, lossy, wovf, best_k, levels)
+      (f, v1, v2, ro, inv, ret, sufmin, cf, cv1, cv2, cinv, cps,
+       n_required, init_state) -> (done, lossy, wovf, best_k, levels)
     of jnp scalars. Pure jnp — safe under jit, vmap, and shard_map.
+    ``ro[j]`` is 1 iff op j is *read-only* — its step can never change the
+    state at any state where it succeeds (kernel.readonly) — which drives
+    the greedy pure-op closure below.
 
     ``cps[j]`` is the index of the previous crashed op identical to j
     (same f/v1/v2), or -1: used for the canonical-order pruning below.
@@ -166,38 +204,15 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
         cbitmat[o, o >> 5] = np.uint32(1) << np.uint32(o & 31)
 
     def _shr1(m):
-        """Whole-mask right shift by one bit: [*, MW] -> [*, MW]."""
-        parts = []
-        for w in range(MW):
-            lo = m[..., w] >> jnp.uint32(1)
-            if w + 1 < MW:
-                lo = lo | (m[..., w + 1] << jnp.uint32(31))
-            parts.append(lo)
-        return jnp.stack(parts, axis=-1)
+        return _shr1_multi(m, MW)
 
     def _trailing_ones_multi(m):
-        """Trailing one-bits across the whole [*, MW] mask."""
-        tw = [_trailing_ones(m[..., w]) for w in range(MW)]
-        t = tw[0]
-        for w in range(1, MW):
-            t = jnp.where(t == 32 * w, 32 * w + tw[w], t)
-        return t
+        return _trailing_ones_mw(m, MW)
 
     def _shr_by(m, t):
-        """Whole-mask right shift by a per-row amount t in [0, 32*MW]."""
-        mpad = jnp.concatenate(
-            [m, jnp.zeros(m.shape[:-1] + (1,), jnp.uint32)], axis=-1)
-        ws = (t >> 5)[:, None]
-        bs = (t & 31).astype(jnp.uint32)[:, None]
-        widx = jnp.arange(MW, dtype=jnp.int32)[None, :]
-        a = jnp.take_along_axis(mpad, jnp.clip(widx + ws, 0, MW), axis=-1)
-        b = jnp.take_along_axis(mpad, jnp.clip(widx + ws + 1, 0, MW),
-                                axis=-1)
-        hi = jnp.where(bs > 0, b << jnp.minimum(
-            jnp.uint32(32) - bs, jnp.uint32(31)), jnp.uint32(0))
-        return (a >> bs) | hi
+        return _shr_by_mw(m, t, MW)
 
-    def search(f, v1, v2, inv, ret, sufmin, cf, cv1, cv2, cinv, cps,
+    def search(f, v1, v2, ro, inv, ret, sufmin, cf, cv1, cv2, cinv, cps,
                n_required, init_state):
         offs = jnp.arange(W, dtype=jnp.int32)          # [W]
 
@@ -239,7 +254,39 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
                     & (inv[jc] < ret_k[:, None])
                     & ~already)
             s2, ok = step(s_e[:, None], f[jc], v1[jc], v2[jc])
-            valid = cand & ok
+            # Partial-order reduction: a READ-ONLY candidate (ro: its step
+            # can never change the state at ANY state where it succeeds —
+            # a register read, a cas(x,x), a set read) that succeeds now
+            # can always be linearized immediately: moving it earlier in a
+            # witness never invalidates the steps it jumps over, because
+            # it changes nothing anywhere. So each expanded config emits
+            # ONE closure successor taking all such pure candidates at
+            # once, and branches only over the rest. This collapses the
+            # 2^reads subset explosion on read-heavy histories and is
+            # sound for refutation too (every witness normalizes to a
+            # greedy-pure witness, and those are explored exhaustively).
+            # NOTE the test must be ro, not "state unchanged here": an op
+            # that is incidentally pure at the current state (a rewrite of
+            # the current value) may be needed later as a state-RESTORING
+            # step, so it is not safely movable.
+            pure = cand & ok & (ro[jc] > 0)
+            valid = cand & ok & ~pure
+
+            # closure successor: take all pure candidates, then advance the
+            # frontier past the (possibly long) run of linearized ops
+            pure_bits = jnp.sum(
+                jnp.where(pure[:, :, None], bitmat[None, :, :],
+                          jnp.uint32(0)),
+                axis=1, dtype=jnp.uint32)                       # [E, MW]
+            mc_ = m_e | pure_bits
+            tc_ = _trailing_ones_multi(mc_)
+            kcl = k_e + tc_
+            mcl = _shr_by(mc_, tc_)
+            closure_ok = a_e & jnp.any(pure, axis=1)            # [E]
+            # full reduction: a config with pure candidates emits ONLY its
+            # closure successor — impure (and crashed) branches happen
+            # after the pure ops are absorbed, from the closure config
+            valid = valid & ~closure_ok[:, None]
 
             # frontier advance for o == 0: skip runs of already-linearized
             m1 = _shr1(m_e)
@@ -262,6 +309,7 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
                 ctaken = jnp.any(
                     (cm_e[:, None, :] & cbitmat[None, :, :]) != 0, axis=-1)
                 ccand = (a_e[:, None]
+                         & ~closure_ok[:, None]
                          & (cinv[None, :] < ret_k[:, None])
                          & ~ctaken)
                 # canonical order: skip a crashed op whose earlier identical
@@ -277,7 +325,11 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
                 ccand = ccand & ~redundant
                 cs2, cok = step(s_e[:, None], cf[None, :], cv1[None, :],
                                 cv2[None, :])
-                cvalid = ccand & cok
+                # a pure crashed op need never be taken: it is optional and
+                # leaves the state unchanged, so the untaken config
+                # dominates (exactly the subset-dominance rule, applied
+                # exhaustively at generation time)
+                cvalid = ccand & cok & (cs2 != s_e[:, None])
                 ck2 = jnp.broadcast_to(k_e[:, None], (E, CR))
                 cmm2 = jnp.broadcast_to(m_e[:, None, :], (E, CR, MW))
                 ccm2 = cm_e[:, None, :] | cbitmat[None, :, :]
@@ -293,7 +345,8 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
             # and check completion ----------------------------------------
             segs = ([(k2.reshape(-1), m2.reshape(-1, MW),
                       cm2.reshape(-1, max(MC, 1)), s2.reshape(-1),
-                      valid.reshape(-1))]
+                      valid.reshape(-1)),
+                     (kcl, mcl, cm_e, s_e, closure_ok)]
                     + crash_rows
                     + [(k[E:], mask[E:], cmask[E:], state[E:], alive[E:])])
             fk = jnp.concatenate([s[0] for s in segs])
@@ -306,31 +359,42 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
 
             # -- dedup + dominance: one lexsort; the deepest configurations
             # sort first (truncation keeps them) and invalid rows sink past
-            # MAXK; cmask words sort last, by popcount, so each
-            # (k, mask, state) group leads with its fewest-crashed-taken
-            # configs ------------------------------------------------------
-            key1 = jnp.where(fv, MAXK - fk, MAXK + 1 + fk)
+            # MAXK. Depth is the TOTAL linearized count k + |mask| — not k
+            # alone: in histories where commit order diverges from return
+            # order (e.g. a burst of ~100 concurrent ops completing in an
+            # unrelated order) progress accumulates in the mask while k
+            # stays near zero, and a k-keyed pool buries it. k rides along
+            # as a secondary sort term (configs are only equal when
+            # (k, mask, state) all match). cmask words sort last, by
+            # popcount, so each (k, mask, state) group leads with its
+            # fewest-crashed-taken configs --------------------------------
+            pm = fk * 0
+            for w in range(MW):
+                pm = pm + lax.population_count(fm[:, w]).astype(jnp.int32)
+            depth = fk + pm
+            key1 = jnp.where(fv, MAXK - depth, MAXK + 1 + fk)
             fmw = [fm[:, w] for w in range(MW)]
             fcmw = [fcm[:, w] for w in range(MC)]
             if MC:
                 pc = fcmw[0] * 0
                 for w in range(MC):
                     pc = pc + lax.population_count(fcmw[w])
-                terms = ([key1] + fmw + [fs, pc.astype(jnp.int32)] + fcmw)
+                terms = ([key1, fk] + fmw
+                         + [fs, pc.astype(jnp.int32)] + fcmw)
             else:
-                terms = [key1] + fmw + [fs]
+                terms = [key1, fk] + fmw + [fs]
             sorted_terms = lax.sort(tuple(terms), num_keys=len(terms))
             key1 = sorted_terms[0]
-            fmw = list(sorted_terms[1:1 + MW])
-            fs = sorted_terms[1 + MW]
-            fcmw = list(sorted_terms[3 + MW:]) if MC else []
+            fk = sorted_terms[1]
+            fmw = list(sorted_terms[2:2 + MW])
+            fs = sorted_terms[2 + MW]
+            fcmw = list(sorted_terms[4 + MW:]) if MC else []
             fv = key1 <= MAXK
-            fk = jnp.where(fv, MAXK - key1, key1 - (MAXK + 1))
 
             def _eq_prev(a):
                 return a[1:] == a[:-1]
 
-            grp_eq = _eq_prev(key1) & _eq_prev(fs)
+            grp_eq = _eq_prev(key1) & _eq_prev(fk) & _eq_prev(fs)
             for w in range(MW):
                 grp_eq = grp_eq & _eq_prev(fmw[w])
             same_grp = jnp.concatenate(
@@ -346,8 +410,8 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
                 g = lax.cummax(jnp.where(same_grp, jnp.int32(0), iota))
                 for p in range(LEADERS):
                     li = jnp.minimum(g + p, iota.shape[0] - 1)
-                    lead = ((key1[li] == key1) & (fs[li] == fs)
-                            & (li < iota) & fv)
+                    lead = ((key1[li] == key1) & (fk[li] == fk)
+                            & (fs[li] == fs) & (li < iota) & fv)
                     subset = jnp.ones(fv.shape, bool)
                     for w in range(MW):
                         lead = lead & (fmw[w][li] == fmw[w])
@@ -406,11 +470,12 @@ def _jit_single(kernel_id: int, capacity: int, window: int,
                 expand: Optional[int] = None):
     kernel = _KERNELS_BY_ID[kernel_id]
 
-    def single(f, v1, v2, inv, ret, sm, cf, cv1, cv2, cinv, cps, nr, ini):
+    def single(f, v1, v2, ro, inv, ret, sm, cf, cv1, cv2, cinv, cps,
+               nr, ini):
         search = _search_fn(kernel.step, f.shape[0], cf.shape[0],
                             capacity, window, expand)
-        return search(f, v1, v2, inv, ret, sm, cf, cv1, cv2, cinv, cps,
-                      nr, ini)
+        return search(f, v1, v2, ro, inv, ret, sm, cf, cv1, cv2, cinv,
+                      cps, nr, ini)
 
     return jax.jit(single)
 
@@ -420,11 +485,13 @@ def _jit_batch(kernel_id: int, capacity: int, window: int,
                expand: Optional[int] = None):
     kernel = _KERNELS_BY_ID[kernel_id]
 
-    def batched(f, v1, v2, inv, ret, sm, cf, cv1, cv2, cinv, cps, nr, ini):
+    def batched(f, v1, v2, ro, inv, ret, sm, cf, cv1, cv2, cinv, cps,
+                nr, ini):
         search = _search_fn(kernel.step, f.shape[1], cf.shape[1],
                             capacity, window, expand)
         return jax.vmap(search)(
-            f, v1, v2, inv, ret, sm, cf, cv1, cv2, cinv, cps, nr, ini)
+            f, v1, v2, ro, inv, ret, sm, cf, cv1, cv2, cinv, cps, nr,
+            ini)
 
     return jax.jit(batched)
 
@@ -433,7 +500,8 @@ def _jit_batch(kernel_id: int, capacity: int, window: int,
 CRASH_MAX = 64
 
 
-def _split_packed(p: PackedHistory, breq: int, cr: int) -> Optional[dict]:
+def _split_packed(p: PackedHistory, breq: int, cr: int,
+                  kernel: Optional[KernelSpec] = None) -> Optional[dict]:
     """Split an (unpadded) PackedHistory into the padded required section
     [breq] and crashed section [cr] device arrays. Returns None when the
     history has more crashed ops than the crashed bitmask can hold."""
@@ -450,6 +518,13 @@ def _split_packed(p: PackedHistory, breq: int, cr: int) -> Optional[dict]:
     from jepsen_tpu.models.core import NIL_ID
     inf = int(RET_INF)
     inv_req = pad(p.inv[:nr], breq, inf)
+    # ro[j] = 1 iff required op j is read-only (see kernel.readonly) —
+    # feeds the device search's greedy pure-op closure. Padding rows 0.
+    ro = np.zeros(breq, dtype=np.int32)
+    if kernel is not None and kernel.readonly is not None:
+        for j in range(nr):
+            if kernel.readonly(int(p.f[j]), int(p.v1[j]), int(p.v2[j])):
+                ro[j] = 1
     # cps[j]: previous crashed op with identical (f, v1, v2), or -1 —
     # drives the canonical-order pruning (identical crashed ops are
     # interchangeable, so only the lowest available untaken one may be
@@ -465,6 +540,7 @@ def _split_packed(p: PackedHistory, breq: int, cr: int) -> Optional[dict]:
         "f": pad(p.f[:nr], breq, 0),
         "v1": pad(p.v1[:nr], breq, NIL_ID),
         "v2": pad(p.v2[:nr], breq, NIL_ID),
+        "ro": ro,
         "inv": inv_req,
         "ret": pad(p.ret[:nr], breq, inf),
         "sm": _suffix_min_inv(inv_req, breq),
@@ -481,8 +557,26 @@ def _split_packed(p: PackedHistory, breq: int, cr: int) -> Optional[dict]:
     }
 
 
-_COLS = ("f", "v1", "v2", "inv", "ret", "sm", "cf", "cv1", "cv2", "cinv",
-         "cps", "nr", "ini")
+_COLS = ("f", "v1", "v2", "ro", "inv", "ret", "sm", "cf", "cv1", "cv2",
+         "cinv", "cps", "nr", "ini")
+
+
+def _window_needed(p: PackedHistory) -> int:
+    """Smallest window W such that no candidate ever falls beyond the
+    frontier window: max over k of (largest j with inv[j] < ret[k]) - k + 1.
+    Computed host-side in O(n log n) via the non-decreasing suffix-min of
+    inv — lets the escalation ladder skip rungs that would only report
+    window overflow."""
+    nr = p.n_required
+    if nr == 0:
+        return 0
+    inv = p.inv[:nr]
+    sm = _suffix_min_inv(inv, nr)[:nr]     # non-decreasing
+    # per frontier k: the largest j with sufmin[j] < ret[k] is
+    # searchsorted(sm, ret[k]) - 1; j >= k always holds since
+    # sm[k] <= inv[k] < ret[k]. One vectorized pass for all k.
+    idx = np.searchsorted(sm, p.ret[:nr], side="left")
+    return max(1, int((idx - np.arange(nr)).max()))
 
 
 def _crash_width(n_cr: int) -> Optional[int]:
@@ -529,30 +623,43 @@ def _result(done: bool, lossy: bool, wovf: bool, best_k: int, levels: int,
 ESCALATION = ((1024, 32, 64), (4096, 64, 256), (16384, 128, 1024))
 
 
+def _select_rungs(wneed: int):
+    """Escalation rungs whose window can actually cover the history's
+    needed candidate window (host-computed). Rungs below it would only
+    burn a compile to report window overflow. When even MAX_WINDOW is too
+    narrow, run just the widest rung: a witness may still be found (done
+    is sound regardless of wovf), and refutation was impossible anyway."""
+    rungs = tuple(r for r in ESCALATION if r[1] >= wneed)
+    return rungs or (ESCALATION[-1],)
+
+
 def check_packed_tpu(p: PackedHistory, kernel: KernelSpec,
                      capacity: Optional[int] = None,
-                     window: Optional[int] = WINDOW) -> Dict[str, Any]:
+                     window: Optional[int] = WINDOW,
+                     expand: Optional[int] = None) -> Dict[str, Any]:
     """Check one packed single-key history on the default JAX backend.
 
-    capacity=None auto-escalates through ESCALATION, retrying on
-    capacity overflow (and on window overflow while the window can still
-    grow)."""
+    capacity=None auto-escalates through ESCALATION (skipping rungs whose
+    window is provably too narrow for this history), retrying on capacity
+    overflow (and on window overflow while the window can still grow).
+    With an explicit capacity, ``expand`` < capacity selects best-first
+    search (None = exhaustive level-synchronous BFS)."""
     if window is not None:
         _check_window(window)
     if p.n_required == 0:
         return {"valid": True, "levels": 0, "backend": "tpu"}
     cr = _crash_width(p.n - p.n_required)
     cols = (None if cr is None
-            else _split_packed(p, _bucket(p.n_required), cr))
+            else _split_packed(p, _bucket(p.n_required), cr, kernel))
     if cols is None:
         return {"valid": UNKNOWN, "backend": "tpu",
                 "error": f"{p.n - p.n_required} crashed ops exceed the "
                          f"crashed-set width {CRASH_MAX}"}
     if capacity is not None:
         _check_window(window or WINDOW)
-        ladder = ((capacity, window or WINDOW, None),)
+        ladder = ((capacity, window or WINDOW, expand),)
     else:
-        ladder = ESCALATION
+        ladder = _select_rungs(_window_needed(p))
     out: Dict[str, Any] = {}
     for cap, win, exp in ladder:
         fn = _jit_single(_kernel_key(kernel), cap, win, exp)
@@ -573,7 +680,7 @@ def warm_ladder(p: PackedHistory, kernel: KernelSpec,
     of how far it escalates."""
     cr = _crash_width(p.n - p.n_required)
     cols = (None if cr is None
-            else _split_packed(p, _bucket(p.n_required), cr))
+            else _split_packed(p, _bucket(p.n_required), cr, kernel))
     if cols is None:
         return
     # n_required=0 completes at level 0: the call compiles (and caches)
@@ -588,7 +695,8 @@ def warm_ladder(p: PackedHistory, kernel: KernelSpec,
 
 def check_history_tpu(history: History, model: Model,
                       capacity: Optional[int] = None,
-                      window: Optional[int] = WINDOW
+                      window: Optional[int] = WINDOW,
+                      expand: Optional[int] = None
                       ) -> Optional[Dict[str, Any]]:
     """Entry point used by LinearizableChecker(backend='tpu').
 
@@ -604,14 +712,15 @@ def check_history_tpu(history: History, model: Model,
     if pk is None:
         return None
     packed, kernel = pk
-    return check_packed_tpu(packed, kernel, capacity, window)
+    return check_packed_tpu(packed, kernel, capacity, window, expand)
 
 
 def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
                     capacity: Optional[int] = None,
                     window: Optional[int] = WINDOW,
                     mesh: Optional["jax.sharding.Mesh"] = None,
-                    axis: str = "keys") -> Dict[str, Any]:
+                    axis: str = "keys",
+                    expand: Optional[int] = None) -> Dict[str, Any]:
     """Check a {key: history} map batched on device — the independent-key
     data-parallel axis (reference independent.clj:65-219 lifts generators,
     independent.clj:246-296 fans the checker out per key; here the fan-out
@@ -649,52 +758,74 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
     crash_counts = [p.n - p.n_required for p in packed.values()]
     cr = _crash_width(min(max(crash_counts, default=0), CRASH_MAX))
 
-    rows = []      # (key, cols) for keys that go to the device
+    rows = []      # (key, cols, window_needed) for device-bound keys
     for key, p in packed.items():
         if p.n_required == 0:
             results[key] = {"valid": True, "levels": 0, "backend": "tpu"}
             continue
-        cols = None if cr is None else _split_packed(p, breq, cr)
+        cols = None if cr is None else _split_packed(p, breq, cr, kernel)
         if cols is None:
             results[key] = {
                 "valid": UNKNOWN, "backend": "tpu",
                 "error": f"{p.n - p.n_required} crashed ops exceed the "
                          f"crashed-set width {CRASH_MAX}"}
             continue
-        rows.append((key, cols))
+        rows.append((key, cols, _window_needed(p)))
 
     if capacity is not None:
         _check_window(window or WINDOW)
-        ladder = ((capacity, window or WINDOW, None),)
+        ladder = ((capacity, window or WINDOW, expand),)
     else:
         ladder = ESCALATION
 
     for step, (cap, win, exp) in enumerate(ladder):
         if not rows:
             break
-        arrays = [np.stack([cols[c] for _, cols in rows]) for c in _COLS]
+        last_rung = step == len(ladder) - 1
+        if capacity is None and not last_rung:
+            # Route keys whose needed window provably exceeds this rung's
+            # straight to the next rung — running them here would only
+            # report window overflow. (Narrow keys still finish on the
+            # cheap early rungs; one wide key must not drag the whole
+            # batch onto the widest pool.)
+            runnable = [r for r in rows if r[2] <= win]
+            deferred = [r for r in rows if r[2] > win]
+        else:
+            runnable, deferred = rows, []
+        if not runnable:
+            rows = deferred
+            continue
+        rows = runnable
+        arrays = [np.stack([cols[c] for _, cols, _ in rows])
+                  for c in _COLS]
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
             # Pad the key batch up to the mesh axis size so it divides.
             per = mesh.shape[axis]
             pad = (-len(rows)) % per
             if pad:
-                arrays = [np.concatenate(
-                    [a, np.repeat(a[-1:], pad, axis=0)]) for a in arrays]
+                # Pad with trivially-complete rows (n_required=0 finishes
+                # at level 0) — repeating a real key would re-run its
+                # search, possibly the batch's most expensive, pad times.
+                def _pad_col(a, c):
+                    fill = np.repeat(a[-1:], pad, axis=0)
+                    if c == "nr":
+                        fill = np.zeros_like(fill)
+                    return np.concatenate([a, fill])
+                arrays = [_pad_col(a, c) for a, c in zip(arrays, _COLS)]
             sh_row = NamedSharding(mesh, P(axis))
             arrays = [jax.device_put(a, sh_row) for a in arrays]
         fn = _jit_batch(_kernel_key(kernel), cap, win, exp)
         done, lossy, wovf, best, levels = (np.asarray(x)
                                            for x in fn(*arrays))
-        retry = []
-        last_rung = step == len(ladder) - 1
-        for r, (key, cols) in enumerate(rows):
+        retry = deferred
+        for r, (key, cols, wneed) in enumerate(rows):
             res = _result(bool(done[r]), bool(lossy[r]), bool(wovf[r]),
                           int(best[r]), int(levels[r]), packed[key])
             escalatable = (bool(lossy[r])
                            or (bool(wovf[r]) and win < MAX_WINDOW))
             if res["valid"] is UNKNOWN and escalatable and not last_rung:
-                retry.append((key, cols))
+                retry.append((key, cols, wneed))
             else:
                 results[key] = res
         rows = retry

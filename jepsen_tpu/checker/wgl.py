@@ -56,6 +56,10 @@ def check_packed(p: PackedHistory,
     f, v1, v2, inv, ret = (p.f.tolist(), p.v1.tolist(), p.v2.tolist(),
                            p.inv.tolist(), p.ret.tolist())
     step = kernel.step
+    # Only required ops participate in the readonly closure (crashed ops
+    # are governed by the separate no-effect rule below).
+    ro = ([bool(kernel.readonly(f[j], v1[j], v2[j])) for j in range(n_req)]
+          if kernel.readonly is not None else None)
 
     # Precompute candidate offset lists per frontier k: all j >= k with
     # inv[j] < ret[k] (ops concurrent with the frontier op), lazily.
@@ -83,22 +87,55 @@ def check_packed(p: PackedHistory,
                     "error": f"config budget {max_configs} exhausted",
                     "configs-explored": explored,
                     "max-linearized-prefix": best_k}
+        # Partial-order reduction (mirrors the device search): a succeeding
+        # READ-ONLY candidate — kernel.readonly: its step can never change
+        # the state at ANY state where it succeeds (register read,
+        # cas(x,x), set read) — can be linearized greedily: moving it
+        # earlier in a witness never invalidates the steps it jumps over,
+        # because it changes nothing anywhere. A config with such pure
+        # required candidates emits ONE closure successor taking them all.
+        # A *crashed* op whose step leaves the current state unchanged is
+        # never taken now (optional + no effect == the untaken config
+        # dominates). Collapses the 2^reads subset explosion; sound for
+        # refutation as well (every witness normalizes to greedy-pure
+        # form). NOTE readonly, not "state unchanged here": an op that is
+        # incidentally pure at this state (a rewrite of the current value)
+        # may be needed later as a state-restoring step.
+        pure_mask = 0
+        impure = []
         for j in candidates(k):
             if (mask >> (j - k)) & 1:
                 continue  # already linearized
             s2, ok = step(state, f[j], v1[j], v2[j])
             if not ok:
                 continue
-            if j == k:
-                # advance frontier past consecutively-linearized ops
-                m = mask >> 1
-                k2 = k + 1
-                while m & 1:
-                    m >>= 1
-                    k2 += 1
-                cfg = (k2, m, int(s2))
-            else:
-                cfg = (k, mask | (1 << (j - k)), int(s2))
+            if j >= n_req and int(s2) == state:
+                continue  # no-effect crashed op: never take now
+            if j < n_req and ro is not None and ro[j]:
+                pure_mask |= 1 << (j - k)
+                continue
+            impure.append((j, int(s2)))
+        if pure_mask:
+            m = mask | pure_mask
+            k2 = k
+            while m & 1:
+                m >>= 1
+                k2 += 1
+            succs = [(k2, m, state)]
+        else:
+            succs = []
+            for j, s2 in impure:
+                if j == k:
+                    # advance frontier past consecutively-linearized ops
+                    m = mask >> 1
+                    k2 = k + 1
+                    while m & 1:
+                        m >>= 1
+                        k2 += 1
+                    succs.append((k2, m, s2))
+                else:
+                    succs.append((k, mask | (1 << (j - k)), s2))
+        for cfg in succs:
             if cfg[0] > best_k:
                 best_k = cfg[0]
             if cfg[0] >= n_req:
@@ -184,21 +221,42 @@ def check_model(history: History, model: Model,
             return {"valid": UNKNOWN,
                     "error": f"config budget {max_configs} exhausted",
                     "configs-explored": explored}
+        # pure-op closure — see check_packed for the reduction argument;
+        # here "read-only" is the model's own readonly_op classification
+        pure_mask = 0
+        impure = []
         for j in candidates(k):
             if (mask >> (j - k)) & 1:
                 continue
             m2 = m.step(ops[j])
             if is_inconsistent(m2):
                 continue
-            if j == k:
-                mm = mask >> 1
-                k2 = k + 1
-                while mm & 1:
-                    mm >>= 1
-                    k2 += 1
-                cfg = (k2, mm, m2)
-            else:
-                cfg = (k, mask | (1 << (j - k)), m2)
+            if j >= n_req and m2 == m:
+                continue  # no-effect crashed op: never take now
+            if j < n_req and m.readonly_op(ops[j]):
+                pure_mask |= 1 << (j - k)
+                continue
+            impure.append((j, m2))
+        if pure_mask:
+            mm = mask | pure_mask
+            k2 = k
+            while mm & 1:
+                mm >>= 1
+                k2 += 1
+            succs = [(k2, mm, m)]
+        else:
+            succs = []
+            for j, m2 in impure:
+                if j == k:
+                    mm = mask >> 1
+                    k2 = k + 1
+                    while mm & 1:
+                        mm >>= 1
+                        k2 += 1
+                    succs.append((k2, mm, m2))
+                else:
+                    succs.append((k, mask | (1 << (j - k)), m2))
+        for cfg in succs:
             best_k = max(best_k, cfg[0])
             if cfg[0] >= n_req:
                 return {"valid": True, "configs-explored": explored}

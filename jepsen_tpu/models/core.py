@@ -34,6 +34,13 @@ class Model:
     def step(self, op: Op) -> "Model":
         raise NotImplementedError
 
+    def readonly_op(self, op: Op) -> bool:
+        """True iff stepping ``op`` can never change the state, at ANY state
+        where it succeeds (a register read, a cas(x,x), a set read). Such
+        ops can be linearized greedily by the checkers (partial-order
+        reduction); defaults to False (no reduction)."""
+        return False
+
     def __eq__(self, other):
         return type(self) is type(other) and self.__dict__ == other.__dict__
 
@@ -76,6 +83,9 @@ class NoOp(Model):
     def step(self, op: Op) -> Model:
         return self
 
+    def readonly_op(self, op: Op) -> bool:
+        return True
+
     def __repr__(self):
         return "NoOp"
 
@@ -109,6 +119,14 @@ class CASRegister(Model):
                 return self
             return inconsistent(f"can't read {v} from register {self.value}")
         return inconsistent(f"unknown op f={f}")
+
+    def readonly_op(self, op: Op) -> bool:
+        if op.f == "read":
+            return True
+        if op.f == "cas" and op.value is not None:
+            old, new = op.value
+            return old == new
+        return False
 
     def __eq__(self, other):
         return isinstance(other, CASRegister) and self.value == other.value
@@ -170,6 +188,9 @@ class SetModel(Model):
             return inconsistent(
                 f"can't read {op.value} from set {sorted(self.items)}")
         return inconsistent(f"unknown op f={op.f}")
+
+    def readonly_op(self, op: Op) -> bool:
+        return op.f == "read"
 
     def __eq__(self, other):
         return isinstance(other, SetModel) and self.items == other.items
@@ -325,6 +346,11 @@ class KernelSpec:
     #: ValueError when the packed history violates a kernel capacity
     #: invariant (e.g. queue per-value counts exceeding the nibble width).
     validate: Optional[Callable] = None
+    #: Host predicate (f_code, v1, v2) -> bool: True iff the op's step can
+    #: NEVER change the state at any state where it succeeds (register
+    #: read, cas(x,x), set read). Drives the checkers' greedy pure-op
+    #: closure (partial-order reduction); None disables the reduction.
+    readonly: Optional[Callable] = None
 
 
 def _cas_register_step(state, f, v1, v2):
@@ -483,6 +509,8 @@ CAS_REGISTER_KERNEL = KernelSpec(
     f_codes={"read": F_READ, "write": F_WRITE, "cas": F_CAS},
     pack_init=lambda m, intern: (NIL_ID if m.value is None
                                  else intern(m.value)),
+    readonly=lambda f, v1, v2: (f == F_READ
+                                or (f == F_CAS and v1 == v2)),
 )
 
 MUTEX_KERNEL = KernelSpec(
@@ -498,6 +526,7 @@ NOOP_KERNEL = KernelSpec(
     init_state=0,
     step=_noop_step,
     f_codes={},
+    readonly=lambda f, v1, v2: True,
 )
 
 SET_KERNEL = KernelSpec(
@@ -507,6 +536,7 @@ SET_KERNEL = KernelSpec(
     f_codes={"add": F_ADD, "read": F_READ},
     pack_init=_set_pack_init,
     encode_op=_set_encode,
+    readonly=lambda f, v1, v2: f == F_READ,
 )
 
 UNORDERED_QUEUE_KERNEL = KernelSpec(

@@ -87,10 +87,21 @@ class TestInitStates:
         assert check_history_tpu(h, Mutex(True))["valid"] is False
         assert check_history_tpu(h, Mutex(False))["valid"] is True
 
-    def test_window_over_32_rejected(self):
+    def test_window_over_max_rejected(self):
         h = H((0, "invoke", "write", 0), (0, "ok", "write", 0))
         with pytest.raises(ValueError):
-            check_history_tpu(h, CASRegister(), window=64)
+            check_history_tpu(h, CASRegister(), window=256)
+
+    def test_window_64_accepted(self):
+        # the multi-word mask lifted the cap: 64 and 128 are legal widths.
+        # capacity must be explicit — with capacity=None the ladder picks
+        # its own windows and the parameter is only validated, not used.
+        h = H((0, "invoke", "write", 0), (0, "ok", "write", 0),
+              (1, "invoke", "read", None), (1, "ok", "read", 0))
+        assert check_history_tpu(h, CASRegister(), capacity=64,
+                                 window=64)["valid"] is True
+        assert check_history_tpu(h, CASRegister(), capacity=64,
+                                 window=128)["valid"] is True
 
 
 class TestAgainstCPUOracle:
@@ -388,6 +399,182 @@ class TestUnorderedQueueKernel:
         assert check_history_tpu(h, UnorderedQueue()) is None
         assert linearizable(UnorderedQueue(), backend="tpu").check(
             {}, h)["valid"] is True
+
+
+def wide_history(n_procs=100, rounds=2, write_frac=0.12, seed=0,
+                 corrupt=False):
+    """Rounds of n_procs fully-overlapping ops against one register:
+    every op of a round is invoked before any completes, so candidate
+    offsets reach ~n_procs-1 and the device search NEEDS a multi-word
+    window (the aerospike 100-thread shape, reference
+    aerospike/src/aerospike/core.clj:566-575). Read-heavy with unique
+    write values keeps the witness value-chain-constrained — wide but
+    tractable, like real high-concurrency workloads. Linearizable by
+    construction unless ``corrupt``."""
+    rng = random.Random(seed)
+    h = History()
+    value = None
+    t = 0
+    nextv = 0
+    for _ in range(rounds):
+        ops = []
+        for p in range(n_procs):
+            if rng.random() < write_frac:
+                f, v = "write", nextv
+                nextv += 1
+            else:
+                f, v = "read", None
+            h.append(Op(type="invoke", f=f, value=v, process=p, time=t))
+            t += 1
+            ops.append((p, f, v))
+        rng.shuffle(ops)                   # commit order
+        comps = []
+        for p, f, v in ops:
+            if f == "write":
+                value = v
+                comps.append((p, "ok", f, v))
+            else:
+                comps.append((p, "ok", f, value))
+        rng.shuffle(comps)                 # return order, independent
+        for p, typ, f, v in comps:
+            h.append(Op(type=typ, f=f, value=v, process=p, time=t))
+            t += 1
+    if corrupt:
+        rows = list(h)
+        for i in range(len(rows) - 1, -1, -1):
+            o = rows[i]
+            if o.type == "ok" and o.f == "read":
+                rows[i] = o.replace(value=10**6)   # never-written value
+                break
+        h = History.of(rows)
+    return h
+
+
+class TestWideShapes:
+    """Positive coverage for the lifted window/crash caps (VERDICT r2 weak
+    #2): multi-word masks (MW>1), multi-word crashed sets (MC>1), and the
+    ~100-thread aerospike concurrency shape, each vs the CPU oracle."""
+
+    def test_100_concurrency_needs_window_128(self):
+        from jepsen_tpu.checker.tpu import _window_needed
+        h = wide_history(100, 2, seed=5)
+        p = pack_history(h, CAS_REGISTER_KERNEL)
+        assert _window_needed(p) > 64          # only window=128 (MW=4) fits
+        assert check_packed(p, CAS_REGISTER_KERNEL)["valid"] is True
+        r = check_packed_tpu(p, CAS_REGISTER_KERNEL, capacity=4096,
+                             window=128, expand=256)
+        assert r["valid"] is True              # device decides, positively
+
+    def test_100_concurrency_corrupted_never_verifies(self):
+        h = wide_history(100, 2, seed=5, corrupt=True)
+        p = pack_history(h, CAS_REGISTER_KERNEL)
+        assert check_packed(p, CAS_REGISTER_KERNEL)["valid"] is False
+        r = check_packed_tpu(p, CAS_REGISTER_KERNEL, capacity=4096,
+                             window=128, expand=256)
+        assert r["valid"] is not True
+
+    def test_48_concurrency_window_64(self):
+        from jepsen_tpu.checker.tpu import _window_needed
+        h = wide_history(48, 2, seed=3)
+        p = pack_history(h, CAS_REGISTER_KERNEL)
+        need = _window_needed(p)
+        assert 32 < need <= 64                 # exercises MW=2
+        assert check_packed(p, CAS_REGISTER_KERNEL)["valid"] is True
+        r = check_packed_tpu(p, CAS_REGISTER_KERNEL, capacity=2048,
+                             window=64, expand=128)
+        assert r["valid"] is True
+
+    def test_over_32_crashed_ops(self):
+        # > 32 crashed ops needs the two-word crashed mask (MC=2)
+        from jepsen_tpu.testing import simulate_register_history
+        h = simulate_register_history(260, n_procs=6, n_vals=8, seed=3,
+                                      crash_p=0.3)
+        p = pack_history(h, CAS_REGISTER_KERNEL)
+        assert p.n - p.n_required > 32
+        assert check_packed(p, CAS_REGISTER_KERNEL)["valid"] is True
+        r = check_packed_tpu(p, CAS_REGISTER_KERNEL)
+        assert r["valid"] is True
+
+    def test_rung_selection_skips_narrow_windows(self):
+        from jepsen_tpu.checker.tpu import (
+            ESCALATION, _select_rungs, _window_needed)
+        h = wide_history(100, 2, seed=5)
+        p = pack_history(h, CAS_REGISTER_KERNEL)
+        rungs = _select_rungs(_window_needed(p))
+        assert all(w >= _window_needed(p) for _, w, _ in rungs)
+        # narrow histories keep the cheap first rung
+        assert _select_rungs(5) == ESCALATION
+        # impossibly wide: still runs the widest rung (witness may exist)
+        assert _select_rungs(4000) == (ESCALATION[-1],)
+
+
+class TestMaskHelpers:
+    """The multi-word mask primitives vs arbitrary-precision Python ints."""
+
+    def _to_words(self, x, mw):
+        return [(x >> (32 * w)) & 0xFFFFFFFF for w in range(mw)]
+
+    def test_shr1_shrby_trailing_ones(self):
+        import jax.numpy as jnp
+        from jepsen_tpu.checker.tpu import (
+            _shr1_multi, _shr_by_mw, _trailing_ones_mw)
+        rng = random.Random(2)
+        for mw in (1, 2, 4):
+            ints = [rng.getrandbits(32 * mw) for _ in range(64)]
+            m = jnp.asarray(
+                np.array([self._to_words(x, mw) for x in ints],
+                         dtype=np.uint32))
+            got1 = np.asarray(_shr1_multi(m, mw))
+            want1 = np.array([self._to_words(x >> 1, mw) for x in ints],
+                             dtype=np.uint32)
+            assert (got1 == want1).all()
+
+            def t_ones(x):
+                t = 0
+                while x & 1:
+                    x >>= 1
+                    t += 1
+                return t
+            gott = np.asarray(_trailing_ones_mw(m, mw))
+            wantt = np.array([min(t_ones(x), 32 * mw) for x in ints])
+            assert (gott == wantt).all()
+
+            ts = np.array([rng.randrange(0, 32 * mw + 1) for _ in ints],
+                          dtype=np.int32)
+            gots = np.asarray(_shr_by_mw(m, jnp.asarray(ts), mw))
+            wants = np.array(
+                [self._to_words(x >> int(t), mw)
+                 for x, t in zip(ints, ts)], dtype=np.uint32)
+            assert (gots == wants).all()
+
+
+class TestReadonlyClosureRegression:
+    """The pure-op closure must absorb only READ-ONLY ops. A write of the
+    current value is NOT movable: this history needs it later as a
+    state-restoring step (the minimal counterexample that broke an
+    earlier state-unchanged-here closure rule)."""
+
+    def test_rewrite_as_restoring_step(self):
+        h = H((0, "invoke", "write", 0),
+              (1, "invoke", "cas", (0, 1)),
+              (2, "invoke", "write", 0),
+              (2, "ok", "write", 0),
+              (1, "ok", "cas", (0, 1)),
+              (0, "ok", "write", 0),
+              (2, "invoke", "read", None),
+              (0, "invoke", "write", 1),
+              (0, "ok", "write", 1),
+              (2, "ok", "read", 0))
+        p = pack_history(h, CAS_REGISTER_KERNEL)
+        assert check_packed(p, CAS_REGISTER_KERNEL)["valid"] is True
+        assert check_packed_tpu(p, CAS_REGISTER_KERNEL,
+                                capacity=512)["valid"] is True
+
+    def test_cas_same_value_is_readonly(self):
+        from jepsen_tpu.models.core import F_CAS, F_READ, F_WRITE
+        ro = CAS_REGISTER_KERNEL.readonly
+        assert ro(F_READ, 3, -1) and ro(F_CAS, 2, 2)
+        assert not ro(F_WRITE, 2, -1) and not ro(F_CAS, 2, 3)
 
 
 class TestScale:
