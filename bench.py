@@ -75,8 +75,7 @@ def child_main(platform: str) -> int:
     except Exception:  # noqa: BLE001 (older jax)
         pass
 
-    from jepsen_tpu.checker.tpu import (
-        check_history_tpu, pack_with_init, warm_ladder)
+    from jepsen_tpu.checker.tpu import check_history_tpu
     from jepsen_tpu.models import CASRegister
     from jepsen_tpu.testing import simulate_register_history
 
@@ -91,29 +90,32 @@ def child_main(platform: str) -> int:
     print(f"# synthesized {len(history)} events in {time.time()-t0:.1f}s",
           file=sys.stderr)
 
-    # Warm-up: same op count => same padded bucket => shared compilation.
-    # Compile every escalation rung the timed check could touch.
-    t0 = time.time()
-    warm = simulate_register_history(N_OPS, n_procs=N_PROCS, n_vals=16,
-                                     seed=7, crash_p=0.002)
-    packed, kernel = pack_with_init(warm, CASRegister())
-    warm_ladder(packed, kernel, rungs=3)
-    r = check_history_tpu(warm, CASRegister())
-    print(f"# warm-up (incl. compiles): {time.time()-t0:.1f}s -> "
-          f"{r['valid']}", file=sys.stderr)
-
+    # COLD: time-to-first-verdict, compiles included. Host-side rung
+    # selection means exactly one rung compiles for this (low-
+    # concurrency) shape; with a populated persistent cache even that
+    # compile is skipped — the orchestrator runs a second cold child to
+    # record the cached-cold number.
     t0 = time.time()
     result = check_history_tpu(history, CASRegister())
-    dt = time.time() - t0
-    print(f"# check: valid={result['valid']} levels={result.get('levels')} "
-          f"in {dt:.2f}s", file=sys.stderr)
-    try:
-        _secondary_metrics()
-    except Exception as e:  # noqa: BLE001 — secondary must not eat the line
-        print(f"# secondary metrics failed: {e!r}", file=sys.stderr)
-    if result["valid"] is not True:
-        # A wrong or unknown verdict on a valid-by-construction history is a
-        # bench failure, not a number.
+    cold = time.time() - t0
+    print(f"# cold check (incl. compile): valid={result['valid']} "
+          f"levels={result.get('levels')} in {cold:.2f}s", file=sys.stderr)
+
+    # WARM: steady-state search time, compilation cached in-process.
+    t0 = time.time()
+    result2 = check_history_tpu(history, CASRegister())
+    warm = time.time() - t0
+    print(f"# warm check: valid={result2['valid']} in {warm:.2f}s",
+          file=sys.stderr)
+
+    if not os.environ.get("JEPSEN_BENCH_SKIP_SECONDARY"):
+        try:
+            _secondary_metrics()
+        except Exception as e:  # noqa: BLE001 — must not eat the line
+            print(f"# secondary metrics failed: {e!r}", file=sys.stderr)
+    if result["valid"] is not True or result2["valid"] is not True:
+        # A wrong or unknown verdict on a valid-by-construction history is
+        # a bench failure, not a number.
         print(json.dumps({"metric": METRIC, "value": None, "unit": "s",
                           "vs_baseline": 0, "platform": dev.platform,
                           "error": f"verdict {result['valid']!r}"}))
@@ -121,10 +123,12 @@ def child_main(platform: str) -> int:
 
     print(json.dumps({
         "metric": METRIC,
-        "value": round(dt, 3),
+        "value": round(warm, 3),
         "unit": "s",
-        "vs_baseline": round(TARGET_S / dt, 2),
+        "vs_baseline": round(TARGET_S / warm, 2),
         "platform": dev.platform,
+        "cold_s": round(cold, 3),
+        "cold_vs_baseline": round(TARGET_S / cold, 2),
     }))
     return 0
 
@@ -163,10 +167,12 @@ def _secondary_metrics():
 # ---------------------------------------------------------------------------
 
 
-def _run_child(platform: str, timeout: float):
+def _run_child(platform: str, timeout: float, skip_secondary: bool = False):
     """Run one measurement child. Returns (record | None, note)."""
     env = dict(os.environ)
     env["JEPSEN_BENCH_CHILD"] = platform
+    if skip_secondary:
+        env["JEPSEN_BENCH_SKIP_SECONDARY"] = "1"
     if platform == "cpu":
         env["JAX_PLATFORMS"] = "cpu"
     print(f"# bench: trying platform={platform} (timeout {timeout:.0f}s)",
@@ -213,8 +219,20 @@ def main() -> int:
         rec, note = _run_child("tpu", min(480.0, remaining - 90))
         notes.append(note)
         if rec is not None and rec.get("value") is not None:
+            extras = {k: rec[k] for k in ("cold_s", "cold_vs_baseline")
+                      if k in rec}
+            # Second cold child: same measurement in a FRESH process —
+            # its cold_s shows whether the persistent compilation cache
+            # actually eliminates the compile across processes.
+            remaining = deadline - time.time()
+            if remaining > 180:
+                rec2, note2 = _run_child(
+                    "tpu", min(300.0, remaining - 60), skip_secondary=True)
+                notes.append(note2)
+                if rec2 is not None and rec2.get("cold_s") is not None:
+                    extras["cached_cold_s"] = rec2["cold_s"]
             emit(rec["value"], rec["vs_baseline"],
-                 platform=rec.get("platform", "tpu"))
+                 platform=rec.get("platform", "tpu"), **extras)
             return 0
         if attempt == 0:
             time.sleep(5)
@@ -226,8 +244,10 @@ def main() -> int:
         rec, note = _run_child("cpu", remaining - 30)
         notes.append(note)
         if rec is not None and rec.get("value") is not None:
+            extras = {k: rec[k] for k in ("cold_s", "cold_vs_baseline")
+                      if k in rec}
             emit(rec["value"], rec["vs_baseline"], platform="cpu",
-                 note="tpu unavailable; cpu-backend fallback")
+                 note="tpu unavailable; cpu-backend fallback", **extras)
             return 0
 
     emit(None, 0, error="; ".join(notes))
