@@ -1,0 +1,185 @@
+"""Counterexample rendering for failed linearizability analyses.
+
+Reference: on ``valid? false`` jepsen renders ``linear.svg`` via
+``knossos.linear.report/render-analysis!`` (jepsen/src/jepsen/
+checker.clj:96-103) — a partial-order diagram of the failing window. This
+module draws the equivalent, dependency-free (same hand-rolled SVG
+approach as :mod:`jepsen_tpu.checker.perf`):
+
+- one row per process, time (event index) on the x axis;
+- the tail of the *maximal linearized prefix* (green), the frontier op the
+  search could not get past (red), its concurrent candidate ops (orange),
+  and available crashed ops (grey, dashed);
+- the reachable frontier *states* (every model state any maximal search
+  path ended in), and for each blocked op the states it fails from —
+  the "why" of the failure, phrased with the kernel's describe_state.
+
+The artifact is written into the test's store dir by
+:class:`jepsen_tpu.checker.wgl.LinearizableChecker`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from jepsen_tpu.models.core import (
+    F_CAS, F_READ, KernelSpec, NIL_ID)
+from jepsen_tpu.ops.encode import PackedHistory, RET_INF
+
+_GREEN, _RED, _ORANGE, _GREY = "#2ca02c", "#d62728", "#ff7f0e", "#888888"
+_PREFIX_TAIL = 6      # linearized-prefix ops shown for context
+_MAX_CANDIDATES = 18  # concurrent ops shown
+
+
+def _op_label(p: PackedHistory, j: int) -> str:
+    inv_op, _ = p.ops[j] if j < len(p.ops) else (None, None)
+    if inv_op is None:
+        return f"op {j}"
+    v = inv_op.value
+    if inv_op.f == "read":
+        # reads are checked against their completion value
+        comp = p.ops[j][1]
+        if comp is not None and comp.value is not None:
+            v = comp.value
+    return f"{inv_op.f} {v if v is not None else ''}".strip()
+
+
+def _describe(kernel: KernelSpec, state: int, values: List[Any]) -> str:
+    if kernel.describe_state is not None:
+        return kernel.describe_state(int(state), values)
+    return str(int(state))
+
+
+def _failure_notes(p: PackedHistory, kernel: KernelSpec, j: int,
+                   states: List[int]) -> Tuple[bool, str]:
+    """(any_state_accepts, note): step op j from every frontier state."""
+    ok_from, fail_from = [], []
+    for s in states:
+        _, ok = kernel.step(int(s), int(p.f[j]), int(p.v1[j]),
+                            int(p.v2[j]))
+        (ok_from if ok else fail_from).append(s)
+    vals = p.value_table
+    if not fail_from:
+        return True, "applies from every frontier state"
+    if not ok_from:
+        return False, ("blocked from every frontier state: " + ", ".join(
+            _describe(kernel, s, vals) for s in fail_from[:4]))
+    return True, ("blocked from " + ", ".join(
+        _describe(kernel, s, vals) for s in fail_from[:4]))
+
+
+def analysis(p: PackedHistory, kernel: KernelSpec,
+             result: Dict[str, Any]) -> Dict[str, Any]:
+    """Structured failure analysis: prefix tail, frontier op, concurrent
+    candidates with per-state step outcomes. Pure data — the SVG renderer
+    and tests both consume it."""
+    best_k = int(result.get("max-linearized-prefix", 0))
+    states = result.get("final-states")
+    if states is None:
+        # e.g. the device backend decided: harvest frontier states with a
+        # bounded CPU re-run (failures are typically local, so this is
+        # cheap relative to the refutation itself)
+        from jepsen_tpu.checker.wgl import check_packed
+        res2 = check_packed(p, kernel, max_configs=200_000)
+        states = res2.get("final-states", [int(p.init_state)])
+    states = [int(s) for s in states]
+
+    nr = p.n_required
+    rows: List[Dict[str, Any]] = []
+    for j in range(max(0, best_k - _PREFIX_TAIL), best_k):
+        rows.append({"j": j, "role": "linearized",
+                     "label": _op_label(p, j), "note": ""})
+    cand: List[int] = []
+    if best_k < nr:
+        rk = int(p.ret[best_k])
+        cand = [j for j in range(best_k, p.n)
+                if int(p.inv[j]) < rk][:_MAX_CANDIDATES]
+    for j in cand:
+        role = ("frontier" if j == best_k
+                else "crashed" if j >= nr else "candidate")
+        _, note = _failure_notes(p, kernel, j, states)
+        rows.append({"j": j, "role": role, "label": _op_label(p, j),
+                     "note": note})
+    return {
+        "max-linearized-prefix": best_k,
+        "n-required": nr,
+        "frontier-states": [_describe(kernel, s, p.value_table)
+                            for s in states],
+        "ops": rows,
+    }
+
+
+def render_linear_svg(p: PackedHistory, kernel: KernelSpec,
+                      result: Dict[str, Any], path: str) -> Dict[str, Any]:
+    """Write the linear.svg counterexample diagram; returns the analysis."""
+    a = analysis(p, kernel, result)
+    rows = a["ops"]
+    if not rows:
+        rows = []
+    # x axis: event indices of the shown ops
+    evs: List[int] = []
+    for r in rows:
+        j = r["j"]
+        evs.append(int(p.inv[j]))
+        if int(p.ret[j]) != int(RET_INF):
+            evs.append(int(p.ret[j]))
+    x0 = min(evs, default=0)
+    x1 = max(evs, default=1)
+    if x1 <= x0:
+        x1 = x0 + 1
+    procs = sorted({int(p.process[r["j"]]) for r in rows})
+    prow = {pr: i for i, pr in enumerate(procs)}
+
+    left, top, rowh = 70, 110, 34
+    w = 980
+    h = top + rowh * max(1, len(procs)) + 40
+
+    def sx(ev: int) -> float:
+        return left + (ev - x0) / (x1 - x0) * (w - left - 260)
+
+    color = {"linearized": _GREEN, "frontier": _RED,
+             "candidate": _ORANGE, "crashed": _GREY}
+    parts = [
+        f'<svg xmlns="http://www.w3.org/2000/svg" width="{w}" '
+        f'height="{h}" font-family="monospace">',
+        f'<rect width="{w}" height="{h}" fill="white"/>',
+        f'<text x="12" y="22" font-size="15">non-linearizable: '
+        f'{a["max-linearized-prefix"]}/{a["n-required"]} ops linearized; '
+        f'frontier cannot advance</text>',
+        f'<text x="12" y="44" font-size="12">reachable frontier states: '
+        f'{", ".join(a["frontier-states"][:8])}</text>',
+        f'<text x="12" y="66" font-size="11" fill="{_GREEN}">'
+        f'linearized prefix</text>',
+        f'<text x="150" y="66" font-size="11" fill="{_RED}">frontier op'
+        f'</text>',
+        f'<text x="250" y="66" font-size="11" fill="{_ORANGE}">concurrent '
+        f'candidate</text>',
+        f'<text x="420" y="66" font-size="11" fill="{_GREY}">crashed '
+        f'(optional)</text>',
+    ]
+    for pr, i in prow.items():
+        y = top + i * rowh
+        parts.append(f'<text x="8" y="{y + 14}" font-size="11">p{pr}'
+                     f'</text>')
+        parts.append(f'<line x1="{left}" y1="{y + 10}" x2="{w - 250}" '
+                     f'y2="{y + 10}" stroke="#eeeeee"/>')
+    for r in rows:
+        j = r["j"]
+        y = top + prow[int(p.process[j])] * rowh
+        xi = sx(int(p.inv[j]))
+        crashed = int(p.ret[j]) == int(RET_INF)
+        xr = (w - 255) if crashed else sx(int(p.ret[j]))
+        c = color[r["role"]]
+        dash = ' stroke-dasharray="4,3"' if crashed else ""
+        parts.append(
+            f'<rect x="{xi:.1f}" y="{y + 4}" width="{max(xr - xi, 3):.1f}"'
+            f' height="12" fill="{c}" fill-opacity="0.35" stroke="{c}"'
+            f'{dash}/>')
+        label = r["label"] + ("  ✗ " + r["note"]
+                              if r["note"].startswith("blocked") else "")
+        parts.append(f'<text x="{xi + 2:.1f}" y="{y + 14}" font-size="10">'
+                     f'{label}</text>')
+    parts.append("</svg>")
+    with open(path, "w") as fh:
+        fh.write("\n".join(parts))
+    return a

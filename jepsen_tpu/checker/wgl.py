@@ -78,6 +78,9 @@ def check_packed(p: PackedHistory,
     seen = {init}
     explored = 0
     best_k = 0
+    # Frontier evidence for counterexample rendering: the model states of
+    # explored configs at the deepest prefix reached (bounded sample).
+    best_states: set = {int(p.init_state)}
 
     while stack:
         k, mask, state = stack.pop()
@@ -138,6 +141,9 @@ def check_packed(p: PackedHistory,
         for cfg in succs:
             if cfg[0] > best_k:
                 best_k = cfg[0]
+                best_states = {cfg[2]}
+            elif cfg[0] == best_k and len(best_states) < 16:
+                best_states.add(cfg[2])
             if cfg[0] >= n_req:
                 return {"valid": True, "configs-explored": explored}
             if cfg not in seen:
@@ -149,6 +155,7 @@ def check_packed(p: PackedHistory,
         "configs-explored": explored,
         "max-linearized-prefix": best_k,
         "frontier-op": _describe_op(p, best_k) if best_k < n else None,
+        "final-states": sorted(best_states),
     }
 
 
@@ -214,6 +221,7 @@ def check_model(history: History, model: Model,
     seen = {init}
     explored = 0
     best_k = 0
+    best_models: List[Model] = [model]
     while stack:
         k, mask, m = stack.pop()
         explored += 1
@@ -257,7 +265,12 @@ def check_model(history: History, model: Model,
                 else:
                     succs.append((k, mask | (1 << (j - k)), m2))
         for cfg in succs:
-            best_k = max(best_k, cfg[0])
+            if cfg[0] > best_k:
+                best_k = cfg[0]
+                best_models = [cfg[2]]
+            elif cfg[0] == best_k and len(best_models) < 16 \
+                    and cfg[2] not in best_models:
+                best_models.append(cfg[2])
             if cfg[0] >= n_req:
                 return {"valid": True, "configs-explored": explored}
             if cfg not in seen:
@@ -268,6 +281,7 @@ def check_model(history: History, model: Model,
         "configs-explored": explored,
         "max-linearized-prefix": best_k,
         "frontier-op": ops[best_k].to_dict() if best_k < n else None,
+        "final-models": [repr(m) for m in best_models],
     }
 
 
@@ -291,6 +305,12 @@ class LinearizableChecker(Checker):
         model = self.model or test.get("model")
         if model is None:
             raise ValueError("linearizable checker needs a model")
+        out = self._check(history, model)
+        if out.get("valid") is False:
+            self._render(test, history, model, out)
+        return out
+
+    def _check(self, history: History, model: Model):
         if self.backend == "tpu":
             res = None
             try:
@@ -311,6 +331,31 @@ class LinearizableChecker(Checker):
             return check_model(history, model, self.max_configs)
         packed, kernel = pk
         return check_packed(packed, kernel, self.max_configs)
+
+    def _render(self, test, history: History, model: Model, out: dict):
+        """On valid:false, write the linear.svg counterexample diagram
+        into the store (reference checker.clj:96-103 renders via
+        knossos.linear.report/render-analysis!). Best-effort: rendering
+        failures must never mask the verdict."""
+        import os
+        d = test.get("store-dir") if isinstance(test, dict) else None
+        if not d:
+            return
+        try:
+            from jepsen_tpu.checker.counterexample import render_linear_svg
+            from jepsen_tpu.ops.encode import pack_with_init
+            try:
+                pk = pack_with_init(history, model)
+            except ValueError:
+                pk = None
+            if pk is None:
+                return  # object-model path: no packed encoding to draw
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(d, "linear.svg")
+            render_linear_svg(pk[0], pk[1], out, path)
+            out["counterexample"] = "linear.svg"
+        except Exception as e:  # noqa: BLE001
+            out["counterexample-error"] = repr(e)
 
 
 def linearizable(model: Optional[Model] = None, backend: str = "cpu",

@@ -351,6 +351,9 @@ class KernelSpec:
     #: read, cas(x,x), set read). Drives the checkers' greedy pure-op
     #: closure (partial-order reduction); None disables the reduction.
     readonly: Optional[Callable] = None
+    #: Human rendering of a packed state word for counterexample reports:
+    #: (state, value_table) -> str. None falls back to the raw integer.
+    describe_state: Optional[Callable] = None
 
 
 def _cas_register_step(state, f, v1, v2):
@@ -502,6 +505,33 @@ def _uqueue_validate(packed):
             f"value would overflow the count nibble")
 
 
+
+def _register_describe(state, values):
+    if state == NIL_ID:
+        return "nil"
+    return repr(values[state]) if 0 <= state < len(values) else str(state)
+
+
+def _mutex_describe(state, values):
+    return "locked" if state else "free"
+
+
+def _set_describe(state, values):
+    elems = [repr(values[i]) if i < len(values) else str(i)
+             for i in range(SET_MAX_IDS) if (state >> i) & 1]
+    return "{" + ", ".join(elems) + "}"
+
+
+def _uqueue_describe(state, values):
+    parts = []
+    for i in range(UQUEUE_MAX_IDS):
+        c = (state >> (4 * i)) & 15
+        if c:
+            v = repr(values[i]) if i < len(values) else str(i)
+            parts.append(f"{v}x{c}" if c > 1 else v)
+    return "pending{" + ", ".join(parts) + "}"
+
+
 CAS_REGISTER_KERNEL = KernelSpec(
     name="cas-register",
     init_state=NIL_ID,
@@ -511,6 +541,7 @@ CAS_REGISTER_KERNEL = KernelSpec(
                                  else intern(m.value)),
     readonly=lambda f, v1, v2: (f == F_READ
                                 or (f == F_CAS and v1 == v2)),
+    describe_state=_register_describe,
 )
 
 MUTEX_KERNEL = KernelSpec(
@@ -519,6 +550,7 @@ MUTEX_KERNEL = KernelSpec(
     step=_mutex_step,
     f_codes={"acquire": F_ACQUIRE, "release": F_RELEASE},
     pack_init=lambda m, intern: int(m.locked),
+    describe_state=_mutex_describe,
 )
 
 NOOP_KERNEL = KernelSpec(
@@ -537,6 +569,7 @@ SET_KERNEL = KernelSpec(
     pack_init=_set_pack_init,
     encode_op=_set_encode,
     readonly=lambda f, v1, v2: f == F_READ,
+    describe_state=_set_describe,
 )
 
 UNORDERED_QUEUE_KERNEL = KernelSpec(
@@ -547,6 +580,7 @@ UNORDERED_QUEUE_KERNEL = KernelSpec(
     pack_init=_uqueue_pack_init,
     encode_op=_uqueue_encode,
     validate=_uqueue_validate,
+    describe_state=_uqueue_describe,
 )
 
 

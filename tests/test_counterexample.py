@@ -1,0 +1,96 @@
+"""Counterexample artifact tests (reference checker.clj:96-103: on
+valid:false knossos renders linear.svg into the store)."""
+
+import os
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from jepsen_tpu.checker import UNKNOWN
+from jepsen_tpu.checker.counterexample import analysis, render_linear_svg
+from jepsen_tpu.checker.wgl import linearizable
+from jepsen_tpu.history import History, Op
+from jepsen_tpu.models import CASRegister
+from jepsen_tpu.models.core import CAS_REGISTER_KERNEL
+from jepsen_tpu.ops import pack_history
+
+
+def _failing_history():
+    rows = [
+        (0, "invoke", "write", 1), (0, "ok", "write", 1),
+        (1, "invoke", "cas", (1, 2)), (1, "ok", "cas", (1, 2)),
+        (2, "invoke", "read", None),
+        (3, "invoke", "write", 3), (3, "info", "write", 3),
+        (2, "ok", "read", 1),
+    ]
+    h = History()
+    for i, (p, t, f, v) in enumerate(rows):
+        h.append(Op(type=t, f=f, value=v, process=p, time=i))
+    return h
+
+
+def _valid_history():
+    h = History()
+    h.append(Op(type="invoke", f="write", value=1, process=0, time=0))
+    h.append(Op(type="ok", f="write", value=1, process=0, time=1))
+    return h
+
+
+class TestLinearSvg:
+    def test_failing_history_writes_artifact(self, tmp_path):
+        test = {"store-dir": str(tmp_path)}
+        out = linearizable(CASRegister()).check(test, _failing_history())
+        assert out["valid"] is False
+        assert out["counterexample"] == "linear.svg"
+        svg = (tmp_path / "linear.svg").read_text()
+        assert svg.startswith("<svg")
+        assert "frontier" in svg
+        # the "why": the stale read is blocked from every reachable state
+        assert "blocked" in svg
+        assert "read 1" in svg
+
+    def test_valid_history_writes_nothing(self, tmp_path):
+        test = {"store-dir": str(tmp_path)}
+        out = linearizable(CASRegister()).check(test, _valid_history())
+        assert out["valid"] is True
+        assert not (tmp_path / "linear.svg").exists()
+
+    def test_no_store_dir_is_fine(self):
+        out = linearizable(CASRegister()).check({}, _failing_history())
+        assert out["valid"] is False
+        assert "counterexample" not in out
+
+    def test_device_backend_renders_too(self, tmp_path):
+        # the device result carries no frontier states; the renderer
+        # harvests them with a bounded CPU re-run
+        test = {"store-dir": str(tmp_path)}
+        out = linearizable(CASRegister(), backend="tpu").check(
+            test, _failing_history())
+        assert out["valid"] is False
+        if out.get("valid") is not UNKNOWN:
+            assert (tmp_path / "linear.svg").exists()
+
+
+class TestAnalysis:
+    def test_structure(self):
+        p = pack_history(_failing_history(), CAS_REGISTER_KERNEL)
+        from jepsen_tpu.checker.wgl import check_packed
+        res = check_packed(p, CAS_REGISTER_KERNEL)
+        assert res["valid"] is False
+        a = analysis(p, CAS_REGISTER_KERNEL, res)
+        roles = {r["role"] for r in a["ops"]}
+        assert "frontier" in roles and "linearized" in roles
+        assert "crashed" in roles          # the crashed write is optional
+        # states are human values, not interned ids
+        assert set(a["frontier-states"]) == {"2", "3"}
+        frontier = [r for r in a["ops"] if r["role"] == "frontier"][0]
+        assert frontier["note"].startswith("blocked from every")
+
+    def test_harvest_when_states_missing(self, tmp_path):
+        p = pack_history(_failing_history(), CAS_REGISTER_KERNEL)
+        res = {"valid": False, "max-linearized-prefix": 2}
+        a = render_linear_svg(p, CAS_REGISTER_KERNEL, res,
+                              str(tmp_path / "x.svg"))
+        assert a["frontier-states"]
+        assert (tmp_path / "x.svg").exists()
