@@ -40,7 +40,8 @@ from jepsen_tpu.ops.encode import PackedHistory, RET_INF
 
 def check_packed(p: PackedHistory,
                  kernel: KernelSpec,
-                 max_configs: Optional[int] = None) -> Dict[str, Any]:
+                 max_configs: Optional[int] = None,
+                 should_stop=None) -> Dict[str, Any]:
     """WGL over a packed single-key history using integer model kernels.
 
     Returns {'valid': bool, ...}; if max_configs is exceeded, {'valid':
@@ -90,6 +91,10 @@ def check_packed(p: PackedHistory,
                     "error": f"config budget {max_configs} exhausted",
                     "configs-explored": explored,
                     "max-linearized-prefix": best_k}
+        if should_stop is not None and explored % 512 == 0 \
+                and should_stop():
+            return {"valid": UNKNOWN, "configs-explored": explored,
+                    "error": "cancelled"}
         # Partial-order reduction (mirrors the device search): a succeeding
         # READ-ONLY candidate — kernel.readonly: its step can never change
         # the state at ANY state where it succeeds (register read,
@@ -195,7 +200,8 @@ def _pair_sorted(history: History) -> List[Tuple[int, int, Op]]:
 
 
 def check_model(history: History, model: Model,
-                max_configs: Optional[int] = None) -> Dict[str, Any]:
+                max_configs: Optional[int] = None,
+                should_stop=None) -> Dict[str, Any]:
     """Generic WGL over arbitrary Model objects."""
     rows = _pair_sorted(history)
     n = len(rows)
@@ -229,6 +235,10 @@ def check_model(history: History, model: Model,
             return {"valid": UNKNOWN,
                     "error": f"config budget {max_configs} exhausted",
                     "configs-explored": explored}
+        if should_stop is not None and explored % 512 == 0 \
+                and should_stop():
+            return {"valid": UNKNOWN, "configs-explored": explored,
+                    "error": "cancelled"}
         # pure-op closure — see check_packed for the reduction argument;
         # here "read-only" is the model's own readonly_op classification
         pure_mask = 0
@@ -289,17 +299,26 @@ class LinearizableChecker(Checker):
     """Checker facade (reference checker.clj:82-107 'linearizable').
 
     backend:
-      'cpu'  — this module's WGL (default; knossos-equivalent)
+      'cpu'  — host search (default)
       'tpu'  — batched JAX search on the default backend (TPU if present);
-               see jepsen_tpu.checker.tpu. Falls back to CPU search when the
-               model has no integer kernel.
+               see jepsen_tpu.checker.tpu. Falls back to the host search
+               when the model has no integer kernel.
+    algorithm (the host-search algorithm — reference checker.clj:85-94
+    selects knossos :competition | :linear | :wgl the same way):
+      'wgl'          — Wing-Gong-Lowe frontier search (this module)
+      'linear'       — just-in-time linearization (checker.jitlin)
+      'competition'  — both raced in threads, first answer wins
     """
 
     def __init__(self, model: Optional[Model] = None, backend: str = "cpu",
-                 max_configs: Optional[int] = None):
+                 max_configs: Optional[int] = None,
+                 algorithm: str = "wgl"):
+        if algorithm not in ("wgl", "linear", "competition"):
+            raise ValueError(f"unknown algorithm {algorithm!r}")
         self.model = model
         self.backend = backend
         self.max_configs = max_configs
+        self.algorithm = algorithm
 
     def check(self, test, history: History, opts=None):
         model = self.model or test.get("model")
@@ -327,9 +346,31 @@ class LinearizableChecker(Checker):
             pk = pack_with_init(history, model)
         except ValueError:  # op f unsupported by the integer kernel
             pk = None
+        from jepsen_tpu.checker.jitlin import (
+            check_jit_model, check_jit_packed, competition)
         if pk is None:
+            if self.algorithm == "linear":
+                return check_jit_model(history, model, self.max_configs)
+            if self.algorithm == "competition":
+                return competition({
+                    "wgl": lambda stop: check_model(
+                        history, model, self.max_configs,
+                        should_stop=stop),
+                    "linear": lambda stop: check_jit_model(
+                        history, model, self.max_configs,
+                        should_stop=stop),
+                })
             return check_model(history, model, self.max_configs)
         packed, kernel = pk
+        if self.algorithm == "linear":
+            return check_jit_packed(packed, kernel, self.max_configs)
+        if self.algorithm == "competition":
+            return competition({
+                "wgl": lambda stop: check_packed(
+                    packed, kernel, self.max_configs, should_stop=stop),
+                "linear": lambda stop: check_jit_packed(
+                    packed, kernel, self.max_configs, should_stop=stop),
+            })
         return check_packed(packed, kernel, self.max_configs)
 
     def _render(self, test, history: History, model: Model, out: dict):
@@ -359,5 +400,6 @@ class LinearizableChecker(Checker):
 
 
 def linearizable(model: Optional[Model] = None, backend: str = "cpu",
-                 max_configs: Optional[int] = None) -> LinearizableChecker:
-    return LinearizableChecker(model, backend, max_configs)
+                 max_configs: Optional[int] = None,
+                 algorithm: str = "wgl") -> LinearizableChecker:
+    return LinearizableChecker(model, backend, max_configs, algorithm)

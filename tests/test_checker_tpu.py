@@ -105,6 +105,20 @@ class TestInitStates:
 
 
 class TestAgainstCPUOracle:
+    def test_random_histories_agree_with_jit_algorithm(self):
+        # device pool search vs the INDEPENDENT just-in-time algorithm
+        # (not just the repo's own WGL) — a true differential oracle
+        from jepsen_tpu.checker.jitlin import check_jit_packed
+        rng = random.Random(31)
+        for i in range(60):
+            h = random_register_history(rng, n_procs=4, n_ops=9, n_vals=3,
+                                        crash_p=0.15)
+            p = pack_history(h, CAS_REGISTER_KERNEL)
+            want = check_jit_packed(p, CAS_REGISTER_KERNEL)["valid"]
+            got = check_packed_tpu(p, CAS_REGISTER_KERNEL,
+                                   capacity=512)["valid"]
+            assert got is want or got is UNKNOWN, (i, want, got)
+
     def test_random_histories_agree(self):
         rng = random.Random(7)
         mismatches = []

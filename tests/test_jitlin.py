@@ -1,0 +1,143 @@
+"""Just-in-time linearization (knossos :linear rebuild) + competition.
+
+The JIT algorithm is a deliberately independent implementation — here it
+is fuzzed against the WGL search (itself brute-force-validated in
+test_linearizable.py), giving the repo a true differential oracle pair
+(reference selects between the same algorithms at checker.clj:85-94)."""
+
+import random
+
+import pytest
+
+from jepsen_tpu.checker import UNKNOWN
+from jepsen_tpu.checker.jitlin import (
+    check_jit_model, check_jit_packed, competition)
+from jepsen_tpu.checker.wgl import check_model, check_packed, linearizable
+from jepsen_tpu.models import CASRegister, Mutex, SetModel, UnorderedQueue
+from jepsen_tpu.models.core import CAS_REGISTER_KERNEL, MUTEX_KERNEL
+from jepsen_tpu.ops import pack_history
+
+from test_linearizable import H, random_register_history
+
+
+class TestGoldenJit:
+    def test_sequential(self):
+        ok = H((0, "invoke", "write", 0), (0, "ok", "write", 0),
+               (1, "invoke", "read", None), (1, "ok", "read", 0))
+        bad = H((0, "invoke", "write", 0), (0, "ok", "write", 0),
+                (1, "invoke", "read", None), (1, "ok", "read", 1))
+        pk_ok = pack_history(ok, CAS_REGISTER_KERNEL)
+        pk_bad = pack_history(bad, CAS_REGISTER_KERNEL)
+        assert check_jit_packed(pk_ok, CAS_REGISTER_KERNEL)["valid"] is True
+        r = check_jit_packed(pk_bad, CAS_REGISTER_KERNEL)
+        assert r["valid"] is False
+        assert r["failed-op"]["f"] == "read"
+
+    def test_concurrent_reorder(self):
+        # read overlapping the write may see either value
+        h = H((0, "invoke", "write", 1),
+              (1, "invoke", "read", None), (1, "ok", "read", 1),
+              (0, "ok", "write", 1))
+        p = pack_history(h, CAS_REGISTER_KERNEL)
+        assert check_jit_packed(p, CAS_REGISTER_KERNEL)["valid"] is True
+
+    def test_crashed_write_may_apply(self):
+        h = H((0, "invoke", "write", 7), (0, "info", "write", 7),
+              (1, "invoke", "read", None), (1, "ok", "read", 7))
+        p = pack_history(h, CAS_REGISTER_KERNEL)
+        assert check_jit_packed(p, CAS_REGISTER_KERNEL)["valid"] is True
+
+    def test_mutex(self):
+        bad = H((0, "invoke", "acquire", None), (0, "ok", "acquire", None),
+                (1, "invoke", "acquire", None), (1, "ok", "acquire", None))
+        p = pack_history(bad, MUTEX_KERNEL)
+        assert check_jit_packed(p, MUTEX_KERNEL)["valid"] is False
+
+    def test_model_object_path(self):
+        h = H((0, "invoke", "enqueue", 1), (0, "ok", "enqueue", 1),
+              (1, "invoke", "dequeue", None), (1, "ok", "dequeue", 1))
+        assert check_jit_model(h, UnorderedQueue())["valid"] is True
+        bad = H((0, "invoke", "dequeue", None), (0, "ok", "dequeue", 9))
+        assert check_jit_model(bad, UnorderedQueue())["valid"] is False
+
+    def test_budget_returns_unknown(self):
+        h = random_register_history(random.Random(1), n_procs=4, n_ops=20,
+                                    n_vals=3)
+        p = pack_history(h, CAS_REGISTER_KERNEL)
+        r = check_jit_packed(p, CAS_REGISTER_KERNEL, max_configs=3)
+        assert r["valid"] is UNKNOWN
+
+
+class TestDifferentialOracle:
+    """WGL vs JIT on random histories — two independent algorithms must
+    agree on every verdict."""
+
+    def test_register_fuzz(self):
+        rng = random.Random(21)
+        for i in range(400):
+            h = random_register_history(rng, n_procs=4, n_ops=9, n_vals=3,
+                                        crash_p=0.15)
+            p = pack_history(h, CAS_REGISTER_KERNEL)
+            a = check_packed(p, CAS_REGISTER_KERNEL)["valid"]
+            b = check_jit_packed(p, CAS_REGISTER_KERNEL)["valid"]
+            assert a is b, (i, a, b, list(h))
+
+    def test_register_fuzz_object_path(self):
+        rng = random.Random(22)
+        for i in range(150):
+            h = random_register_history(rng, n_procs=3, n_ops=8, n_vals=3,
+                                        crash_p=0.1)
+            a = check_model(h, CASRegister())["valid"]
+            b = check_jit_model(h, CASRegister())["valid"]
+            assert a is b, (i, a, b, list(h))
+
+    def test_longer_histories(self):
+        rng = random.Random(23)
+        for _ in range(12):
+            h = random_register_history(rng, n_procs=5, n_ops=60, n_vals=4,
+                                        crash_p=0.05)
+            p = pack_history(h, CAS_REGISTER_KERNEL)
+            a = check_packed(p, CAS_REGISTER_KERNEL)["valid"]
+            b = check_jit_packed(p, CAS_REGISTER_KERNEL,
+                                 max_configs=500_000)["valid"]
+            assert b is a or b is UNKNOWN, (a, b)
+
+
+class TestCompetition:
+    def test_first_answer_wins(self):
+        h = random_register_history(random.Random(5), n_procs=4, n_ops=12,
+                                    n_vals=3, crash_p=0.1)
+        want = check_model(h, CASRegister())["valid"]
+        c = linearizable(CASRegister(), algorithm="competition")
+        out = c.check({}, h)
+        assert out["valid"] is want
+        assert out["algorithm"] in ("wgl", "linear")
+
+    def test_competition_fuzz(self):
+        rng = random.Random(6)
+        for _ in range(60):
+            h = random_register_history(rng, n_procs=4, n_ops=10, n_vals=3,
+                                        crash_p=0.1)
+            want = check_model(h, CASRegister())["valid"]
+            out = linearizable(CASRegister(),
+                               algorithm="competition").check({}, h)
+            assert out["valid"] is want
+
+    def test_algorithm_selection(self):
+        h = H((0, "invoke", "write", 0), (0, "ok", "write", 0))
+        for algo in ("wgl", "linear", "competition"):
+            assert linearizable(CASRegister(),
+                                algorithm=algo).check({}, h)["valid"] \
+                is True
+        with pytest.raises(ValueError):
+            linearizable(CASRegister(), algorithm="bogus")
+
+    def test_all_unknown_reported(self):
+        h = random_register_history(random.Random(9), n_procs=4, n_ops=20,
+                                    n_vals=3)
+        out = competition({
+            "a": lambda stop: {"valid": UNKNOWN, "error": "x"},
+            "b": lambda stop: {"valid": UNKNOWN, "error": "y"},
+        })
+        assert out["valid"] is UNKNOWN
+        assert out["algorithm"] in ("a", "b")
