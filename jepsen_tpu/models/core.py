@@ -346,6 +346,12 @@ class KernelSpec:
     #: ValueError when the packed history violates a kernel capacity
     #: invariant (e.g. queue per-value counts exceeding the nibble width).
     validate: Optional[Callable] = None
+    #: Post-pack id rewrite: (PackedHistory) -> None, mutating value-id
+    #: columns to fit the kernel's state encoding (e.g. the queue kernel's
+    #: value-symmetry slot coloring); raises ValueError when impossible
+    #: (the caller falls back to the generic object search). Runs before
+    #: validate.
+    remap: Optional[Callable] = None
     #: Host predicate (f_code, v1, v2) -> bool: True iff the op's step can
     #: NEVER change the state at any state where it succeeds (register
     #: read, cas(x,x), set read). Drives the checkers' greedy pure-op
@@ -451,14 +457,20 @@ UQUEUE_MAX_COUNT = 15
 
 
 def _uqueue_step(state, f, v1, v2):
+    """v1 = the op's value-field BIT OFFSET (pre-scaled by _uqueue_remap),
+    v2 = the field's count mask ((1<<width)-1). The remap guarantees the
+    field count can never exceed the mask along any search path, so the
+    increment/decrement arithmetic cannot corrupt neighboring fields."""
     is_enq = f == F_ENQUEUE
     is_deq = f == F_DEQUEUE
-    sh = (v1 * (v1 >= 0)) * 4
+    sh = v1 * (v1 >= 0)
     unit = (state * 0 + 1) << sh
-    cnt = (state >> sh) & 15
+    cnt = (state >> sh) & v2
     deq_ok = is_deq & (v1 >= 0) & (cnt > 0)
     ok = is_enq | deq_ok
-    state2 = state + unit * is_enq - unit * deq_ok
+    # v2 == 0 marks a SINK enqueue (its value is never dequeued, so its
+    # count is never read): succeeds, changes nothing
+    state2 = state + unit * (is_enq & (v2 > 0)) - unit * deq_ok
     return state2, ok
 
 
@@ -469,11 +481,9 @@ def _uqueue_encode(f_code, f, inv_value, ok_value, intern):
         # e.g. a crashed dequeue whose removed element is unknowable —
         # the word encoding cannot express "some element"
         raise ValueError("queue kernel: nil op value")
-    i = intern(val)
-    if i >= UQUEUE_MAX_IDS:
-        raise ValueError(
-            f"queue kernel: more than {UQUEUE_MAX_IDS} distinct values")
-    return i, NIL_ID
+    # unbounded interning here; _uqueue_remap interval-colors the ids
+    # onto the UQUEUE_MAX_IDS nibble slots afterwards
+    return intern(val), NIL_ID
 
 
 def _uqueue_pack_init(model, intern):
@@ -491,18 +501,126 @@ def _uqueue_pack_init(model, intern):
     return s
 
 
-def _uqueue_validate(packed):
-    """Nibble counts must never overflow: initial pending + total enqueues
-    per value <= 15 (dequeues only lower them)."""
-    counts = [(int(packed.init_state) >> (4 * i)) & 15
-              for i in range(UQUEUE_MAX_IDS)]
-    for fc, v in zip(packed.f.tolist(), packed.v1.tolist()):
-        if fc == F_ENQUEUE and v >= 0:
-            counts[v] += 1
-    if max(counts, default=0) > UQUEUE_MAX_COUNT:
+#: Usable state bits (the int32 sign bit is left clear by construction).
+UQUEUE_STATE_BITS = 31
+
+
+def _uqueue_remap(packed):
+    """Value-symmetry bit-field packing, so realistic queue workloads —
+    hundreds of unique enqueued values (reference disque.clj:305-310,
+    rabbitmq.clj:148-181) — fit one int32 state word.
+
+    Two facts make this possible:
+
+    * **interval sharing** — two values whose *event spans* are disjoint
+      can never be pending simultaneously: every op of the earlier value
+      returns before any op of the later invokes, so real-time order
+      forces all of the earlier value's ops first in any witness (and in
+      any WGL search path: the frontier cannot pass the earlier value's
+      dequeue unlinearized before the later value's ops become
+      candidates). Such values may share a count field. A value's span
+      runs from its first event to its last return — extended to
+      infinity if any of its ops crashed or it can remain pending.
+    * **adaptive field width** — a value enqueued at most once needs a
+      1-bit count; <=3 simultaneous pendings 2 bits; <=15 4 bits. The
+      dominant unique-value workload therefore fits ~31 simultaneously
+      live values, not 8.
+
+    Greedy interval coloring (optimal for interval graphs) builds field
+    slots per width class; fields get bit offsets; ops are rewritten to
+    (v1 = field offset, v2 = count mask) for _uqueue_step. Overflow of
+    any bound (width > 4 bits, total bits > UQUEUE_STATE_BITS) raises
+    ValueError and the caller falls back to the object search.
+
+    Mutates packed.v1/v2, packed.init_state (counts re-keyed by field)
+    and packed.value_table (per-field (offset, mask, label) triples for
+    describe_state)."""
+    from jepsen_tpu.ops.encode import RET_INF as _INF
+    inf = int(_INF)
+    init = int(packed.init_state)
+    # span + counts per original interned id; init-pending ids (interned
+    # first, ids 0..k, 4-bit counts from _uqueue_pack_init) span from
+    # before the history (start -1)
+    info = {}  # id -> [start, end, bound(init+enq), deq]
+    for i in range(UQUEUE_MAX_IDS):
+        c = (init >> (4 * i)) & 15
+        if c:
+            info[i] = [-1, -1, c, 0]
+    for j in range(packed.n):
+        v = int(packed.v1[j])
+        if v < 0:
+            continue
+        inv_e, ret_e = int(packed.inv[j]), int(packed.ret[j])
+        rec = info.setdefault(v, [inv_e, -1, 0, 0])
+        rec[0] = min(rec[0], inv_e)
+        rec[1] = max(rec[1], ret_e)
+        if int(packed.f[j]) == F_ENQUEUE:
+            rec[2] += 1
+        else:
+            rec[3] += 1
+    classes = {1: [], 2: [], 4: []}
+    sinks = set()
+    for v, rec in sorted(info.items(), key=lambda kv: kv[1][0]):
+        if rec[3] == 0:
+            # never dequeued: no op ever reads this value's count, so its
+            # enqueues are no-ops (sink encoding v1=0/v2=0) and it needs
+            # no field at all — the undrained tail of a queue history
+            # costs nothing
+            sinks.add(v)
+            continue
+        if rec[2] > rec[3]:
+            rec[1] = inf  # can stay pending forever: field never freed
+        b = rec[2]
+        if b > UQUEUE_MAX_COUNT:
+            raise ValueError(
+                f"queue kernel: more than {UQUEUE_MAX_COUNT} simultaneous "
+                f"pendings of one value would overflow the count field")
+        classes[1 if b <= 1 else 2 if b <= 3 else 4].append((v, rec))
+    field_slot = {}       # id -> (width, slot_index_within_class)
+    n_slots = {}
+    labels = {}           # (width, slot) -> [labels]
+    for w, vals in classes.items():
+        free_at = []      # per slot: last event index occupying it
+        for v, rec in vals:           # already span-start sorted
+            for s, fa in enumerate(free_at):
+                if fa < rec[0]:
+                    free_at[s] = rec[1]
+                    break
+            else:
+                s = len(free_at)
+                free_at.append(rec[1])
+            field_slot[v] = (w, s)
+            val = (packed.value_table[v]
+                   if 0 <= v < len(packed.value_table) else v)
+            labels.setdefault((w, s), []).append(repr(val))
+        n_slots[w] = len(free_at)
+    if sum(w * n for w, n in n_slots.items()) > UQUEUE_STATE_BITS:
         raise ValueError(
-            f"queue kernel: more than {UQUEUE_MAX_COUNT} enqueues of one "
-            f"value would overflow the count nibble")
+            f"queue kernel: {sum(n_slots.values())} simultaneously-live "
+            f"values need more than {UQUEUE_STATE_BITS} state bits")
+    # bit offsets: width classes laid out contiguously
+    base = {}
+    off = 0
+    for w in (1, 2, 4):
+        base[w] = off
+        off += w * n_slots[w]
+    field_of = {v: (base[w] + w * s, (1 << w) - 1)
+                for v, (w, s) in field_slot.items()}
+    for j in range(packed.n):
+        v = int(packed.v1[j])
+        if v >= 0:
+            o, m = field_of.get(v, (0, 0))    # sinks: v1=0, v2=0
+            packed.v1[j] = o
+            packed.v2[j] = m
+    new_init = 0
+    for i in range(UQUEUE_MAX_IDS):
+        c = (init >> (4 * i)) & 15
+        if c and i not in sinks:
+            new_init += c << field_of[i][0]
+    packed.init_state = new_init
+    packed.value_table = [
+        (base[w] + w * s, (1 << w) - 1, "|".join(ls))
+        for (w, s), ls in sorted(labels.items())]
 
 
 
@@ -523,12 +641,15 @@ def _set_describe(state, values):
 
 
 def _uqueue_describe(state, values):
+    # after _uqueue_remap, value_table holds (offset, mask, label) fields
     parts = []
-    for i in range(UQUEUE_MAX_IDS):
-        c = (state >> (4 * i)) & 15
+    for entry in values:
+        if not (isinstance(entry, tuple) and len(entry) == 3):
+            return f"state={state:#x}"
+        off, mask, label = entry
+        c = (int(state) >> off) & mask
         if c:
-            v = repr(values[i]) if i < len(values) else str(i)
-            parts.append(f"{v}x{c}" if c > 1 else v)
+            parts.append(f"{label}x{c}" if c > 1 else str(label))
     return "pending{" + ", ".join(parts) + "}"
 
 
@@ -579,7 +700,10 @@ UNORDERED_QUEUE_KERNEL = KernelSpec(
     f_codes={"enqueue": F_ENQUEUE, "dequeue": F_DEQUEUE},
     pack_init=_uqueue_pack_init,
     encode_op=_uqueue_encode,
-    validate=_uqueue_validate,
+    remap=_uqueue_remap,
+    # sink enqueues (v2==0: value never dequeued) succeed and change
+    # nothing at any state — safely absorbed by the pure-op closure
+    readonly=lambda f, v1, v2: f == F_ENQUEUE and v2 == 0,
     describe_state=_uqueue_describe,
 )
 

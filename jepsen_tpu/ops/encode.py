@@ -139,13 +139,17 @@ def _op_values(f_code: int, f: Any, inv_value: Any, ok_value: Any,
 
 
 def pack_history(history: Sequence[Op], kernel: KernelSpec,
-                 intern: Optional[_Interner] = None) -> PackedHistory:
+                 intern: Optional[_Interner] = None,
+                 init_state: Optional[int] = None) -> PackedHistory:
     """Compile a raw single-key history into a PackedHistory.
 
     Steps: (1) walk events assigning event indices; (2) pair invocations with
     completions per process; (3) drop failed pairs and crashed reads (a
     crashed read constrains nothing); (4) intern values; (5) sort ops by
-    return index (RET_INF last, tie-broken by invocation index).
+    return index (RET_INF last, tie-broken by invocation index);
+    (6) kernel remap (e.g. the queue kernel's value-slot interval
+    coloring) and capacity validation — either may raise ValueError, on
+    which the caller falls back to the generic object search.
     """
     intern = intern or _Interner()
     if kernel.encode_op is not None:
@@ -204,15 +208,21 @@ def pack_history(history: Sequence[Op], kernel: KernelSpec,
             procs[p] = len(procs)
         proc_col.append(procs[p])
 
-    return PackedHistory(
+    packed = PackedHistory(
         f=col(2), v1=col(3), v2=col(4), inv=col(0), ret=col(1),
         process=np.asarray(proc_col, dtype=np.int32) if n else
         np.zeros(0, np.int32),
         n_required=n_required,
-        init_state=kernel.init_state,
+        init_state=(kernel.init_state if init_state is None
+                    else init_state),
         value_table=intern.values,
         ops=[(r[6], r[7]) for r in rows],
     )
+    if kernel.remap is not None:
+        kernel.remap(packed)     # raises ValueError when it cannot fit
+    if kernel.validate is not None:
+        kernel.validate(packed)  # raises ValueError on capacity violation
+    return packed
 
 
 def pack_with_init(history: Sequence[Op], model,
@@ -231,10 +241,7 @@ def pack_with_init(history: Sequence[Op], model,
     intern = _Interner()
     init = (kernel.pack_init(model, intern.id)
             if kernel.pack_init is not None else kernel.init_state)
-    packed = pack_history(history, kernel, intern)
-    packed.init_state = init
-    if kernel.validate is not None:
-        kernel.validate(packed)  # raises ValueError on capacity violations
+    packed = pack_history(history, kernel, intern, init_state=init)
     return packed, kernel
 
 

@@ -396,6 +396,18 @@ class TestUnorderedQueueKernel:
             invalid += want is False and got is False
         assert decided > 100 and valid and invalid
 
+    def test_count_field_overflow_falls_back(self):
+        # one value pending >15 times simultaneously overflows even the
+        # widest (4-bit) count field
+        rows = []
+        for _ in range(17):
+            rows += [(0, "invoke", "enqueue", 9), (0, "ok", "enqueue", 9)]
+        rows += [(1, "invoke", "dequeue", None), (1, "ok", "dequeue", 9)]
+        h = H(*rows)
+        assert check_history_tpu(h, UnorderedQueue()) is None
+        assert linearizable(UnorderedQueue(), backend="tpu").check(
+            {}, h)["valid"] is True
+
     def test_crashed_dequeue_falls_back(self):
         # a crashed dequeue's removed element is unknowable: no word
         # encoding; the facade answers via the object search
@@ -405,14 +417,17 @@ class TestUnorderedQueueKernel:
         assert linearizable(UnorderedQueue(), backend="tpu").check(
             {}, h)["valid"] is True
 
-    def test_count_nibble_overflow_falls_back(self):
+    def test_never_dequeued_values_are_sinks(self):
+        # 17 enqueues of one never-dequeued value used to overflow the
+        # count nibble and fall back; sink encoding (no op ever reads the
+        # count) keeps it on the device path
         rows = []
         for i in range(17):
             rows += [(0, "invoke", "enqueue", 9), (0, "ok", "enqueue", 9)]
         h = H(*rows)
-        assert check_history_tpu(h, UnorderedQueue()) is None
-        assert linearizable(UnorderedQueue(), backend="tpu").check(
-            {}, h)["valid"] is True
+        r = check_history_tpu(h, UnorderedQueue())
+        assert r is not None and r["valid"] is True
+        assert r["backend"] == "tpu"
 
 
 def wide_history(n_procs=100, rounds=2, write_frac=0.12, seed=0,
@@ -589,6 +604,105 @@ class TestReadonlyClosureRegression:
         ro = CAS_REGISTER_KERNEL.readonly
         assert ro(F_READ, 3, -1) and ro(F_CAS, 2, 2)
         assert not ro(F_WRITE, 2, -1) and not ro(F_CAS, 2, 3)
+
+
+def unique_queue_history(n_ops=200, n_procs=5, seed=1, corrupt=False):
+    """Unique sequential enqueue values — the realistic disque/rabbitmq
+    shape (reference disque.clj:305-310) that used to blow the 8-value
+    kernel limit. Linearizable by construction (dequeues return a value
+    whose enqueue completed; empty-queue dequeues fail) unless corrupt."""
+    rng = random.Random(seed)
+    h = History()
+    free = list(range(n_procs))
+    open_ops = {}
+    pending = []
+    nextv = done = t = 0
+    while done < n_ops or open_ops:
+        if free and done < n_ops and (not open_ops or rng.random() < 0.55):
+            p = free.pop(rng.randrange(len(free)))
+            if rng.random() < 0.55 or not pending:
+                op = Op(type="invoke", f="enqueue", value=nextv, process=p,
+                        time=t)
+                nextv += 1
+            else:
+                op = Op(type="invoke", f="dequeue", value=None, process=p,
+                        time=t)
+            h.append(op)
+            open_ops[p] = op
+            done += 1
+        else:
+            p = rng.choice(list(open_ops))
+            inv = open_ops.pop(p)
+            if inv.f == "enqueue":
+                pending.append(inv.value)
+                h.append(Op(type="ok", f="enqueue", value=inv.value,
+                            process=p, time=t))
+            else:
+                if pending:
+                    v = pending.pop(rng.randrange(len(pending)))
+                    h.append(Op(type="ok", f="dequeue", value=v,
+                                process=p, time=t))
+                else:
+                    h.append(Op(type="fail", f="dequeue", value=None,
+                                process=p, time=t))
+            free.append(p)
+        t += 1
+    if corrupt:
+        rows = list(h)
+        for i in range(len(rows) - 1, -1, -1):
+            if rows[i].type == "ok" and rows[i].f == "dequeue":
+                rows[i] = rows[i].replace(value=10**7)
+                break
+        h = History.of(rows)
+    return h
+
+
+class TestQueueValueSymmetry:
+    """Adaptive bit-field packing (interval value sharing + per-value
+    count widths + never-dequeued sinks) keeps production-shaped queue
+    histories on the device path (VERDICT r2 weak #4)."""
+
+    def test_200_op_unique_values_ride_device_path(self):
+        h = unique_queue_history(200, seed=1)
+        r = check_history_tpu(h, UnorderedQueue())
+        assert r is not None and r["valid"] is True
+        assert r["backend"] == "tpu"
+
+    def test_200_op_corrupted_detected_on_device(self):
+        h = unique_queue_history(200, seed=1, corrupt=True)
+        r = check_history_tpu(h, UnorderedQueue())
+        assert r is not None and r["valid"] is False
+        assert r["backend"] == "tpu"
+
+    def test_unique_value_fuzz_vs_object_oracle(self):
+        rng = random.Random(3)
+        fallbacks = 0
+        for seed in range(60):
+            h = unique_queue_history(14, n_procs=3, seed=seed,
+                                     corrupt=(seed % 3 == 0))
+            want = check_model(h, UnorderedQueue())["valid"]
+            r = check_history_tpu(h, UnorderedQueue(), capacity=512)
+            if r is None:
+                fallbacks += 1
+                continue
+            got = r["valid"]
+            assert got is want or got is UNKNOWN, (seed, want, got)
+        assert fallbacks == 0  # every unique-value history fits the word
+
+    def test_interval_sharing_reuses_fields(self):
+        # sequential lifetimes share one bit: 30 values, depth 1
+        rows = []
+        for v in range(30):
+            rows += [(0, "invoke", "enqueue", v), (0, "ok", "enqueue", v),
+                     (1, "invoke", "dequeue", None),
+                     (1, "ok", "dequeue", v)]
+        h = H(*rows)
+        from jepsen_tpu.ops.encode import pack_with_init
+        p, kernel = pack_with_init(h, UnorderedQueue())
+        # all 30 values colored onto very few bit fields
+        assert len(p.value_table) <= 2
+        r = check_history_tpu(h, UnorderedQueue())
+        assert r["valid"] is True and r["backend"] == "tpu"
 
 
 class TestScale:
