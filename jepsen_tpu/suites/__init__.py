@@ -8,46 +8,61 @@ ops.
 
 from __future__ import annotations
 
+import warnings
 from typing import Callable, Dict
 
+#: The complete suite inventory: name -> (module, constructor attr).
+#: tests/test_suite_registry.py asserts registry() serves every row, so a
+#: typo here or a broken suite module fails CI instead of silently
+#: vanishing from the CLI.
+SUITES = {
+    "etcd": ("etcd", "etcd_test"),
+    "zookeeper": ("zookeeper", "zk_test"),
+    "consul": ("consul", "consul_test"),
+    "disque": ("disque", "disque_test"),
+    "raftis": ("raftis", "raftis_test"),
+    "chronos": ("chronos", "chronos_test"),
+    "rabbitmq": ("rabbitmq", "rabbitmq_test"),
+    "rabbitmq-mutex": ("rabbitmq", "mutex_test"),
+    "hazelcast": ("hazelcast", "hazelcast_test"),
+    "cockroachdb": ("cockroachdb", "register_test"),
+    "cockroachdb-bank": ("cockroachdb", "bank_test"),
+    "cockroachdb-sets": ("cockroachdb", "sets_test"),
+    "galera": ("galera", "dirty_reads_test"),
+    "aerospike": ("aerospike", "cas_register_test"),
+    "aerospike-counter": ("aerospike", "counter_test"),
+    "mongodb": ("mongodb", "document_cas_test"),
+    "mongodb-transfer": ("mongodb", "transfer_test"),
+    "mongodb-rocks": ("small", "mongodb_rocks_test"),
+    "elasticsearch": ("elasticsearch", "dirty_read_test"),
+    "tidb": ("sql_family", "tidb_bank_test"),
+    "percona": ("sql_family", "percona_dirty_reads_test"),
+    "mysql-cluster": ("sql_family", "mysql_cluster_bank_test"),
+    "postgres-rds": ("sql_family", "postgres_rds_bank_test"),
+    "crate": ("sql_family", "crate_version_divergence_test"),
+    "logcabin": ("small", "logcabin_test"),
+    "robustirc": ("small", "robustirc_test"),
+    "rethinkdb": ("small", "rethinkdb_test"),
+    "ravendb": ("small", "ravendb_test"),
+}
 
-def registry() -> Dict[str, Callable[[dict], dict]]:
-    """Suite-name -> test constructor, imported lazily."""
-    from jepsen_tpu.suites import consul, disque, etcd, raftis, zookeeper
-    out = {
-        "etcd": etcd.etcd_test,
-        "zookeeper": zookeeper.zk_test,
-        "consul": consul.consul_test,
-        "disque": disque.disque_test,
-        "raftis": raftis.raftis_test,
-    }
+
+def registry(strict: bool = False) -> Dict[str, Callable[[dict], dict]]:
+    """Suite-name -> test constructor, imported lazily.
+
+    A suite that fails to import/resolve is LOUD: a warning by default
+    (so one broken suite doesn't take down the CLI), an exception under
+    strict=True (what the registry test uses)."""
     import importlib
-    for name, mod, attr in (
-            ("rabbitmq", "rabbitmq", "rabbitmq_test"),
-            ("rabbitmq-mutex", "rabbitmq", "mutex_test"),
-            ("hazelcast", "hazelcast", "hazelcast_test"),
-            ("cockroachdb", "cockroachdb", "register_test"),
-            ("cockroachdb-bank", "cockroachdb", "bank_test"),
-            ("cockroachdb-sets", "cockroachdb", "sets_test"),
-            ("galera", "galera", "dirty_reads_test"),
-            ("aerospike", "aerospike", "cas_register_test"),
-            ("aerospike-counter", "aerospike", "counter_test"),
-            ("mongodb", "mongodb", "document_cas_test"),
-            ("mongodb-transfer", "mongodb", "transfer_test"),
-            ("mongodb-rocks", "small", "mongodb_rocks_test"),
-            ("elasticsearch", "elasticsearch", "dirty_read_test"),
-            ("tidb", "sql_family", "tidb_bank_test"),
-            ("percona", "sql_family", "percona_dirty_reads_test"),
-            ("mysql-cluster", "sql_family", "mysql_cluster_bank_test"),
-            ("postgres-rds", "sql_family", "postgres_rds_bank_test"),
-            ("crate", "sql_family", "crate_version_divergence_test"),
-            ("logcabin", "small", "logcabin_test"),
-            ("robustirc", "small", "robustirc_test"),
-            ("rethinkdb", "small", "rethinkdb_test"),
-            ("ravendb", "small", "ravendb_test")):
+    out: Dict[str, Callable[[dict], dict]] = {}
+    for name, (mod, attr) in SUITES.items():
         try:
             m = importlib.import_module(f"jepsen_tpu.suites.{mod}")
             out[name] = getattr(m, attr)
-        except (ImportError, AttributeError):
-            pass  # suite not built yet
+        except (ImportError, AttributeError) as e:
+            if strict:
+                raise
+            warnings.warn(
+                f"suite {name!r} ({mod}.{attr}) failed to load: {e!r}",
+                RuntimeWarning, stacklevel=2)
     return out

@@ -210,3 +210,257 @@ def _iso(posix: float) -> str:
     import datetime
     return (datetime.datetime.fromtimestamp(posix, datetime.timezone.utc)
             .strftime("%Y-%m-%dT%H:%M:%SZ"))
+
+
+# ---------------------------------------------------------------------------
+# Cluster DB, run capture, nemesis, test builder (chronos.clj)
+# ---------------------------------------------------------------------------
+
+#: docs say 8080 but the package binds to 4400 by default (chronos.clj:25)
+PORT = 4400
+JOB_DIR = "/tmp/chronos-test/"
+
+
+def run_command(job: Job) -> str:
+    """The shell command a run executes: log job name + start, sleep for
+    the duration, log the end (chronos.clj command, :112-119). The run
+    logfiles under JOB_DIR are what read_runs harvests."""
+    return (f"MEW=$(mktemp -p {JOB_DIR}); "
+            f"echo \"{job.name}\" >> $MEW; "
+            f"date -u -Ins >> $MEW; "
+            f"sleep {int(job.duration)}; "
+            f"date -u -Ins >> $MEW;")
+
+
+def parse_file_time(t):
+    """ISO8601 with comma fractional seconds -> POSIX seconds
+    (chronos.clj parse-file-time: date emits commas in some locales)."""
+    if not t:
+        return None
+    import datetime
+    t = t.strip().replace(",", ".")
+    # `date -u -Ins` appends +00:00; fromisoformat handles it (trim the
+    # nanosecond tail to microseconds first)
+    import re as _re
+    t = _re.sub(r"\.(\d{6})\d*", r".\1", t)
+    return datetime.datetime.fromisoformat(t).timestamp()
+
+
+def parse_file(node, file_str: str) -> dict:
+    """One run logfile: name, start, end lines (chronos.clj parse-file)."""
+    parts = (file_str.split("\n") + [None, None, None])[:3]
+    name, start, end = parts
+    return {"node": node, "name": int(name),
+            "start": parse_file_time(start),
+            "end": parse_file_time(end)}
+
+
+def read_runs(test: dict) -> List[dict]:
+    """All runs from all nodes: cat every JOB_DIR logfile over the
+    control plane (chronos.clj read-runs, c/on-many + cu/ls-full)."""
+    from jepsen_tpu.control import on_nodes
+    from jepsen_tpu.control import util as cu
+
+    def per_node(t, node):
+        try:
+            files = cu.ls_full(t, node, JOB_DIR)
+        except Exception:  # noqa: BLE001 — node may be down/partitioned
+            return []
+        out = []
+        for path in files:
+            try:
+                from jepsen_tpu import control
+                out.append(parse_file(node,
+                                      control.exec(t, node, "cat", path)))
+            except Exception:  # noqa: BLE001
+                continue
+        return out
+    by_node = on_nodes(test, per_node)
+    return [r for runs in by_node.values() for r in runs]
+
+
+class ChronosDB:
+    """Chronos over the mesos cluster (chronos.clj db, :57-83): mesos+ZK
+    substrate, chronos package, lowered scheduler horizon, service
+    start/stop, log capture."""
+
+    def __init__(self, mesos_version: str = "0.23.0-1.0.debian81",
+                 chronos_version: str = "2.3.4-1.0.81.debian77"):
+        from jepsen_tpu.suites.mesosphere import MesosDB
+        self.mesos = MesosDB(mesos_version)
+        self.chronos_version = chronos_version
+
+    def setup(self, test, node):
+        from jepsen_tpu import control
+        from jepsen_tpu.os import debian
+        self.mesos.setup(test, node)
+        debian.install(test, node, {"chronos": self.chronos_version})
+        with control.sudo():
+            # lower the scheduler horizon, else chronos forgets frequent
+            # tasks (chronos.clj configure, :41-46)
+            control.execute(
+                test, node,
+                "echo 1 > /etc/chronos/conf/schedule_horizon")
+            control.exec(test, node, "mkdir", "-p", JOB_DIR)
+        start_chronos(test, node)
+
+    def teardown(self, test, node):
+        from jepsen_tpu import control
+        from jepsen_tpu.control import util as cu
+        with control.sudo():
+            try:
+                control.exec(test, node, "service", "chronos", "stop")
+            except control.RemoteError:
+                pass
+            try:
+                cu.grepkill(test, node, "/usr/bin/chronos")
+            except control.RemoteError:
+                pass
+        self.mesos.teardown(test, node)
+        with control.sudo():
+            control.execute(test, node, f"rm -rf {JOB_DIR}")
+            control.execute(test, node,
+                            "truncate --size 0 /var/log/messages || true")
+
+    def log_files(self, test, node):
+        return self.mesos.log_files(test, node) + ["/var/log/messages"]
+
+
+def start_chronos(test, node) -> None:
+    """Start chronos if not already running (chronos.clj start!, :48-55)."""
+    from jepsen_tpu import control
+    with control.sudo():
+        try:
+            control.exec(test, node, "service", "chronos", "status")
+        except control.RemoteError:
+            control.exec(test, node, "service", "chronos", "start")
+
+
+class ResurrectionHub:
+    """Nemesis wrapper: mesos and chronos crash all the time; an
+    f='resurrect' op restarts mesos master+slave and chronos on every
+    node, any other op is delegated to the wrapped nemesis
+    (chronos.clj resurrection-hub, :220-238)."""
+
+    def __init__(self, nemesis):
+        self.nemesis = nemesis
+
+    def setup(self, test):
+        self.nemesis = self.nemesis.setup(test)
+        return self
+
+    def invoke(self, test, op):
+        if op.f != "resurrect":
+            return self.nemesis.invoke(test, op)
+        from jepsen_tpu.control import on_nodes
+        from jepsen_tpu.suites import mesosphere
+
+        def revive(t, node):
+            mesosphere.start_master(t, node)
+            mesosphere.start_slave(t, node)
+            start_chronos(t, node)
+        on_nodes(test, revive)
+        return op.replace(value="resurrection-complete")
+
+    def teardown(self, test):
+        self.nemesis.teardown(test)
+
+
+def add_job_gen(seed: Optional[int] = None):
+    """Generator of add-job invocations (chronos.clj add-job, :194-218):
+    runs never overlap because the interval exceeds
+    duration + epsilon + forgiveness."""
+    import random
+    import time as _time
+
+    rng = random.Random(seed)
+    counter = {"id": 0}
+
+    def op_fn(test=None, process=None):
+        head_start = 10  # schedule a bit in the future
+        duration = rng.randrange(10)
+        epsilon = 10 + rng.randrange(20)
+        interval = (1 + duration + epsilon + EPSILON_FORGIVENESS
+                    + rng.randrange(30))
+        counter["id"] += 1
+        return Op(type="invoke", f="add-job",
+                  value=Job(name=counter["id"],
+                            start=_time.time() + head_start,
+                            interval=interval,
+                            count=1 + rng.randrange(99),
+                            epsilon=epsilon,
+                            duration=duration))
+    return gen.gen(op_fn)
+
+
+class ChronosRunsClient(ChronosClient):
+    """ChronosClient whose final read harvests the run logfiles from the
+    nodes over the control plane (chronos.clj Client :read ->
+    read-runs)."""
+
+    def open(self, test, node):
+        return ChronosRunsClient(node, self.port, self.timeout)
+
+    def invoke(self, test, op: Op) -> Op:
+        import time as _time
+        if op.f == "read":
+            try:
+                runs = read_runs(test)
+            except Exception as e:  # noqa: BLE001
+                return op.replace(type="fail", error=repr(e)[:100])
+            return op.replace(type="ok",
+                              value={"time": _time.time(), "runs": runs})
+        return super().invoke(test, op)
+
+
+def chronos_test(opts: dict) -> dict:
+    """simple-test (chronos.clj:240-270): create jobs on a stagger, let
+    them run under a start/stop/resurrect nemesis cycle, then a final
+    read of which runs happened, checked by the CSP-equivalent matcher."""
+    from jepsen_tpu import nemesis as nem
+    from jepsen_tpu.os import debian
+
+    test = noop_test()
+    time_limit = opts.get("time-limit", 450)
+
+    def nemesis_cycle():
+        while True:
+            yield gen.sleep(200)
+            yield gen.once({"type": "info", "f": "start"})
+            yield gen.sleep(200)
+            yield gen.once({"type": "info", "f": "stop"})
+            yield gen.once({"type": "info", "f": "resurrect"})
+
+    test.update({
+        "name": "chronos",
+        "os": debian.os(),
+        "db": ChronosDB(opts.get("mesos-version", "0.23.0-1.0.debian81"),
+                        opts.get("chronos-version",
+                                 "2.3.4-1.0.81.debian77")),
+        "client": ChronosRunsClient(),
+        "nemesis": ResurrectionHub(nem.partition_random_halves()),
+        "checker": chronos_checker(),
+        "generator": gen.phases(
+            gen.time_limit(
+                time_limit,
+                gen.clients(
+                    gen.stagger(30, gen.delay(30, add_job_gen())),
+                    gen.seq(nemesis_cycle()))),
+            gen.nemesis(gen.once({"type": "info", "f": "stop"})),
+            gen.nemesis(gen.once({"type": "info", "f": "resurrect"})),
+            gen.clients(gen.once({"type": "invoke", "f": "read"}))),
+    })
+    test.update({k: v for k, v in opts.items()
+                 if k in ("nodes", "concurrency", "ssh", "time-limit",
+                          "store-dir", "store-root", "net")})
+    return test
+
+
+def main(argv=None):
+    from jepsen_tpu import cli
+    cli.main(cli.merge_commands(cli.single_test_cmd(chronos_test),
+                                cli.serve_cmd()), argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -14,6 +14,80 @@ def op(f, v=None, p=0):
     return Op(type="invoke", f=f, value=v, process=p, time=0)
 
 
+class TestChronosCluster:
+    """The mesos cluster DB, run capture, and resurrection-hub nemesis
+    (chronos.clj:57-83,220-238; mesosphere.clj) in dummy-SSH mode."""
+
+    def test_mesos_master_slave_split(self):
+        from jepsen_tpu.suites import mesosphere
+        t = dummy_test()
+        assert mesosphere.master_nodes(t) == ["n1", "n2", "n3"]
+        assert mesosphere.is_master(t, "n1")
+        assert not mesosphere.is_master(t, "n5")
+        assert mesosphere.zk_uri(t) == (
+            "zk://n1:2181,n2:2181,n3:2181,n4:2181,n5:2181/mesos")
+
+    def test_parse_run_file(self):
+        r = chronos.parse_file(
+            "n2", "7\n2016-01-01T00:00:01,500000000+00:00\n"
+                  "2016-01-01T00:00:06,500000000+00:00")
+        assert r["name"] == 7 and r["node"] == "n2"
+        assert abs(r["end"] - r["start"] - 5.0) < 1e-6
+        r2 = chronos.parse_file("n1", "3\n2016-01-01T00:00:01,5+00:00\n")
+        assert r2["end"] is None
+
+    def test_run_command_logs_name_and_times(self):
+        j = chronos.Job(name=4, start=0, interval=60, count=1, epsilon=10,
+                        duration=3)
+        cmd = chronos.run_command(j)
+        assert "mktemp -p /tmp/chronos-test/" in cmd
+        assert 'echo "4"' in cmd and "sleep 3" in cmd
+
+    def test_resurrection_hub_restarts_everything(self):
+        from jepsen_tpu import nemesis as nem
+        t = dummy_test()
+        with control.session_pool(t):
+            hub = chronos.ResurrectionHub(nem.noop()).setup(t)
+            out = hub.invoke(t, op("resurrect").replace(type="info",
+                                                        process="nemesis"))
+            assert out.value == "resurrection-complete"
+            cmds = logs(t)
+            # chronos restarted everywhere; masters/slaves on their nodes
+            assert any("service chronos" in c for c in cmds["n1"])
+            assert any("mesos-master" in c for c in cmds["n1"])
+            assert any("mesos-slave" in c for c in cmds["n5"])
+            assert not any("mesos-slave" in c for c in cmds["n1"])
+
+    def test_resurrection_hub_delegates_other_ops(self):
+        from jepsen_tpu import nemesis as nem
+        t = dummy_test()
+        with control.session_pool(t):
+            hub = chronos.ResurrectionHub(
+                nem.partition_halves()).setup(t)
+            out = hub.invoke(t, op("start").replace(type="info",
+                                                    process="nemesis"))
+            assert "Cut off" in str(out.value)
+
+    def test_add_job_gen_non_overlapping(self):
+        g = chronos.add_job_gen(seed=5)
+        seen = set()
+        for _ in range(20):
+            o = g.op({}, 0)
+            j = o.value
+            assert j.name not in seen
+            seen.add(j.name)
+            assert j.interval > j.duration + j.epsilon \
+                + chronos.EPSILON_FORGIVENESS
+            assert 1 <= j.count <= 99
+
+    def test_chronos_test_map_builds(self):
+        t = chronos.chronos_test({"time-limit": 1,
+                                  "nodes": ["n1", "n2", "n3"]})
+        assert t["name"] == "chronos"
+        assert isinstance(t["nemesis"], chronos.ResurrectionHub)
+        assert isinstance(t["db"], chronos.ChronosDB)
+
+
 class TestChronosChecker:
     def job(self, **kw):
         base = dict(name=0, start=100.0, interval=60.0, count=3,

@@ -22,15 +22,28 @@ ALL_SUITES = sorted([
     "cockroachdb-sets", "galera", "aerospike", "aerospike-counter",
     "mongodb", "mongodb-transfer", "mongodb-rocks", "elasticsearch",
     "tidb", "percona", "mysql-cluster", "postgres-rds", "crate",
-    "logcabin", "robustirc", "rethinkdb", "ravendb",
+    "logcabin", "robustirc", "rethinkdb", "ravendb", "chronos",
 ])
 
 
 class TestRegistry:
     def test_all_suites_registered(self):
-        reg = suites.registry()
+        # strict=True: a suite with an import/typo problem raises here
+        # instead of silently vanishing (how the chronos omission survived
+        # two rounds)
+        reg = suites.registry(strict=True)
+        assert sorted(reg) == sorted(suites.SUITES)
         missing = [s for s in ALL_SUITES if s not in reg]
         assert not missing, f"missing suites: {missing}"
+
+    def test_broken_suite_warns_loudly(self, monkeypatch):
+        monkeypatch.setitem(suites.SUITES, "bogus-suite",
+                            ("no_such_module", "nope"))
+        with pytest.warns(RuntimeWarning, match="bogus-suite"):
+            reg = suites.registry()
+        assert "bogus-suite" not in reg
+        with pytest.raises(ImportError):
+            suites.registry(strict=True)
 
     @pytest.mark.parametrize("name", ALL_SUITES)
     def test_suite_builds_test_map(self, name):
