@@ -25,6 +25,7 @@ REMOTE_DIR = "/opt/jepsen"
 HELPERS = {
     "bump-time": "bump_time.cc",
     "strobe-time": "strobe_time.cc",
+    "adj-time": "adj_time.cc",
 }
 
 
@@ -65,6 +66,14 @@ def bump_time(test: dict, node, delta_ms: float) -> None:
     """Jump the node's wall clock by delta milliseconds (time.clj:50-53)."""
     with control.sudo():
         control.exec(test, node, f"{REMOTE_DIR}/bump-time", delta_ms)
+
+
+def slew_time(test: dict, node, delta_ms: float) -> None:
+    """Gradually slew the node's clock by delta milliseconds via
+    adjtime(2) — smooth drift rather than a jump (reference
+    cockroachdb/resources/adjtime.c:1-19, compiled by auto.clj:122-140)."""
+    with control.sudo():
+        control.exec(test, node, f"{REMOTE_DIR}/adj-time", delta_ms)
 
 
 def strobe_time(test: dict, node, delta_ms: float, period_ms: float,

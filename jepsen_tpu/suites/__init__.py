@@ -28,6 +28,7 @@ SUITES = {
     "cockroachdb": ("cockroachdb", "register_test"),
     "cockroachdb-bank": ("cockroachdb", "bank_test"),
     "cockroachdb-sets": ("cockroachdb", "sets_test"),
+    "cockroachdb-comments": ("cockroachdb", "comments_test"),
     "galera": ("galera", "dirty_reads_test"),
     "aerospike": ("aerospike", "cas_register_test"),
     "aerospike-counter": ("aerospike", "counter_test"),

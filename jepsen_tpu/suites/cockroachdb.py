@@ -116,9 +116,50 @@ class SQLClient(client_ns.Client):
 # ---------------------------------------------------------------------------
 
 
+#: On-node pcap written by the packet capture (auto.clj pcaplog).
+PCAPLOG = f"{DIR}/trace.pcap"
+DB_PORT = 26257
+
+
+def control_addr(test, node) -> str:
+    """The control node's address as seen from a DB node: the SSH_CLIENT
+    env var of our own session (auto.clj:58-66). The sudo wrapper is
+    dropped so we read the session's env, not a subshell's."""
+    import re as _re
+    line = control.execute(test, node, "env | grep SSH_CLIENT")
+    m = _re.search(r"SSH_CLIENT=(.+?)\s", line)
+    if not m:
+        raise control.RemoteError(node, "env | grep SSH_CLIENT", 1,
+                                  line, "no SSH_CLIENT")
+    return m.group(1)
+
+
+def packet_capture(test, node) -> None:
+    """Start tcpdump on the node, filtered to control-node traffic on the
+    SQL port, as a background daemon (auto.clj packet-capture!,
+    :67-76)."""
+    addr = control_addr(test, node)
+    with control.sudo():
+        control.exec(test, node, "start-stop-daemon",
+                     "--start", "--background",
+                     "--exec", "/usr/sbin/tcpdump",
+                     "--",
+                     "-w", PCAPLOG, "host", addr,
+                     "and", "port", DB_PORT)
+
+
+def stop_packet_capture(test, node) -> None:
+    with control.sudo():
+        try:
+            control.exec(test, node, "killall", "-9", "-w", "tcpdump")
+        except control.RemoteError:
+            pass
+
+
 class CockroachDB(db_ns.DB, db_ns.LogFiles):
-    def __init__(self, version: str = "v1.0"):
+    def __init__(self, version: str = "v1.0", tcpdump: bool = False):
         self.version = version
+        self.tcpdump = tcpdump
 
     def tarball_url(self):
         return (f"https://binaries.cockroachdb.com/"
@@ -127,6 +168,8 @@ class CockroachDB(db_ns.DB, db_ns.LogFiles):
     def setup(self, test, node):
         cu.install_archive(test, node,
                            test.get("tarball", self.tarball_url()), DIR)
+        if self.tcpdump or test.get("tcpdump"):
+            packet_capture(test, node)
         joins = ",".join(str(n) for n in test["nodes"])
         cu.start_daemon(
             test, node, COCKROACH,
@@ -136,11 +179,16 @@ class CockroachDB(db_ns.DB, db_ns.LogFiles):
             logfile=LOGFILE, pidfile=PIDFILE, chdir=DIR)
 
     def teardown(self, test, node):
+        if self.tcpdump or test.get("tcpdump"):
+            stop_packet_capture(test, node)
         cu.grepkill(test, node, "cockroach")
         control.exec(test, node, "rm", "-rf", STORE, LOGFILE)
 
     def log_files(self, test, node):
-        return [LOGFILE]
+        out = [LOGFILE]
+        if self.tcpdump or test.get("tcpdump"):
+            out.append(PCAPLOG)
+        return out
 
 
 def kill(test, node):
@@ -272,6 +320,42 @@ def big_skews() -> dict:
 
 def huge_skews() -> dict:
     return skew("huge", 7_500)
+
+
+class _SlewNemesis(nem.Nemesis):
+    """Gradually slew clocks on a random node subset via adjtime(2) —
+    smooth drift, the fault NTP-disciplined clocks actually exhibit
+    (reference cockroachdb/resources/adjtime.c, compiled by
+    auto.clj:122-140)."""
+
+    def __init__(self, delta_ms: float):
+        self.delta_ms = delta_ms
+
+    def setup(self, test):
+        nt.install(test)
+        return self
+
+    def invoke(self, test, op):
+        if op.f == "start":
+            targets = nt.random_nonempty_subset(test.get("nodes") or [])
+            plan = {n: random.choice([-1, 1]) * self.delta_ms
+                    for n in targets}
+            control.on_nodes(test,
+                             lambda t, n: nt.slew_time(t, n, plan[n]),
+                             nodes=list(plan))
+            return op.replace(value=plan)
+        if op.f == "stop":
+            control.on_nodes(test, nt.reset_time)
+            return op.replace(value="clocks reset")
+        raise ValueError(f"slew nemesis got f={op.f!r}")
+
+    def teardown(self, test):
+        control.on_nodes(test, nt.reset_time)
+
+
+def gradual_skews() -> dict:
+    return {**nemesis_single_gen(), "name": "gradual-skews",
+            "client": _SlewNemesis(250), "clocks": True}
 
 
 class _StrobeNemesis(nem.Nemesis):
@@ -444,6 +528,7 @@ NEMESES: Dict[str, Callable[[], dict]] = {
     "big-skews": big_skews,
     "huge-skews": huge_skews,
     "strobe-skews": strobe_skews,
+    "gradual-skews": gradual_skews,
 }
 
 
@@ -596,6 +681,79 @@ class SetsClient(SQLClient):
         raise ValueError(f"unknown op {op.f!r}")
 
 
+class CommentsClient(SQLClient):
+    """Strict-serializability probe (comments.clj): concurrent blind
+    inserts spread over TABLE_COUNT tables (so keys land in different
+    shard ranges), plus transactional reads across every table."""
+
+    TABLE_COUNT = 10
+
+    def setup(self, test):
+        node = test["nodes"][0]
+        for t in self._tables():
+            sql(test, node, f"CREATE TABLE IF NOT EXISTS {t} "
+                            f"(id INT PRIMARY KEY, key INT)")
+
+    def _tables(self):
+        return [f"comment_{i}" for i in range(self.TABLE_COUNT)]
+
+    def _table_for(self, op_id: int) -> str:
+        return f"comment_{hash(op_id) % self.TABLE_COUNT}"
+
+    def _invoke(self, test, op):
+        k, v = op.value
+        if op.f == "write":
+            sql(test, self.node,
+                f"INSERT INTO {self._table_for(int(v))} (id, key) "
+                f"VALUES ({int(v)}, {int(k)})")
+            return op.replace(type="ok")
+        if op.f == "read":
+            selects = " UNION ALL ".join(
+                f"SELECT id FROM {t} WHERE key = {int(k)}"
+                for t in self._tables())
+            rows = sql(test, self.node,
+                       f"BEGIN; SET TRANSACTION ISOLATION LEVEL "
+                       f"SERIALIZABLE; {selects}; COMMIT")
+            ids = sorted(int(r[0]) for r in rows if r and r[0] != "id")
+            return op.replace(type="ok",
+                              value=independent.tuple_(k, ids))
+        raise ValueError(f"unknown op {op.f!r}")
+
+
+class CommentsChecker(Checker):
+    """T1 < T2 but T2 visible without T1 — the strict-serializability
+    anomaly (comments.clj checker, :92-140). Replaying the (per-key)
+    history: ``expected[w]`` is the set of writes known complete before
+    w's invocation; an ok read seeing w but missing some member of
+    expected[w] is a violation."""
+
+    def check(self, test, history, opts=None):
+        completed: set = set()
+        expected: Dict[int, frozenset] = {}
+        errors = []
+        for op in history:
+            if op.f == "write":
+                if op.is_invoke:
+                    expected[op.value] = frozenset(completed)
+                elif op.is_ok:
+                    completed.add(op.value)
+            elif op.f == "read" and op.is_ok and op.value is not None:
+                seen = set(op.value)
+                our_expected: set = set()
+                for w in seen:
+                    our_expected |= expected.get(w, frozenset())
+                missing = our_expected - seen
+                if missing:
+                    errors.append({"op": op.to_dict(),
+                                   "missing": sorted(missing),
+                                   "expected-count": len(our_expected)})
+        return {"valid": not errors, "errors": errors}
+
+
+def comments_checker() -> CommentsChecker:
+    return CommentsChecker()
+
+
 # ---------------------------------------------------------------------------
 # Tests (register/bank/sets + reuse of monotonic/sequential/g2 checkers)
 # ---------------------------------------------------------------------------
@@ -665,10 +823,40 @@ def sets_test(opts: dict) -> dict:
     })
 
 
+def comments_test(opts: dict) -> dict:
+    """comments.clj test: per-key mix of blind writes (globally unique
+    ids) and transactional cross-table reads, checked per key."""
+    keys = __import__("itertools").count()
+    ids = __import__("itertools").count()
+
+    def writes(test, process):
+        return {"type": "invoke", "f": "write", "value": next(ids)}
+
+    reads = {"type": "invoke", "f": "read", "value": None}
+    return basic_test({
+        **opts,
+        "name": "comments",
+        "client": {
+            "client": CommentsClient(),
+            "during": independent.concurrent_generator(
+                len(opts.get("nodes", [1] * 5)), keys,
+                lambda k: gen.limit(
+                    opts.get("ops-per-key", 500),
+                    gen.stagger(1 / 100, gen.mix([reads, writes])))),
+            "final": None,
+        },
+        "checker": compose({
+            "perf": perf(),
+            "comments": independent.checker(comments_checker()),
+        }),
+    })
+
+
 TESTS: Dict[str, Callable[[dict], dict]] = {
     "register": register_test,
     "bank": bank_test,
     "sets": sets_test,
+    "comments": comments_test,
 }
 
 

@@ -187,3 +187,106 @@ class TestBasicTestTemplate:
             assert "--insecure" in start_cmd
             db.teardown(t, "n1")
             assert any("xargs kill -9" in c for c in logs(t)["n1"])
+
+
+class TestCommentsWorkload:
+    """Strict-serializability comments workload (comments.clj)."""
+
+    def test_checker_valid_history(self):
+        # w1 completes before w2 invokes; read sees both
+        h = [op("write", 1).replace(type="invoke"),
+             op("write", 1).replace(type="ok"),
+             op("write", 2).replace(type="invoke"),
+             op("write", 2).replace(type="ok"),
+             op("read", None).replace(type="invoke"),
+             op("read", [1, 2]).replace(type="ok")]
+        assert cr.comments_checker().check({}, h)["valid"] is True
+
+    def test_checker_t2_without_t1_violation(self):
+        # w1 completed before w2 was invoked (w1 < w2 in real time), but
+        # the read sees w2 without w1: strict serializability violated
+        h = [op("write", 1).replace(type="invoke"),
+             op("write", 1).replace(type="ok"),
+             op("write", 2).replace(type="invoke"),
+             op("write", 2).replace(type="ok"),
+             op("read", None).replace(type="invoke"),
+             op("read", [2]).replace(type="ok")]
+        out = cr.comments_checker().check({}, h)
+        assert out["valid"] is False
+        assert out["errors"][0]["missing"] == [1]
+
+    def test_checker_concurrent_writes_not_ordered(self):
+        # w2 invoked BEFORE w1 completed: no precedence, read may see
+        # either subset
+        h = [op("write", 1).replace(type="invoke"),
+             op("write", 2).replace(type="invoke"),
+             op("write", 1).replace(type="ok"),
+             op("write", 2).replace(type="ok"),
+             op("read", [2]).replace(type="ok")]
+        assert cr.comments_checker().check({}, h)["valid"] is True
+
+    def test_client_sql_shape(self):
+        t = dummy_test(**{"ssh": {"mode": "dummy", "dummy-responses": {
+            "SELECT id": "id\n3\n"}}})
+        with control.session_pool(t):
+            c = cr.CommentsClient().open(t, "n1")
+            got = c.invoke(t, op("write", independent.tuple_(7, 3)))
+            assert got.type == "ok"
+            cmds = logs(t)["n1"]
+            assert any("INSERT INTO comment_" in c_ and "(3, 7)" in c_
+                       for c_ in cmds)
+            rd = c.invoke(t, op("read", independent.tuple_(7, None)))
+            assert rd.type == "ok" and rd.value.key == 7
+            sel = next(c_ for c_ in logs(t)["n1"] if "UNION ALL" in c_)
+            assert "SERIALIZABLE" in sel
+            assert sel.count("SELECT id FROM comment_") == 10
+
+    def test_comments_test_map(self):
+        t = cr.comments_test({"time-limit": 1, "nodes": ["n1", "n2"]})
+        assert t["name"].startswith("cockroachdb-comments")
+        assert isinstance(t["client"], cr.CommentsClient)
+
+
+class TestGradualSkews:
+    def test_slew_invokes_adjtime_helper(self):
+        t = dummy_test()
+        with control.session_pool(t):
+            n = cr.gradual_skews()["client"].setup(t)
+            out = n.invoke(t, Op(type="info", f="start", value=None,
+                                 process="nemesis", time=0))
+            assert isinstance(out.value, dict) and out.value
+            cmds = [c for node in t["nodes"] for c in logs(t)[node]]
+            assert any("adj-time" in c and "g++" in c for c in cmds)
+            assert any("/opt/jepsen/adj-time" in c and "g++" not in c
+                       for c in cmds)
+
+    def test_registered_as_clock_nemesis(self):
+        m = cr.NEMESES["gradual-skews"]()
+        assert m["clocks"] is True
+        # nemesis_product refuses to pair two clock nemeses
+        pairs = cr.nemesis_product(["gradual-skews"], ["big-skews"])
+        assert pairs == []
+
+
+class TestPacketCapture:
+    def test_tcpdump_daemon_command(self):
+        t = dummy_test(**{"ssh": {"mode": "dummy", "dummy-responses": {
+            "SSH_CLIENT": "SSH_CLIENT=10.0.0.9 52311 22\n"}}})
+        with control.session_pool(t):
+            cr.packet_capture(t, "n1")
+            cmds = logs(t)["n1"]
+            cap = next(c for c in cmds if "tcpdump" in c)
+            assert "start-stop-daemon" in cap and "--background" in cap
+            assert "host 10.0.0.9" in cap
+            assert f"port {cr.DB_PORT}" in cap
+
+    def test_db_lifecycle_with_tcpdump(self):
+        t = dummy_test(**{"ssh": {"mode": "dummy", "dummy-responses": {
+            "SSH_CLIENT": "SSH_CLIENT=10.0.0.9 52311 22\n"}},
+            "tcpdump": True, "nodes": ["n1"]})
+        db = cr.CockroachDB()
+        assert cr.PCAPLOG in db.log_files(t, "n1")
+        with control.session_pool(t):
+            db.teardown(t, "n1")
+            assert any("killall" in c and "tcpdump" in c
+                       for c in logs(t)["n1"])

@@ -19,7 +19,8 @@ def op(f, v=None, p=0):
 ALL_SUITES = sorted([
     "etcd", "zookeeper", "consul", "disque", "raftis", "rabbitmq",
     "rabbitmq-mutex", "hazelcast", "cockroachdb", "cockroachdb-bank",
-    "cockroachdb-sets", "galera", "aerospike", "aerospike-counter",
+    "cockroachdb-sets", "cockroachdb-comments", "galera", "aerospike",
+    "aerospike-counter",
     "mongodb", "mongodb-transfer", "mongodb-rocks", "elasticsearch",
     "tidb", "percona", "mysql-cluster", "postgres-rds", "crate",
     "logcabin", "robustirc", "rethinkdb", "ravendb", "chronos",
