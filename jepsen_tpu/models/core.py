@@ -653,6 +653,159 @@ def _uqueue_describe(state, values):
     return "pending{" + ", ".join(parts) + "}"
 
 
+# --- FIFO queue: state = a 7-slot x 4-bit ring word -----------------------
+#
+# The strictly-ordered queue (model.clj:87-100) needs an ORDERED state, so
+# the word is a ring of 4-bit value ids filled from the bottom: nibble 0 is
+# the head, enqueue writes id at the first empty nibble, dequeue succeeds
+# only when nibble 0 equals the op's id and shifts the whole word down.
+# id 0 marks an empty slot, so live ids are 1..15; 7 slots keep the word in
+# 28 bits (the int32 sign bit stays clear, so >> is safe). Interval id
+# coloring (_fifo_remap) reuses ids across values with disjoint event
+# spans, and the maximum span overlap bounds queue depth along ANY search
+# path (a pending value's span contains the frontier's return instant), so
+# histories validated to depth <= 7 can never overflow the ring.
+
+FIFO_SLOTS = 7
+FIFO_MAX_IDS = 15
+
+
+def _fifo_step(state, f, v1, v2):
+    is_enq = f == F_ENQUEUE
+    is_deq = f == F_DEQUEUE
+    # per-nibble occupancy flags at bits 0,4,8,...: nibble nonzero
+    occ = (state | (state >> 1) | (state >> 2) | (state >> 3))
+    length = state * 0
+    for i in range(FIFO_SLOTS):
+        length = length + ((occ >> (4 * i)) & 1)
+    enq_ok = is_enq & (length < FIFO_SLOTS)
+    deq_ok = is_deq & (v1 > 0) & ((state & 15) == v1)
+    ok = enq_ok | deq_ok
+    # modulo keeps the shift < 28 even on full-ring rows (where enq_ok
+    # already masks the bogus result) so int32 never overflows
+    state_enq = state | (v1 << (4 * (length % FIFO_SLOTS) * is_enq))
+    state2 = (state_enq * enq_ok
+              + (state >> 4) * deq_ok
+              + state * (1 - enq_ok - deq_ok))
+    return state2, ok
+
+
+def _fifo_encode(f_code, f, inv_value, ok_value, intern):
+    val = (ok_value if (f_code == F_DEQUEUE and ok_value is not None)
+           else inv_value)
+    if val is None:
+        raise ValueError("fifo kernel: nil op value")
+    # unbounded interning; _fifo_remap interval-colors ids afterwards
+    return intern(val), NIL_ID
+
+
+def _fifo_pack_init(model, intern):
+    s = 0
+    if len(model.queue) > FIFO_SLOTS:
+        raise ValueError(
+            f"fifo kernel: more than {FIFO_SLOTS} initial elements")
+    for i, v in enumerate(model.queue):
+        if v is None:
+            raise ValueError("fifo kernel: nil initial value")
+        s |= (intern(v) + 1) << (4 * i)   # provisional; remap re-keys
+    return s
+
+
+def _fifo_remap(packed):
+    """Interval id coloring + depth validation for the FIFO ring.
+
+    Same span machinery as _uqueue_remap: a value is pending only while
+    the frontier's return instant lies inside its event span, so (a) two
+    values with disjoint spans may share a 4-bit id without a dequeue
+    ever matching the wrong value, and (b) the maximum number of
+    pairwise-overlapping spans bounds ring depth on every search path.
+    Raises ValueError (object-search fallback) when more than
+    FIFO_MAX_IDS values are simultaneously live or depth can exceed
+    FIFO_SLOTS. No sink rule: a never-dequeued value still occupies ring
+    order (it can block later dequeues), unlike the unordered queue."""
+    from jepsen_tpu.ops.encode import RET_INF as _INF
+    inf = int(_INF)
+    init = int(packed.init_state)
+    info = {}   # id -> [start, end, enq, deq]
+    init_ids = []
+    for i in range(FIFO_SLOTS):
+        nib = (init >> (4 * i)) & 15
+        if nib:
+            init_ids.append(nib - 1)        # provisional id from pack_init
+            rec = info.setdefault(nib - 1, [-1, -1, 0, 0])
+            rec[2] += 1                     # each instance occupies a slot
+    for j in range(packed.n):
+        v = int(packed.v1[j])
+        if v < 0:
+            continue
+        inv_e, ret_e = int(packed.inv[j]), int(packed.ret[j])
+        rec = info.setdefault(v, [inv_e, -1, 0, 0])
+        rec[0] = min(rec[0], inv_e)
+        rec[1] = max(rec[1], ret_e)
+        if int(packed.f[j]) == F_ENQUEUE:
+            rec[2] += 1
+        else:
+            rec[3] += 1
+    events = []
+    for v, rec in info.items():
+        if rec[2] > rec[3]:
+            rec[1] = inf                    # may stay pending forever
+        # depth-overlap events: each pending INSTANCE of the value
+        # contributes, bounded by its enqueue count (+1 if in init)
+        events.append((rec[0], rec[2]))
+        if rec[1] != inf:
+            events.append((rec[1], -rec[2]))
+    depth = cur = 0
+    for _, d in sorted(events):
+        cur += d
+        depth = max(depth, cur)
+    if depth > FIFO_SLOTS:
+        raise ValueError(
+            f"fifo kernel: queue depth can reach {depth} > {FIFO_SLOTS} "
+            f"ring slots")
+    id_of = {}
+    free_at = [-2] * FIFO_MAX_IDS
+    labels = {}
+    for v, rec in sorted(info.items(), key=lambda kv: kv[1][0]):
+        for s in range(FIFO_MAX_IDS):
+            if free_at[s] < rec[0]:
+                id_of[v] = s + 1            # ids are 1-based; 0 = empty
+                free_at[s] = rec[1]
+                val = (packed.value_table[v]
+                       if 0 <= v < len(packed.value_table) else v)
+                labels.setdefault(s + 1, []).append(repr(val))
+                break
+        else:
+            raise ValueError(
+                f"fifo kernel: more than {FIFO_MAX_IDS} simultaneously-"
+                f"live values")
+    for j in range(packed.n):
+        v = int(packed.v1[j])
+        if v >= 0:
+            packed.v1[j] = id_of[v]
+    new_init = 0
+    for i in range(FIFO_SLOTS):
+        nib = (init >> (4 * i)) & 15
+        if nib:
+            new_init |= id_of[nib - 1] << (4 * i)
+    packed.init_state = new_init
+    packed.value_table = [
+        "|".join(labels.get(i, [])) for i in range(FIFO_MAX_IDS + 1)]
+
+
+def _fifo_describe(state, values):
+    parts = []
+    s = int(state)
+    for i in range(FIFO_SLOTS):
+        nib = (s >> (4 * i)) & 15
+        if not nib:
+            break
+        label = (values[nib] if nib < len(values) and values[nib]
+                 else f"id{nib}")
+        parts.append(str(label))
+    return "queue[" + ", ".join(parts) + "]"
+
+
 CAS_REGISTER_KERNEL = KernelSpec(
     name="cas-register",
     init_state=NIL_ID,
@@ -708,10 +861,24 @@ UNORDERED_QUEUE_KERNEL = KernelSpec(
 )
 
 
+FIFO_QUEUE_KERNEL = KernelSpec(
+    name="fifo-queue",
+    init_state=0,
+    step=_fifo_step,
+    f_codes={"enqueue": F_ENQUEUE, "dequeue": F_DEQUEUE},
+    pack_init=_fifo_pack_init,
+    encode_op=_fifo_encode,
+    remap=_fifo_remap,
+    describe_state=_fifo_describe,
+)
+
+
 def kernel_spec_for(model: Model) -> Optional[KernelSpec]:
     """Return the integer KernelSpec for a model instance, or None if the
-    model's state does not fit the single-word encoding (FIFOQueue needs an
-    ordered state and uses the object search / fold checkers instead)."""
+    model's state does not fit the single-word encoding. Every reference
+    model family (model.clj) now has a device kernel; histories whose
+    shape exceeds a kernel's capacity (e.g. FIFO depth > 7) still fall
+    back per history via remap/validate ValueErrors."""
     if isinstance(model, CASRegister):
         return CAS_REGISTER_KERNEL
     if isinstance(model, Mutex):
@@ -722,4 +889,6 @@ def kernel_spec_for(model: Model) -> Optional[KernelSpec]:
         return SET_KERNEL
     if isinstance(model, UnorderedQueue):
         return UNORDERED_QUEUE_KERNEL
+    if isinstance(model, FIFOQueue):
+        return FIFO_QUEUE_KERNEL
     return None
