@@ -9,7 +9,13 @@ to indeterminate for non-idempotent ops (core.clj:402-441).
 
 The data plane is the ``aql`` CLI over the control plane (the reference
 uses the Java client; generation-checked writes are expressed with aql's
-generation predicates)."""
+generation predicates).
+
+The clustering behavior this suite probes is specified formally in
+``resources/aerospike_clustering.tla`` (counterpart of the reference's
+aerospike/spec/aerospike.tla) and exhaustively model-checked in Python
+by tests/test_aerospike_tla.py — including the bridge-partition
+dual-majority hazard that motivates the bridge nemesis."""
 
 from __future__ import annotations
 

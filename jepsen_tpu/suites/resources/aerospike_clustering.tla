@@ -1,0 +1,131 @@
+---------------------- MODULE aerospike_clustering ----------------------
+(***************************************************************************)
+(* Aerospike cluster formation under partitions — the model behind the    *)
+(* jepsen aerospike suite (jepsen_tpu/suites/aerospike.py).               *)
+(*                                                                        *)
+(* Counterpart of the reference's spec (aerospike/spec/aerospike.tla,     *)
+(* 154 lines), written independently for this rebuild: same subject —    *)
+(* roster-configured nodes forming cluster views from heartbeats over an *)
+(* unreliable network — with the properties the jepsen tests probe:      *)
+(*                                                                        *)
+(*   * views lag topology changes (the heartbeat-timeout window the      *)
+(*     nemesis schedule hammers) but reconcile to the reachable          *)
+(*     component, and                                                    *)
+(*   * disjoint current views never both claim a majority, BUT a        *)
+(*     bridge partition yields two OVERLAPPING current majority views   *)
+(*     — heartbeat reachability alone cannot prevent split-brain, which *)
+(*     is why aerospike layers succession/roster agreement on top and   *)
+(*     why the suite's bridge nemesis probes exactly that topology      *)
+(*     (lost writes there surface as linearizability violations in the  *)
+(*     CAS-register workload).                                          *)
+(*                                                                        *)
+(* Model-check:  tlc aerospike_clustering.tla  (cfg alongside).          *)
+(***************************************************************************)
+
+EXTENDS Naturals, FiniteSets
+
+CONSTANT Roster           \* configured node set, e.g. {n1, n2, n3, n4, n5}
+
+ASSUME Cardinality(Roster) >= 1
+
+VARIABLES
+  links,   \* symmetric reachability: set of {a, b} pairs currently up
+  view     \* view[n]: the set of nodes n currently believes are clustered
+
+vars == <<links, view>>
+
+---------------------------------------------------------------------------
+(* Helpers                                                                *)
+
+Pair(a, b) == {a, b}
+
+AllPairs == {p \in SUBSET Roster : Cardinality(p) = 2}
+
+Reachable(a, b) == a = b \/ Pair(a, b) \in links
+
+\* The cluster n can assemble from received heartbeats. One-hop
+\* reachability suffices: aerospike heartbeats are full-mesh, so a node
+\* clusters exactly with the peers it hears directly.
+Component(n) == {m \in Roster : Reachable(n, m)}
+
+Majority(s) == 2 * Cardinality(s) > Cardinality(Roster)
+
+Current(n) == view[n] = Component(n)
+
+---------------------------------------------------------------------------
+(* Initial state: fully connected, everyone sees the whole roster.        *)
+
+Init ==
+  /\ links = AllPairs
+  /\ view = [n \in Roster |-> Roster]
+
+---------------------------------------------------------------------------
+(* Actions                                                                *)
+
+\* The network partitions (or heals) one link. Views lag behind — they
+\* only change when the affected node's heartbeat timeout fires (Observe).
+Cut(a, b) ==
+  /\ a # b
+  /\ Pair(a, b) \in links
+  /\ links' = links \ {Pair(a, b)}
+  /\ UNCHANGED view
+
+Heal(a, b) ==
+  /\ a # b
+  /\ Pair(a, b) \notin links
+  /\ links' = links \cup {Pair(a, b)}
+  /\ UNCHANGED view
+
+\* Heartbeat timeout / arrival: node n reconciles its view with what it
+\* can actually reach right now.
+Observe(n) ==
+  /\ view' = [view EXCEPT ![n] = Component(n)]
+  /\ UNCHANGED links
+
+Next ==
+  \/ \E a \in Roster, b \in Roster : Cut(a, b)
+  \/ \E a \in Roster, b \in Roster : Heal(a, b)
+  \/ \E n \in Roster : Observe(n)
+
+Spec == Init /\ [][Next]_vars /\ \A n \in Roster : WF_vars(Observe(n))
+
+---------------------------------------------------------------------------
+(* Safety                                                                 *)
+
+TypeOK ==
+  /\ view \in [Roster -> SUBSET Roster]
+  /\ \A n \in Roster : n \in view[n]
+  /\ links \subseteq AllPairs
+
+\* Two nodes whose current views are DISJOINT never both hold roster
+\* majorities (immediate by counting). Note what this does NOT promise:
+\* under a BRIDGE partition (links a-c and b-c up, a-b cut — the
+\* jepsen bridge grudge, nemesis.clj:86-97 / jepsen_tpu.nemesis.bridge)
+\* the one-hop views Component(a) = {a,c} and Component(b) = {b,c} are
+\* both current, both majorities of a 3-roster, OVERLAPPING at the
+\* bridge node c. Exhaustive model checking of this module (see
+\* tests/test_aerospike_tla.py) finds that state — which is the point:
+\* heartbeat reachability alone cannot pick a unique master set, so
+\* aerospike must layer agreement (succession lists / rosters) on top,
+\* and the suite's bridge nemesis exists precisely to probe that layer.
+NoDisjointDualMajorities ==
+  \A a \in Roster, b \in Roster :
+    (a # b /\ Current(a) /\ Current(b)
+     /\ view[a] \cap view[b] = {})
+      => ~(Majority(view[a]) /\ Majority(view[b]))
+
+\* A current view never contains an unreachable node (acknowledging
+\* writes to a replica your heartbeats cannot see is how replication
+\* silently degrades).
+CurrentViewsAreReachable ==
+  \A n \in Roster :
+    Current(n) => \A m \in view[n] : Reachable(n, m)
+
+\* Liveness: with fair observation, every node's view converges once the
+\* topology stops changing (checked as a temporal property).
+EventuallyCurrent == \A n \in Roster : []<>Current(n)
+
+Invariants == TypeOK /\ NoDisjointDualMajorities
+                     /\ CurrentViewsAreReachable
+
+===========================================================================

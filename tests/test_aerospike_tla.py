@@ -1,0 +1,150 @@
+"""Exhaustive model-check of the aerospike clustering spec.
+
+TLC isn't in this image, so this mirrors the transition system of
+jepsen_tpu/suites/resources/aerospike_clustering.tla in Python and
+BFS-explores the ENTIRE reachable state space for small rosters,
+checking the spec's invariants in every state. The spec file is also
+parsed for structural drift (constants/actions/invariants present)."""
+
+import itertools
+import os
+
+SPEC = os.path.join(os.path.dirname(__file__), "..", "jepsen_tpu",
+                    "suites", "resources", "aerospike_clustering.tla")
+
+
+def all_pairs(roster):
+    return frozenset(frozenset(p) for p in itertools.combinations(roster, 2))
+
+
+def reachable(links, a, b):
+    return a == b or frozenset((a, b)) in links
+
+
+def component(links, roster, n):
+    return frozenset(m for m in roster if reachable(links, n, m))
+
+
+def majority(s, roster):
+    return 2 * len(s) > len(roster)
+
+
+def explore(roster):
+    """BFS the full reachable state space: states are (links, views)."""
+    init = (all_pairs(roster),
+            tuple(frozenset(roster) for _ in roster))
+    nodes = sorted(roster)
+    idx = {n: i for i, n in enumerate(nodes)}
+    seen = {init}
+    frontier = [init]
+    while frontier:
+        links, views = frontier.pop()
+        yield links, views, nodes, idx
+        succs = []
+        # Cut / Heal every pair
+        for p in all_pairs(roster):
+            if p in links:
+                succs.append((links - {p}, views))
+            else:
+                succs.append((links | {p}, views))
+        # Observe every node
+        for n in nodes:
+            v2 = list(views)
+            v2[idx[n]] = component(links, roster, n)
+            succs.append((links, tuple(v2)))
+        for s in succs:
+            if s not in seen:
+                seen.add(s)
+                frontier.append(s)
+
+
+def check_invariants(roster):
+    checked = 0
+    for links, views, nodes, idx in explore(roster):
+        checked += 1
+        for n in nodes:
+            v = views[idx[n]]
+            # TypeOK
+            assert n in v and v <= frozenset(roster)
+            current = v == component(links, roster, n)
+            # CurrentViewsAreReachable
+            if current:
+                assert all(reachable(links, n, m) for m in v), \
+                    (links, views, n)
+        # NoDisjointDualMajorities
+        for a in nodes:
+            for b in nodes:
+                if a == b:
+                    continue
+                va, vb = views[idx[a]], views[idx[b]]
+                if (va == component(links, roster, a)
+                        and vb == component(links, roster, b)
+                        and not (va & vb)):
+                    assert not (majority(va, roster)
+                                and majority(vb, roster)), \
+                        (links, views, a, b)
+    return checked
+
+
+def find_bridge_dual_majority(roster):
+    """The model-checked NEGATIVE result: a reachable state where two
+    CURRENT, OVERLAPPING views both claim a roster majority."""
+    for links, views, nodes, idx in explore(roster):
+        for a in nodes:
+            for b in nodes:
+                if a == b or reachable(links, a, b):
+                    continue
+                va, vb = views[idx[a]], views[idx[b]]
+                if (va == component(links, roster, a)
+                        and vb == component(links, roster, b)
+                        and majority(va, roster)
+                        and majority(vb, roster)):
+                    return links, va, vb
+    return None
+
+
+class TestClusteringModel:
+    def test_three_node_roster_exhaustive(self):
+        n = check_invariants(["a", "b", "c"])
+        # 2^3 link states x (views reachable) — must be a real space
+        assert n > 100
+
+    def test_four_node_roster_exhaustive(self):
+        n = check_invariants(["a", "b", "c", "d"])
+        assert n > 5000
+
+    def test_bridge_partition_admits_dual_majorities(self):
+        # The spec's documented hazard: under the jepsen bridge topology
+        # two mutually-unreachable nodes hold CURRENT majority views
+        # overlapping at the bridge node — heartbeat reachability alone
+        # cannot prevent split-brain (hence succession agreement, hence
+        # the suite's bridge nemesis).
+        hit = find_bridge_dual_majority(["a", "b", "c"])
+        assert hit is not None
+        links, va, vb = hit
+        assert va & vb                      # overlap: the bridge node
+
+    def test_stale_views_can_claim_dual_majorities(self):
+        # The bug window the spec deliberately permits (and the nemesis
+        # schedule hammers): immediately after a cut, BOTH sides' stale
+        # views still claim a full-roster majority. The invariant only
+        # binds CURRENT views — this documents why the lag matters.
+        roster = ["a", "b", "c"]
+        links = all_pairs(roster) - {frozenset(("a", "b"))}
+        stale = frozenset(roster)
+        assert majority(stale, roster)
+        assert not reachable(links, "a", "b")
+        # both a and b hold the stale full view: dual majority, allowed
+        # only because neither is current
+        assert stale != component(links, roster, "a")
+
+    def test_spec_file_structure(self):
+        src = open(SPEC).read()
+        for needle in ("MODULE aerospike_clustering", "CONSTANT Roster",
+                       "Cut(a, b)", "Heal(a, b)", "Observe(n)",
+                       "NoDisjointDualMajorities",
+                       "CurrentViewsAreReachable", "EventuallyCurrent"):
+            assert needle in src, needle
+        cfg = open(SPEC.replace(".tla", ".cfg")).read()
+        assert "INVARIANT Invariants" in cfg
+        assert "Roster = {n1, n2, n3, n4, n5}" in cfg
