@@ -392,56 +392,175 @@ def _noop_step(state, f, v1, v2):
 # --- grow-only set: state = presence bitmask over <= 31 interned ids -------
 #
 # add's v1 is the element's bit POSITION; read's v1 is the whole read set as
-# a bitMASK (or NIL_ID for a don't-care read), so consistency is one integer
-# compare. Both encodings are produced by _set_encode below.
+# a full target WORD (or NIL_ID for a don't-care read), so consistency is
+# one integer compare. _set_remap compresses elements into the word by
+# READ-SIGNATURE CLASSES: elements contained in exactly the same reads
+# are interchangeable, so a class needs only a COUNT field (how many of
+# its members are in the set), and a read's exact-set constraint becomes
+# state == target where target holds each class's full count iff the
+# class is inside the read. Hundreds of unique added elements with a
+# handful of reads (the realistic sets workload, e.g. cockroach
+# sets.clj) collapse to a few count fields. Elements added more than
+# once (or both initial and re-added) are idempotent and get individual
+# OR-bits instead (v2 flags the mode per add op).
 
-SET_MAX_IDS = 31  # ids 0..30: bitmask stays positive in int32
+SET_MAX_IDS = 31          # state bits 0..30: the word stays positive
+SET_IMPOSSIBLE_BIT = 30   # reserved: reads of never-added elements
 
 
 def _set_step(state, f, v1, v2):
     is_add = f == F_ADD
     is_read = f == F_READ
-    sh = v1 * (v1 >= 0)           # NIL (-1) -> harmless shift of 0
-    bit = (state * 0 + 1) << sh   # 1 in state's dtype/shape
     read_ok = (v1 == NIL_ID) | (state == v1)
     ok = is_add | (is_read & read_ok)
-    state2 = state | (bit * is_add)
+    # add rows carry a UNIT word in v1 (a class-count increment or an
+    # idempotent bit); v2 == 1 selects count mode (+), else OR mode
+    unit = v1 * is_add * (v1 >= 0)
+    plus = is_add & (v2 == 1)
+    state2 = (state + unit) * plus + (state | unit) * (1 - plus)
     return state2, ok
 
 
 def _set_encode(f_code, f, inv_value, ok_value, intern):
     if f_code == F_ADD:
         if inv_value is None:
-            # NIL_ID would alias bit 0 (the first interned element)
             raise ValueError("set kernel: nil add value")
-        i = intern(inv_value)
-        if i >= SET_MAX_IDS:
-            raise ValueError(
-                f"set kernel: more than {SET_MAX_IDS} distinct elements")
-        return i, NIL_ID
-    # read: completion value (the observed set) wins; encode as bitmask
+        # unbounded interning; _set_remap builds the word layout
+        return intern(inv_value), NIL_ID
+    # read: completion value (the observed set) wins; intern the whole
+    # OBSERVED SET as one table entry for the remap to compile
     val = ok_value if ok_value is not None else inv_value
     if val is None:
         return NIL_ID, NIL_ID
-    m = 0
-    for e in val:
-        i = intern(e)
-        if i >= SET_MAX_IDS:
-            raise ValueError(
-                f"set kernel: more than {SET_MAX_IDS} distinct elements")
-        m |= 1 << i
-    return m, NIL_ID
+    return intern(tuple(sorted(map(repr, val)))), NIL_ID
 
 
 def _set_pack_init(model, intern):
+    # provisional bitmask over init-element ids (interned first, so ids
+    # are 0..k-1); _set_remap re-keys it into the field layout
     m = 0
-    for e in model.items:
-        i = intern(e)
-        if i >= SET_MAX_IDS:
+    for i, e in enumerate(sorted(model.items, key=repr)):
+        if intern(e) >= SET_MAX_IDS:
             raise ValueError(
-                f"set kernel: more than {SET_MAX_IDS} distinct elements")
+                f"set kernel: more than {SET_MAX_IDS} initial elements")
         m |= 1 << i
     return m
+
+
+def _set_remap(packed):
+    """Compile element ids into the read-signature-class word layout.
+
+    Soundness: two elements whose membership agrees on EVERY observed
+    read are interchangeable — no constraint in the history can tell
+    them apart — so only the count of a class's added members matters,
+    and since every add op (and init member) contributes exactly once
+    (duplicate-added elements are exiled to idempotent OR-bits), a count
+    field of width ceil(log2(|class|+1)) can never overflow. A read
+    containing an element that is never added (and not initial) can
+    never be satisfied: its target carries the reserved impossible bit
+    no add can set. Raises ValueError when the layout exceeds the 31-bit
+    word (the caller falls back to the object search)."""
+    from collections import defaultdict
+
+    def key(v):
+        return v if isinstance(v, (int, str, bool, float, tuple)) else \
+            repr(v)
+
+    init = int(packed.init_state)
+    table = packed.value_table
+    # element-id universe: init members (ids 0..k-1) + add-row ids
+    add_rows = defaultdict(list)      # elem id -> row indices
+    read_rows = []                    # (row, set-of-element-keys)
+    for j in range(packed.n):
+        v = int(packed.v1[j])
+        if v < 0:
+            continue
+        if int(packed.f[j]) == F_ADD:
+            add_rows[v].append(j)
+        else:
+            obs = table[v]            # tuple of sorted reprs
+            read_rows.append((j, frozenset(obs)))
+    init_ids = [i for i in range(SET_MAX_IDS) if (init >> i) & 1]
+    elems = sorted(set(add_rows) | set(init_ids))
+    # signature: which reads contain the element (membership by repr,
+    # matching the read-set encoding above)
+    sig = {}
+    for e in elems:
+        ek = repr(table[e]) if e < len(table) else repr(e)
+        sig[e] = frozenset(j for j, obs in read_rows if ek in obs)
+    # OR-tier: idempotent re-adds (multiple add ops, or init + add)
+    or_tier = [e for e in elems
+               if len(add_rows.get(e, ())) + (e in init_ids) > 1]
+    count_classes = defaultdict(list)
+    for e in elems:
+        if e in or_tier:
+            continue
+        count_classes[sig[e]].append(e)
+    # layout: count fields first, then OR bits; bit 30 reserved
+    layout = {}                       # elem id -> (offset, width, mode)
+    fields = []                       # (offset, mask, label, members)
+    off = 0
+    class_off = {}
+    for s, members in sorted(count_classes.items(),
+                             key=lambda kv: sorted(kv[1])):
+        width = max(1, (len(members)).bit_length())
+        class_off[s] = (off, width)
+        for e in members:
+            layout[e] = (off, width, 1)
+        fields.append((off, (1 << width) - 1,
+                       "|".join(str(table[e]) if e < len(table) else
+                                str(e) for e in sorted(members))))
+        off += width
+    for e in or_tier:
+        layout[e] = (off, 1, 0)
+        fields.append((off, 1, str(table[e]) if e < len(table)
+                       else str(e)))
+        off += 1
+    if off > SET_IMPOSSIBLE_BIT:
+        raise ValueError(
+            f"set kernel: field layout needs {off} bits > "
+            f"{SET_IMPOSSIBLE_BIT} available")
+    # rewrite add rows: v1 = unit word, v2 = mode
+    for e, rows in add_rows.items():
+        o, w, mode = layout[e]
+        for j in rows:
+            packed.v1[j] = 1 << o
+            packed.v2[j] = mode
+    # rewrite read rows: v1 = exact target word
+    elem_by_key = {}
+    for e in elems:
+        elem_by_key[repr(table[e]) if e < len(table) else repr(e)] = e
+    for j, obs in read_rows:
+        target = 0
+        impossible = False
+        seen_classes = set()
+        for ek in obs:
+            e = elem_by_key.get(ek)
+            if e is None:
+                impossible = True     # read of a never-added element
+                continue
+            o, w, mode = layout[e]
+            if mode == 1:
+                seen_classes.add((o, w))
+            else:
+                target |= 1 << o
+        for (o, w) in seen_classes:
+            members = [x for x, (xo, xw, xm) in layout.items()
+                       if xo == o and xm == 1]
+            target |= len(members) << o
+        if impossible:
+            target |= 1 << SET_IMPOSSIBLE_BIT
+        packed.v1[j] = target
+    # rebuild init state in the field layout
+    new_init = 0
+    for e in init_ids:
+        o, w, mode = layout[e]
+        if mode == 1:
+            new_init += 1 << o
+        else:
+            new_init |= 1 << o
+    packed.init_state = new_init
+    packed.value_table = fields
 
 
 # --- unordered queue: state = packed per-value pending counts --------------
@@ -635,9 +754,18 @@ def _mutex_describe(state, values):
 
 
 def _set_describe(state, values):
-    elems = [repr(values[i]) if i < len(values) else str(i)
-             for i in range(SET_MAX_IDS) if (state >> i) & 1]
-    return "{" + ", ".join(elems) + "}"
+    # after _set_remap, value_table holds (offset, mask, label) fields
+    parts = []
+    for entry in values:
+        if not (isinstance(entry, tuple) and len(entry) == 3):
+            return f"state={int(state):#x}"
+        off, mask, label = entry
+        c = (int(state) >> off) & mask
+        if c:
+            full = bin(mask).count("1") == 1 or c == mask
+            parts.append(f"{label}" if mask == 1
+                         else f"{label}:{c}/{mask}")
+    return "{" + ", ".join(parts) + "}"
 
 
 def _uqueue_describe(state, values):
@@ -842,6 +970,7 @@ SET_KERNEL = KernelSpec(
     f_codes={"add": F_ADD, "read": F_READ},
     pack_init=_set_pack_init,
     encode_op=_set_encode,
+    remap=_set_remap,
     readonly=lambda f, v1, v2: f == F_READ,
     describe_state=_set_describe,
 )

@@ -344,10 +344,50 @@ class TestSetKernel:
         # hide behind UNKNOWN
         assert decided > 100 and valid and invalid
 
-    def test_too_many_elements_falls_back(self):
+    def test_many_unread_elements_ride_device(self):
+        # 40 adds with no read used to overflow the 31-bit mask; read-
+        # signature classes collapse them into ONE count field
         rows = []
         for v in range(40):
             rows += [(0, "invoke", "add", v), (0, "ok", "add", v)]
+        h = H(*rows)
+        r = check_history_tpu(h, SetModel())
+        assert r is not None and r["valid"] is True
+        assert r["backend"] == "tpu"
+
+    def test_hundreds_of_adds_with_final_read(self):
+        # the realistic sets workload (cockroach sets.clj / disque):
+        # unique adds, one crashed, one final exact read
+        rows = []
+        for v in range(200):
+            rows += [(v % 5, "invoke", "add", v), (v % 5, "ok", "add", v)]
+        rows += [(9, "invoke", "add", 999), (9, "info", "add", 999)]
+        final = sorted(range(200))          # crashed add not observed
+        rows += [(6, "invoke", "read", None), (6, "ok", "read", final)]
+        h = H(*rows)
+        r = check_history_tpu(h, SetModel())
+        assert r is not None and r["valid"] is True
+        assert r["backend"] == "tpu"
+        # lost update: drop element 77 from the read -> refuted on device
+        bad = sorted(v for v in range(200) if v != 77)
+        rows[-1] = (6, "ok", "read", bad)
+        r2 = check_history_tpu(H(*rows), SetModel())
+        assert r2 is not None and r2["valid"] is False
+
+    def test_read_of_never_added_element_refuted(self):
+        h = H((0, "invoke", "add", 1), (0, "ok", "add", 1),
+              (1, "invoke", "read", None), (1, "ok", "read", [1, 999]))
+        r = check_history_tpu(h, SetModel())
+        assert r is not None and r["valid"] is False
+
+    def test_distinct_signatures_overflow_falls_back(self):
+        # 35 adds each followed by a prefix read: every element gets a
+        # distinct read signature -> 35 classes -> > 31 bits -> fallback
+        rows = []
+        for v in range(35):
+            rows += [(0, "invoke", "add", v), (0, "ok", "add", v),
+                     (1, "invoke", "read", None),
+                     (1, "ok", "read", sorted(range(v + 1)))]
         h = H(*rows)
         assert check_history_tpu(h, SetModel()) is None
         # facade still answers via the object search
