@@ -29,6 +29,11 @@ SUITES = {
     "cockroachdb-bank": ("cockroachdb", "bank_test"),
     "cockroachdb-sets": ("cockroachdb", "sets_test"),
     "cockroachdb-comments": ("cockroachdb", "comments_test"),
+    "cockroachdb-monotonic": ("cockroachdb", "monotonic_test"),
+    "cockroachdb-sequential": ("cockroachdb", "sequential_test"),
+    "cockroachdb-g2": ("cockroachdb", "g2_test"),
+    "cockroachdb-bank-multitable": ("cockroachdb",
+                                    "bank_multitable_test"),
     "galera": ("galera", "dirty_reads_test"),
     "aerospike": ("aerospike", "cas_register_test"),
     "aerospike-counter": ("aerospike", "counter_test"),

@@ -823,6 +823,157 @@ def sets_test(opts: dict) -> dict:
     })
 
 
+class MonotonicSQLClient(SQLClient):
+    """Monotonic timestamped inserts (monotonic.clj): each add reads the
+    current max val and inserts val+1 with the cluster's logical
+    timestamp, atomically in one statement; the checker demands value
+    order and timestamp order agree with no lost/duplicate/revived
+    rows."""
+
+    def setup(self, test):
+        sql(test, test["nodes"][0],
+            "CREATE TABLE IF NOT EXISTS mono (val INT, sts DECIMAL, "
+            "node INT, process INT, tb INT)")
+
+    def _invoke(self, test, op):
+        if op.f == "add":
+            node_i = test["nodes"].index(self.node) \
+                if self.node in test.get("nodes", []) else 0
+            rows = sql(
+                test, self.node,
+                f"INSERT INTO mono (val, sts, node, process, tb) "
+                f"SELECT COALESCE(MAX(val), -1) + 1, "
+                f"cluster_logical_timestamp(), {node_i}, "
+                f"{int(op.process) if op.process != 'nemesis' else -1}, 0 "
+                f"FROM mono RETURNING val")
+            return op.replace(type="ok",
+                              value=int(rows[0][0]) if rows else None)
+        if op.f == "read":
+            rows = sql(test, self.node,
+                       "SELECT val, sts, node, process, tb FROM mono "
+                       "ORDER BY sts")
+            out = [{"val": int(r[0]), "sts": r[1], "node": r[2],
+                    "proc": r[3], "tb": int(r[4])} for r in rows]
+            return op.replace(type="ok", value=out)
+        raise ValueError(f"unknown op {op.f!r}")
+
+
+class SequentialSQLClient(SQLClient):
+    """Sequential-consistency probe (sequential.clj:52-95): writes insert
+    a key's subkeys IN ORDER, each in its own transaction; reads probe
+    them in REVERSE, so any reader seeing subkey i without i-1 (a
+    trailing nil after a value) witnesses a sequential violation."""
+
+    def setup(self, test):
+        sql(test, test["nodes"][0],
+            "CREATE TABLE IF NOT EXISTS seq (tkey STRING PRIMARY KEY)")
+
+    def _invoke(self, test, op):
+        key_count = test.get("key-count", 5)
+        ks = wl.subkeys(key_count, op.value if op.f == "write"
+                        else op.value[0] if isinstance(op.value, tuple)
+                        else op.value)
+        if op.f == "write":
+            for k in ks:       # separate txns, in order
+                sql(test, self.node,
+                    f"INSERT INTO seq (tkey) VALUES ('{k}') "
+                    f"ON CONFLICT (tkey) DO NOTHING")
+            return op.replace(type="ok")
+        if op.f == "read":
+            vals = []
+            for k in reversed(ks):
+                rows = sql(test, self.node,
+                           f"SELECT tkey FROM seq WHERE tkey = '{k}'")
+                vals.append(k if rows else None)
+            return op.replace(type="ok", value=(op.value, vals))
+        raise ValueError(f"unknown op {op.f!r}")
+
+
+class G2SQLClient(SQLClient):
+    """Anti-dependency-cycle probe (adya.clj:31-43 / cockroach g2): the
+    predicate read + guarded insert run as ONE atomic statement, so
+    under SERIALIZABLE at most one of a key's paired inserts can
+    succeed; two successes for one key is the G2 phenomenon."""
+
+    def setup(self, test):
+        node = test["nodes"][0]
+        for t in ("a", "b"):
+            sql(test, node,
+                f"CREATE TABLE IF NOT EXISTS {t} "
+                f"(id INT PRIMARY KEY, key INT, value INT)")
+
+    def _invoke(self, test, op):
+        if op.f != "insert":
+            raise ValueError(f"unknown op {op.f!r}")
+        k = op.value.key
+        a_id, b_id = op.value.value
+        table = "a" if a_id is not None else "b"
+        row_id = a_id if a_id is not None else b_id
+        rows = sql(
+            test, self.node,
+            f"INSERT INTO {table} (id, key, value) "
+            f"SELECT {int(row_id)}, {int(k)}, 30 "
+            f"WHERE NOT EXISTS (SELECT 1 FROM a WHERE key = {int(k)} "
+            f"AND value % 3 = 0) "
+            f"AND NOT EXISTS (SELECT 1 FROM b WHERE key = {int(k)} "
+            f"AND value % 3 = 0) RETURNING id")
+        return op.replace(type="ok" if rows else "fail")
+
+
+class BankMultitableClient(SQLClient):
+    """Bank with each account in its OWN table (bank-multitable:
+    cross-table transactions stress distributed txn paths the
+    single-table bank never touches)."""
+
+    def __init__(self, n: int = 5, starting: int = 10):
+        super().__init__()
+        self.n = n
+        self.starting = starting
+
+    def open(self, test, node):
+        c = BankMultitableClient(self.n, self.starting)
+        c.node = node
+        return c
+
+    def setup(self, test):
+        node = test["nodes"][0]
+        for i in range(self.n):
+            sql(test, node,
+                f"CREATE TABLE IF NOT EXISTS accounts_{i} "
+                f"(id INT PRIMARY KEY, balance BIGINT)")
+            sql(test, node,
+                f"UPSERT INTO accounts_{i} VALUES (0, {self.starting})")
+
+    def _invoke(self, test, op):
+        if op.f == "read":
+            selects = " UNION ALL ".join(
+                f"SELECT {i} AS acct, balance FROM accounts_{i}"
+                for i in range(self.n))
+            rows = sql(test, self.node,
+                       f"SELECT balance FROM ({selects}) ORDER BY acct")
+            return op.replace(type="ok", value=[int(r[0]) for r in rows])
+        if op.f == "transfer":
+            v = op.value
+            frm, to, amt = int(v["from"]), int(v["to"]), int(v["amount"])
+            if frm == to:
+                rows = sql(test, self.node,
+                           f"UPDATE accounts_{frm} SET balance = balance "
+                           f"WHERE balance >= {amt} RETURNING id")
+            else:
+                # debit CTE gates the credit: overdraw debits nothing, so
+                # the credit's EXISTS guard fails -> 0 rows -> determinate
+                # fail, atomically in one statement
+                rows = sql(
+                    test, self.node,
+                    f"WITH d AS (UPDATE accounts_{frm} SET balance = "
+                    f"balance - {amt} WHERE balance >= {amt} "
+                    f"RETURNING 1) "
+                    f"UPDATE accounts_{to} SET balance = balance + {amt} "
+                    f"WHERE EXISTS (SELECT 1 FROM d) RETURNING id")
+            return op.replace(type="ok" if rows else "fail")
+        raise ValueError(f"unknown op {op.f!r}")
+
+
 def comments_test(opts: dict) -> dict:
     """comments.clj test: per-key mix of blind writes (globally unique
     ids) and transactional cross-table reads, checked per key."""
@@ -852,11 +1003,87 @@ def comments_test(opts: dict) -> dict:
     })
 
 
+def monotonic_test(opts: dict) -> dict:
+    return basic_test({
+        **opts,
+        "name": "monotonic",
+        "client": {
+            "client": MonotonicSQLClient(),
+            "during": gen.stagger(
+                1 / 10, lambda t, p: {"type": "invoke", "f": "add",
+                                      "value": None}),
+            "final": gen.once({"f": "read", "value": None}),
+        },
+        "checker": compose({
+            "perf": perf(),
+            "monotonic": wl.monotonic_checker(),
+        }),
+    })
+
+
+def sequential_test(opts: dict) -> dict:
+    key_count = opts.get("key-count", 5)
+    return basic_test({
+        **opts,
+        "name": "sequential",
+        "key-count": key_count,
+        "client": {
+            "client": SequentialSQLClient(),
+            "during": gen.stagger(1 / 10,
+                                  wl.sequential_gen(opts.get("writers", 2))),
+            "final": None,
+        },
+        "checker": compose({
+            "perf": perf(),
+            "sequential": wl.SequentialChecker(),
+        }),
+    })
+
+
+def g2_test(opts: dict) -> dict:
+    return basic_test({
+        **opts,
+        "name": "g2",
+        "client": {
+            "client": G2SQLClient(),
+            "during": wl.g2_gen(),
+            "final": None,
+        },
+        "checker": compose({
+            "perf": perf(),
+            "g2": wl.g2_checker(),
+        }),
+    })
+
+
+def bank_multitable_test(opts: dict) -> dict:
+    n = opts.get("accounts", 5)
+    starting = opts.get("starting-balance", 10)
+    return basic_test({
+        **opts,
+        "name": "bank-multitable",
+        "client": {
+            "client": BankMultitableClient(n, starting),
+            "during": gen.stagger(
+                1 / 10, gen.mix([wl.bank_read, wl.bank_diff_transfer(n)])),
+            "final": gen.once({"f": "read", "value": None}),
+        },
+        "checker": compose({
+            "perf": perf(),
+            "bank": wl.bank_checker(n, n * starting),
+        }),
+    })
+
+
 TESTS: Dict[str, Callable[[dict], dict]] = {
     "register": register_test,
     "bank": bank_test,
+    "bank-multitable": bank_multitable_test,
     "sets": sets_test,
     "comments": comments_test,
+    "monotonic": monotonic_test,
+    "sequential": sequential_test,
+    "g2": g2_test,
 }
 
 

@@ -290,3 +290,106 @@ class TestPacketCapture:
             db.teardown(t, "n1")
             assert any("killall" in c and "tcpdump" in c
                        for c in logs(t)["n1"])
+
+
+class TestMonotonicSQL:
+    def test_add_and_read_shapes(self):
+        t = dummy_test(**{"nodes": ["n1", "n2"], "ssh": {
+            "mode": "dummy", "dummy-responses": {
+                "INSERT INTO mono": "val\n4\n",
+                "SELECT val, sts": "val\tsts\tnode\tprocess\ttb\n"
+                                   "0\t1.0\t0\t0\t0\n1\t2.0\t1\t1\t0\n"}}})
+        with control.session_pool(t):
+            c = cr.MonotonicSQLClient().open(t, "n1")
+            got = c.invoke(t, op("add", None))
+            assert got.type == "ok" and got.value == 4
+            stmt = next(s for s in logs(t)["n1"] if "INSERT INTO mono" in s)
+            assert "cluster_logical_timestamp()" in stmt
+            assert "COALESCE(MAX(val), -1) + 1" in stmt
+            rd = c.invoke(t, op("read", None))
+            assert rd.value[0]["val"] == 0 and rd.value[1]["proc"] == "1"
+
+    def test_monotonic_checker_catches_skew(self):
+        # value order disagrees with timestamp order
+        rows = [{"val": 0, "sts": 2, "node": 0, "proc": 0, "tb": 0},
+                {"val": 1, "sts": 1, "node": 0, "proc": 0, "tb": 0}]
+        h = [op("read", None).replace(type="ok",
+                                      value=sorted(rows,
+                                                   key=lambda r: r["sts"]))]
+        from jepsen_tpu.suites import workloads as wl
+        out = wl.monotonic_checker().check({}, h)
+        assert out["valid"] is False
+
+
+class TestSequentialSQL:
+    def test_writes_in_order_reads_reversed(self):
+        t = dummy_test(**{"key-count": 3, "ssh": {
+            "mode": "dummy", "dummy-responses": {"SELECT tkey": ""}}})
+        with control.session_pool(t):
+            c = cr.SequentialSQLClient().open(t, "n1")
+            assert c.invoke(t, op("write", 7)).type == "ok"
+            writes = [s for s in logs(t)["n1"] if "INSERT INTO seq" in s]
+            assert ["'7_0'" in writes[0], "'7_1'" in writes[1],
+                    "'7_2'" in writes[2]] == [True, True, True]
+            rd = c.invoke(t, op("read", 7))
+            assert rd.value == (7, [None, None, None])
+
+
+class TestG2SQL:
+    def test_predicate_guarded_insert(self):
+        t = dummy_test(**{"ssh": {"mode": "dummy", "dummy-responses": {
+            "INSERT INTO a": "id\n5\n"}}})
+        with control.session_pool(t):
+            c = cr.G2SQLClient().open(t, "n1")
+            o = op("insert", independent.tuple_(3, (5, None)))
+            got = c.invoke(t, o)
+            assert got.type == "ok"
+            stmt = next(s for s in logs(t)["n1"] if "INSERT INTO a" in s)
+            assert "NOT EXISTS (SELECT 1 FROM a WHERE key = 3" in stmt
+            assert "NOT EXISTS (SELECT 1 FROM b WHERE key = 3" in stmt
+        t2 = dummy_test(**{"ssh": {"mode": "dummy", "dummy-responses": {
+            "INSERT INTO b": ""}}})
+        with control.session_pool(t2):
+            c = cr.G2SQLClient().open(t2, "n1")
+            o = op("insert", independent.tuple_(3, (None, 6)))
+            assert c.invoke(t2, o).type == "fail"  # predicate matched
+
+
+class TestBankMultitable:
+    def test_cross_table_transfer_gated_by_debit(self):
+        t = dummy_test(**{"ssh": {"mode": "dummy", "dummy-responses": {
+            "WITH d AS": "id\n0\n"}}})
+        with control.session_pool(t):
+            c = cr.BankMultitableClient(3, 10).open(t, "n1")
+            got = c.invoke(t, op("transfer",
+                                 {"from": 0, "to": 2, "amount": 4}))
+            assert got.type == "ok"
+            stmt = next(s for s in logs(t)["n1"] if "WITH d AS" in s)
+            assert "UPDATE accounts_0" in stmt and \
+                "UPDATE accounts_2" in stmt
+            assert "balance >= 4" in stmt
+        t2 = dummy_test(**{"ssh": {"mode": "dummy", "dummy-responses": {
+            "WITH d AS": ""}}})
+        with control.session_pool(t2):
+            c = cr.BankMultitableClient(3, 10).open(t2, "n1")
+            assert c.invoke(t2, op("transfer",
+                                   {"from": 0, "to": 2,
+                                    "amount": 99})).type == "fail"
+
+    def test_read_unions_tables(self):
+        t = dummy_test(**{"ssh": {"mode": "dummy", "dummy-responses": {
+            "UNION ALL": "balance\n10\n10\n10\n"}}})
+        with control.session_pool(t):
+            c = cr.BankMultitableClient(3, 10).open(t, "n1")
+            assert c.invoke(t, op("read", None)).value == [10, 10, 10]
+
+
+class TestUbuntuOS:
+    def test_setup_package_set_and_ntp_stop(self):
+        from jepsen_tpu.os import ubuntu
+        t = dummy_test()
+        with control.session_pool(t):
+            ubuntu.os().setup(t, "n1")
+            cmds = logs(t)["n1"]
+            assert any("tcpdump" in c and "apt-get" in c for c in cmds)
+            assert any("service ntp stop" in c for c in cmds)

@@ -19,7 +19,9 @@ def op(f, v=None, p=0):
 ALL_SUITES = sorted([
     "etcd", "zookeeper", "consul", "disque", "raftis", "rabbitmq",
     "rabbitmq-mutex", "hazelcast", "cockroachdb", "cockroachdb-bank",
-    "cockroachdb-sets", "cockroachdb-comments", "galera", "aerospike",
+    "cockroachdb-sets", "cockroachdb-comments", "cockroachdb-monotonic",
+    "cockroachdb-sequential", "cockroachdb-g2",
+    "cockroachdb-bank-multitable", "galera", "aerospike",
     "aerospike-counter",
     "mongodb", "mongodb-transfer", "mongodb-rocks", "elasticsearch",
     "tidb", "percona", "mysql-cluster", "postgres-rds", "crate",
