@@ -161,6 +161,43 @@ def _secondary_metrics():
     print(f"# secondary: 2k-op history: {r['valid']} in "
           f"{_t.time()-t0:.2f}s (incl. compile)", file=sys.stderr)
 
+    # config 6: non-register model families on the device path
+    from jepsen_tpu.history import History, Op
+    from jepsen_tpu.models import SetModel, UnorderedQueue
+
+    rows = []
+    for v in range(300):
+        rows.append(Op(type="invoke", f="add", value=v, process=v % 5,
+                       time=2 * v))
+        rows.append(Op(type="ok", f="add", value=v, process=v % 5,
+                       time=2 * v + 1))
+    rows.append(Op(type="invoke", f="read", value=None, process=9,
+                   time=10_000))
+    rows.append(Op(type="ok", f="read", value=sorted(range(300)),
+                   process=9, time=10_001))
+    t0 = _t.time()
+    rs = check_history_tpu(History.of(rows), SetModel())
+    print(f"# secondary: 300-add set + exact read: {rs['valid']} "
+          f"backend={rs.get('backend')} in {_t.time()-t0:.2f}s",
+          file=sys.stderr)
+
+    rows = []
+    t = 0
+    for v in range(150):
+        for f, val in (("enqueue", v), ("dequeue", v)):
+            rows.append(Op(type="invoke", f=f,
+                           value=val if f == "enqueue" else None,
+                           process=0 if f == "enqueue" else 1, time=t))
+            rows.append(Op(type="ok", f=f, value=val,
+                           process=0 if f == "enqueue" else 1,
+                           time=t + 1))
+            t += 2
+    t0 = _t.time()
+    rq = check_history_tpu(History.of(rows), UnorderedQueue())
+    print(f"# secondary: 300-op unique-value queue: {rq['valid']} "
+          f"backend={rq.get('backend')} in {_t.time()-t0:.2f}s",
+          file=sys.stderr)
+
 
 # ---------------------------------------------------------------------------
 # Orchestrator
