@@ -68,6 +68,69 @@ def _failure_notes(p: PackedHistory, kernel: KernelSpec, j: int,
         _describe(kernel, s, vals) for s in fail_from[:4]))
 
 
+def witness_prefix(p: PackedHistory, kernel: KernelSpec,
+                   max_configs: int = 200_000) -> Optional[list]:
+    """Reconstruct ONE maximal linearization order — the concrete op
+    sequence of a deepest search path (knossos's :final-paths
+    equivalent, truncated to a single path; reference
+    checker.clj:104-107 truncates to 10 because they can be huge).
+
+    Re-runs a bounded WGL with parent pointers; returns a list of op
+    indices (into p.ops) in linearization order, or None when the
+    bounded search can't reach the refutation frontier."""
+    from jepsen_tpu.checker.wgl import check_packed
+    n = p.n
+    n_req = p.n_required
+    if n_req == 0:
+        return []
+    f, v1, v2 = p.f.tolist(), p.v1.tolist(), p.v2.tolist()
+    inv, ret = p.inv.tolist(), p.ret.tolist()
+    step = kernel.step
+
+    init = (0, 0, int(p.init_state))
+    parent: Dict[tuple, tuple] = {init: None}
+    stack = [init]
+    best_cfg = init
+    best_depth = 0
+    explored = 0
+    while stack and explored < max_configs:
+        cfg = stack.pop()
+        k, mask, state = cfg
+        explored += 1
+        rk = ret[k] if k < n else None
+        for j in range(k, n):
+            if rk is None or inv[j] >= rk:
+                continue
+            if (mask >> (j - k)) & 1:
+                continue
+            s2, ok = step(state, f[j], v1[j], v2[j])
+            if not ok:
+                continue
+            if j == k:
+                m = mask >> 1
+                k2 = k + 1
+                while m & 1:
+                    m >>= 1
+                    k2 += 1
+                nxt = (k2, m, int(s2))
+            else:
+                nxt = (k, mask | (1 << (j - k)), int(s2))
+            if nxt in parent:
+                continue
+            parent[nxt] = (cfg, j)
+            depth = nxt[0] + bin(nxt[1]).count("1")
+            if (nxt[0], depth) > (best_cfg[0], best_depth):
+                best_cfg, best_depth = nxt, depth
+            stack.append(nxt)
+    order = []
+    cur = best_cfg
+    while parent.get(cur) is not None:
+        cur, j = parent[cur]
+        order.append(j)
+    order.reverse()
+    return order
+
+
 def analysis(p: PackedHistory, kernel: KernelSpec,
              result: Dict[str, Any]) -> Dict[str, Any]:
     """Structured failure analysis: prefix tail, frontier op, concurrent
@@ -100,11 +163,16 @@ def analysis(p: PackedHistory, kernel: KernelSpec,
         _, note = _failure_notes(p, kernel, j, states)
         rows.append({"j": j, "role": role, "label": _op_label(p, j),
                      "note": note})
+    # one concrete maximal linearization order — the :final-paths
+    # equivalent (a single path; knossos truncates to 10 at
+    # checker.clj:104-107 because they can be huge)
+    order = witness_prefix(p, kernel) or []
     return {
         "max-linearized-prefix": best_k,
         "n-required": nr,
         "frontier-states": [_describe(kernel, s, p.value_table)
                             for s in states],
+        "final-path": [_op_label(p, j) for j in order],
         "ops": rows,
     }
 
@@ -147,7 +215,10 @@ def render_linear_svg(p: PackedHistory, kernel: KernelSpec,
         f'{a["max-linearized-prefix"]}/{a["n-required"]} ops linearized; '
         f'frontier cannot advance</text>',
         f'<text x="12" y="44" font-size="12">reachable frontier states: '
-        f'{", ".join(a["frontier-states"][:8])}</text>',
+        f'{", ".join(a["frontier-states"][:8])}'
+        + (f'; one maximal path: '
+           f'{" → ".join(a["final-path"][-7:])}'
+           if a.get("final-path") else "") + '</text>',
         f'<text x="12" y="66" font-size="11" fill="{_GREEN}">'
         f'linearized prefix</text>',
         f'<text x="150" y="66" font-size="11" fill="{_RED}">frontier op'

@@ -393,8 +393,12 @@ class LinearizableChecker(Checker):
                 return  # object-model path: no packed encoding to draw
             os.makedirs(d, exist_ok=True)
             path = os.path.join(d, "linear.svg")
-            render_linear_svg(pk[0], pk[1], out, path)
+            a = render_linear_svg(pk[0], pk[1], out, path)
             out["counterexample"] = "linear.svg"
+            if a.get("final-path"):
+                # knossos :final-paths equivalent (one concrete maximal
+                # linearization order, checker.clj:104-107)
+                out["final-path"] = a["final-path"]
         except Exception as e:  # noqa: BLE001
             out["counterexample-error"] = repr(e)
 

@@ -35,12 +35,15 @@ SUITES = {
     "cockroachdb-bank-multitable": ("cockroachdb",
                                     "bank_multitable_test"),
     "galera": ("galera", "dirty_reads_test"),
+    "galera-set": ("galera", "sets_test"),
+    "galera-bank": ("galera", "bank_test"),
     "aerospike": ("aerospike", "cas_register_test"),
     "aerospike-counter": ("aerospike", "counter_test"),
     "mongodb": ("mongodb", "document_cas_test"),
     "mongodb-transfer": ("mongodb", "transfer_test"),
     "mongodb-rocks": ("small", "mongodb_rocks_test"),
     "elasticsearch": ("elasticsearch", "dirty_read_test"),
+    "elasticsearch-set": ("elasticsearch", "sets_test"),
     "tidb": ("sql_family", "tidb_bank_test"),
     "percona": ("sql_family", "percona_dirty_reads_test"),
     "mysql-cluster": ("sql_family", "mysql_cluster_bank_test"),

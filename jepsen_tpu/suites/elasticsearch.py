@@ -191,6 +191,56 @@ def dirty_read_test(opts: dict) -> dict:
     return test
 
 
+def sets_test(opts: dict) -> dict:
+    """elasticsearch/sets.clj: unique docs indexed under the partition
+    nemesis, then a refreshed match_all scan checked with set algebra
+    (lost documents are ES's classic failure mode)."""
+    import itertools
+    counter = itertools.count()
+
+    def add(test, process):
+        return {"type": "invoke", "f": "write", "value": next(counter)}
+
+    class SetReadClient(ESClient):
+        """Maps the set workload's ops onto the ES client: add = index
+        doc, read = strong (refreshed) scan returning the id set."""
+
+        def open(self, test, node):
+            return SetReadClient(node, self.timeout)
+
+        def invoke(self, test, op):
+            if op.f == "add":
+                return super().invoke(test, op.replace(f="write")) \
+                    .replace(f="add")
+            if op.f == "read":
+                out = super().invoke(test, op.replace(f="strong-read"))
+                val = sorted(out.value) if out.value is not None else None
+                return out.replace(f="read", value=val)
+            return super().invoke(test, op)
+
+    test = noop_test()
+    test.update({
+        "name": "elasticsearch-set",
+        "os": debian.os(),
+        "db": ESDB(),
+        "client": SetReadClient(),
+        "nemesis": nemesis.partition_random_halves(),
+        "checker": compose({"set": set_checker()}),
+        "generator": gen.phases(
+            gen.time_limit(
+                opts.get("time-limit", 60),
+                gen.clients(gen.stagger(1 / 10, add),
+                            gen.seq(_nemesis_cycle()))),
+            gen.nemesis(gen.once({"type": "info", "f": "stop"})),
+            gen.sleep(opts.get("recovery-time", 5)),
+            gen.clients(gen.once({"f": "read", "value": None}))),
+    })
+    test.update({k: v for k, v in opts.items()
+                 if k in ("nodes", "concurrency", "ssh", "time-limit",
+                          "store-dir", "store-root", "net")})
+    return test
+
+
 def _nemesis_cycle():
     while True:
         yield gen.sleep(10)

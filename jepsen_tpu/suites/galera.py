@@ -167,6 +167,156 @@ def dirty_reads_test(opts: dict) -> dict:
     return test
 
 
+class SetClient(client_ns.Client):
+    """galera.clj set-client (:199-236): unique-int inserts + a final
+    scan, the lost-insert probe."""
+
+    def __init__(self, node=None):
+        self.node = node
+
+    def open(self, test, node):
+        return SetClient(node)
+
+    def setup(self, test):
+        sql(test, test["nodes"][0],
+            "CREATE TABLE IF NOT EXISTS sets "
+            "(id INT NOT NULL AUTO_INCREMENT PRIMARY KEY, "
+            "value BIGINT NOT NULL)")
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "add":
+                sql(test, self.node,
+                    f"INSERT INTO sets (value) VALUES ({int(op.value)})")
+                return op.replace(type="ok")
+            if op.f == "read":
+                rows = sql(test, self.node, "SELECT value FROM sets")
+                return op.replace(type="ok",
+                                  value=sorted(int(r[0]) for r in rows))
+            raise ValueError(f"unknown op {op.f!r}")
+        except control.RemoteError as e:
+            return op.replace(type="fail" if op.f == "read" else "info",
+                              error=str(e)[:80])
+
+
+def sets_test(opts: dict) -> dict:
+    """galera.clj sets-test (:238-258): staggered unique adds under the
+    nemesis, then one final read checked with set algebra."""
+    from jepsen_tpu.checker import set_checker
+    counter = itertools.count()
+
+    def add(test, process):
+        return {"type": "invoke", "f": "add", "value": next(counter)}
+
+    test = noop_test()
+    test.update({
+        "name": "galera-set",
+        "os": debian.os(),
+        "db": GaleraDB(),
+        "client": SetClient(),
+        "nemesis": nemesis.partition_random_halves(),
+        "checker": compose({"set": set_checker()}),
+        "generator": gen.phases(
+            gen.time_limit(
+                opts.get("time-limit", 60),
+                gen.clients(gen.delay(1 / 10, add),
+                            gen.seq(_nemesis_cycle()))),
+            gen.nemesis(gen.once({"type": "info", "f": "stop"})),
+            gen.clients(gen.once({"f": "read", "value": None}))),
+    })
+    test.update({k: v for k, v in opts.items()
+                 if k in ("nodes", "concurrency", "ssh", "time-limit",
+                          "store-dir", "store-root", "net")})
+    return test
+
+
+class BankClient(client_ns.Client):
+    """galera.clj BankClient (:300-363): read both balances in a txn,
+    abort on overdraw/negative, else write both back."""
+
+    def __init__(self, n: int = 5, starting: int = 10, node=None):
+        self.n = n
+        self.starting = starting
+        self.node = node
+
+    def open(self, test, node):
+        return BankClient(self.n, self.starting, node)
+
+    def setup(self, test):
+        node = test["nodes"][0]
+        sql(test, node,
+            "CREATE TABLE IF NOT EXISTS accounts "
+            "(id INT NOT NULL PRIMARY KEY, balance BIGINT NOT NULL)")
+        for i in range(self.n):
+            sql(test, node,
+                f"INSERT IGNORE INTO accounts VALUES "
+                f"({i}, {self.starting})")
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "read":
+                rows = sql(test, self.node,
+                           "SELECT balance FROM accounts ORDER BY id")
+                return op.replace(type="ok",
+                                  value=[int(r[0]) for r in rows])
+            if op.f == "transfer":
+                v = op.value
+                frm, to = int(v["from"]), int(v["to"])
+                amt = int(v["amount"])
+                # one serializable txn: row-locked guarded debit, credit
+                # gated on the debit's row count — an overdraw debits 0
+                # rows, credits 0 rows, and commits a no-op
+                stmts = [
+                    "SET SESSION TRANSACTION ISOLATION LEVEL SERIALIZABLE",
+                    "BEGIN",
+                    f"UPDATE accounts SET balance = balance - {amt} "
+                    f"WHERE id = {frm} AND balance >= {amt}",
+                    f"UPDATE accounts SET balance = balance + {amt} "
+                    f"WHERE id = {to} AND ROW_COUNT() > 0",
+                    "SELECT ROW_COUNT()",
+                    "COMMIT"]
+                rows = sql(test, self.node, "; ".join(stmts))
+                applied = rows and rows[-1] and rows[-1][0] == "1"
+                return op.replace(type="ok" if applied else "fail")
+            raise ValueError(f"unknown op {op.f!r}")
+        except control.RemoteError as e:
+            msg = f"{e.err or ''} {e.out or ''}"
+            if "Deadlock" in msg or "abort" in msg.lower():
+                return op.replace(type="fail", error="txn-abort")
+            return op.replace(type="fail" if op.f == "read" else "info",
+                              error=msg.strip()[:80])
+
+
+def bank_test(opts: dict) -> dict:
+    """galera.clj bank-test (:364-383)."""
+    from jepsen_tpu.suites import workloads as wl
+    n = opts.get("accounts", 5)
+    starting = opts.get("starting-balance", 10)
+    test = noop_test()
+    test.update({
+        "name": "galera-bank",
+        "os": debian.os(),
+        "db": GaleraDB(),
+        "client": BankClient(n, starting),
+        "nemesis": nemesis.partition_random_halves(),
+        "checker": compose({"bank": wl.bank_checker(n, n * starting)}),
+        "generator": gen.phases(
+            gen.time_limit(
+                opts.get("time-limit", 60),
+                gen.clients(
+                    gen.stagger(1 / 10,
+                                gen.mix([wl.bank_read,
+                                         wl.bank_diff_transfer(n)])),
+                    gen.seq(_nemesis_cycle()))),
+            gen.nemesis(gen.once({"type": "info", "f": "stop"})),
+            gen.clients(gen.once({"f": "read", "value": None}))),
+    })
+    test.update({k: v for k, v in opts.items()
+                 if k in ("nodes", "concurrency", "ssh", "time-limit",
+                          "store-dir", "store-root", "net")})
+    return test
+
+
 def _nemesis_cycle():
     while True:
         yield gen.sleep(10)

@@ -87,6 +87,32 @@ class TestAnalysis:
         frontier = [r for r in a["ops"] if r["role"] == "frontier"][0]
         assert frontier["note"].startswith("blocked from every")
 
+    def test_final_path_is_a_real_linearization(self):
+        from jepsen_tpu.checker.counterexample import witness_prefix
+        from jepsen_tpu.models.core import is_inconsistent
+        p = pack_history(_failing_history(), CAS_REGISTER_KERNEL)
+        order = witness_prefix(p, CAS_REGISTER_KERNEL)
+        assert order                      # non-empty maximal path
+        # replay the path through the object model: every step legal
+        m = CASRegister()
+        for j in order:
+            inv_op, _ = p.ops[j]
+            val = inv_op.value
+            if inv_op.f == "read":
+                comp = p.ops[j][1]
+                if comp is not None and comp.value is not None:
+                    val = comp.value
+            m = m.step(inv_op.replace(value=val))
+            assert not is_inconsistent(m), (j, inv_op)
+
+    def test_result_carries_final_path(self, tmp_path):
+        test = {"store-dir": str(tmp_path)}
+        out = linearizable(CASRegister()).check(test, _failing_history())
+        assert out["valid"] is False
+        assert out["final-path"]          # e.g. ['write 1', 'cas (1, 2)']
+        svg = (tmp_path / "linear.svg").read_text()
+        assert "maximal path" in svg
+
     def test_harvest_when_states_missing(self, tmp_path):
         p = pack_history(_failing_history(), CAS_REGISTER_KERNEL)
         res = {"valid": False, "max-linearized-prefix": 2}

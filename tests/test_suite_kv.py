@@ -305,3 +305,55 @@ class TestRegistry:
         for name in ("etcd", "zookeeper", "consul", "disque", "raftis"):
             assert name in reg
             assert callable(reg[name])
+
+
+class TestGaleraWorkloads:
+    def test_set_client_sql(self):
+        from jepsen_tpu.suites.galera import SetClient
+        from test_nemesis import dummy_test, logs
+        from jepsen_tpu import control
+        from jepsen_tpu.history import Op
+        t = dummy_test(**{"ssh": {"mode": "dummy", "dummy-responses": {
+            "SELECT value": "3\n7\n"}}})
+        with control.session_pool(t):
+            c = SetClient().open(t, "n1")
+            o = Op(type="invoke", f="add", value=9, process=0, time=0)
+            assert c.invoke(t, o).type == "ok"
+            assert any("INSERT INTO sets (value) VALUES (9)" in s
+                       for s in logs(t)["n1"])
+            rd = c.invoke(t, Op(type="invoke", f="read", value=None,
+                                process=0, time=1))
+            assert rd.value == [3, 7]
+
+    def test_bank_transfer_gated_on_rowcount(self):
+        from jepsen_tpu.suites.galera import BankClient
+        from test_nemesis import dummy_test, logs
+        from jepsen_tpu import control
+        from jepsen_tpu.history import Op
+        op = Op(type="invoke", f="transfer",
+                value={"from": 0, "to": 1, "amount": 3}, process=0, time=0)
+        t = dummy_test(**{"ssh": {"mode": "dummy", "dummy-responses": {
+            "ROW_COUNT()": "1\n"}}})
+        with control.session_pool(t):
+            c = BankClient(2, 10).open(t, "n1")
+            assert c.invoke(t, op).type == "ok"
+            stmt = next(s for s in logs(t)["n1"] if "BEGIN" in s)
+            assert "SERIALIZABLE" in stmt and "balance >= 3" in stmt
+        t2 = dummy_test(**{"ssh": {"mode": "dummy", "dummy-responses": {
+            "ROW_COUNT()": "0\n"}}})
+        with control.session_pool(t2):
+            c = BankClient(2, 10).open(t2, "n1")
+            assert c.invoke(t2, op).type == "fail"
+
+    def test_registry_builds_maps(self):
+        from jepsen_tpu.suites.galera import bank_test, sets_test
+        for fn in (bank_test, sets_test):
+            m = fn({"time-limit": 1, "nodes": ["n1", "n2", "n3"]})
+            assert m["checker"] is not None and m["generator"] is not None
+
+
+class TestESSets:
+    def test_test_map_builds(self):
+        from jepsen_tpu.suites.elasticsearch import sets_test
+        m = sets_test({"time-limit": 1, "nodes": ["n1"]})
+        assert m["name"] == "elasticsearch-set"

@@ -21,8 +21,8 @@ ALL_SUITES = sorted([
     "rabbitmq-mutex", "hazelcast", "cockroachdb", "cockroachdb-bank",
     "cockroachdb-sets", "cockroachdb-comments", "cockroachdb-monotonic",
     "cockroachdb-sequential", "cockroachdb-g2",
-    "cockroachdb-bank-multitable", "galera", "aerospike",
-    "aerospike-counter",
+    "cockroachdb-bank-multitable", "galera", "galera-set", "galera-bank",
+    "elasticsearch-set", "aerospike", "aerospike-counter",
     "mongodb", "mongodb-transfer", "mongodb-rocks", "elasticsearch",
     "tidb", "percona", "mysql-cluster", "postgres-rds", "crate",
     "logcabin", "robustirc", "rethinkdb", "ravendb", "chronos",
