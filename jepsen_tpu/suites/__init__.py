@@ -45,10 +45,16 @@ SUITES = {
     "elasticsearch": ("elasticsearch", "dirty_read_test"),
     "elasticsearch-set": ("elasticsearch", "sets_test"),
     "tidb": ("sql_family", "tidb_bank_test"),
+    "tidb-register": ("sql_family", "tidb_register_test"),
+    "tidb-sets": ("sql_family", "tidb_sets_test"),
     "percona": ("sql_family", "percona_dirty_reads_test"),
+    "percona-set": ("sql_family", "percona_sets_test"),
+    "percona-bank": ("sql_family", "percona_bank_test"),
     "mysql-cluster": ("sql_family", "mysql_cluster_bank_test"),
     "postgres-rds": ("sql_family", "postgres_rds_bank_test"),
     "crate": ("sql_family", "crate_version_divergence_test"),
+    "crate-lost-updates": ("sql_family", "crate_lost_updates_test"),
+    "crate-dirty-read": ("sql_family", "crate_dirty_read_test"),
     "logcabin": ("small", "logcabin_test"),
     "robustirc": ("small", "robustirc_test"),
     "rethinkdb": ("small", "rethinkdb_test"),

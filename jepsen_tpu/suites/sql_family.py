@@ -380,6 +380,254 @@ def crate_version_divergence_test(opts: dict) -> dict:
     return test
 
 
+class CrateLostUpdatesClient(CrateClient):
+    """crate/lost_updates.clj: a set per key stored as an element list;
+    add = read elements + _version, append, write back guarded by the
+    version (a lost update silently drops acknowledged elements); read =
+    refresh + full element list."""
+
+    RETRIES = 5
+
+    def open(self, test, node):
+        return CrateLostUpdatesClient(node, self.timeout)
+
+    def setup(self, test):
+        c = CrateLostUpdatesClient(test["nodes"][0], self.timeout)
+        c._sql("CREATE TABLE IF NOT EXISTS jepsen.sets "
+               "(id INTEGER PRIMARY KEY, elements STRING)")
+        c._sql("INSERT INTO jepsen.sets (id, elements) VALUES (?, ?) "
+               "ON DUPLICATE KEY UPDATE id = id", [0, ""])
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "add":
+                for _ in range(self.RETRIES):
+                    out = self._sql("SELECT elements, _version FROM "
+                                    "jepsen.sets WHERE id = ?", [0])
+                    rows = out.get("rows") or []
+                    if not rows:
+                        return op.replace(type="fail", error="no-row")
+                    elements, version = rows[0]
+                    new = (f"{elements},{int(op.value)}" if elements
+                           else str(int(op.value)))
+                    upd = self._sql(
+                        "UPDATE jepsen.sets SET elements = ? "
+                        "WHERE id = ? AND _version = ?",
+                        [new, 0, version])
+                    if upd.get("rowcount"):
+                        return op.replace(type="ok")
+                return op.replace(type="fail", error="version-conflict")
+            if op.f == "read":
+                self._sql("REFRESH TABLE jepsen.sets")
+                out = self._sql("SELECT elements FROM jepsen.sets "
+                                "WHERE id = ?", [0])
+                rows = out.get("rows") or []
+                if not rows:
+                    return op.replace(type="fail", error="no-row")
+                elements = rows[0][0] or ""
+                vals = sorted(int(x) for x in elements.split(",") if x)
+                return op.replace(type="ok", value=vals)
+            raise ValueError(f"unknown op {op.f!r}")
+        except urllib.error.HTTPError as e:
+            t = "fail" if op.f == "read" else "info"
+            return op.replace(type=t, error=f"http-{e.code}")
+        except (TimeoutError, OSError) as e:
+            t = "fail" if op.f == "read" else "info"
+            return op.replace(type=t, error=type(e).__name__)
+
+
+def crate_lost_updates_test(opts: dict) -> dict:
+    counter = itertools.count()
+
+    def add(test, process):
+        return {"type": "invoke", "f": "add", "value": next(counter)}
+
+    test = noop_test()
+    test.update({
+        "name": "crate-lost-updates",
+        "db": db_ns.noop(),
+        "client": CrateLostUpdatesClient(),
+        "nemesis": nemesis.partition_random_halves(),
+        "checker": compose({"set": set_checker()}),
+        "generator": gen.phases(
+            gen.time_limit(
+                opts.get("time-limit", 60),
+                gen.clients(gen.stagger(1 / 10, add),
+                            gen.seq(_cycle()))),
+            gen.nemesis(gen.once({"type": "info", "f": "stop"})),
+            gen.sleep(5),
+            gen.clients(gen.once({"f": "read", "value": None}))),
+    })
+    test.update({k: v for k, v in opts.items()
+                 if k in ("nodes", "concurrency", "ssh", "time-limit",
+                          "store-dir", "store-root", "net")})
+    return test
+
+
+class CrateDirtyReadClient(CrateClient):
+    """crate/dirty_read.clj: write = insert row by id; read = select by
+    id (may see unacknowledged data); strong-read = refresh + full scan.
+    The elasticsearch dirty-read checker consumes exactly this op
+    vocabulary."""
+
+    def open(self, test, node):
+        return CrateDirtyReadClient(node, self.timeout)
+
+    def setup(self, test):
+        c = CrateDirtyReadClient(test["nodes"][0], self.timeout)
+        c._sql("CREATE TABLE IF NOT EXISTS jepsen.dirty "
+               "(id INTEGER PRIMARY KEY)")
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "write":
+                out = self._sql("INSERT INTO jepsen.dirty (id) "
+                                "VALUES (?)", [int(op.value)])
+                return op.replace(
+                    type="ok" if out.get("rowcount") else "fail")
+            if op.f == "read":
+                out = self._sql("SELECT id FROM jepsen.dirty "
+                                "WHERE id = ?", [int(op.value)])
+                return op.replace(type="ok" if out.get("rows")
+                                  else "fail")
+            if op.f == "strong-read":
+                self._sql("REFRESH TABLE jepsen.dirty")
+                out = self._sql("SELECT id FROM jepsen.dirty LIMIT 10000")
+                vals = {int(r[0]) for r in (out.get("rows") or [])}
+                return op.replace(type="ok", value=vals)
+            raise ValueError(f"unknown op {op.f!r}")
+        except urllib.error.HTTPError as e:
+            t = "fail" if op.f != "write" else "info"
+            return op.replace(type=t, error=f"http-{e.code}")
+        except (TimeoutError, OSError) as e:
+            t = "fail" if op.f != "write" else "info"
+            return op.replace(type=t, error=type(e).__name__)
+
+
+def crate_dirty_read_test(opts: dict) -> dict:
+    from jepsen_tpu.suites.elasticsearch import dirty_read_checker
+    # writes take sequential ids; reads probe a random id below the
+    # write high-water mark (in-flight writes included — that is the
+    # dirty-read window)
+    hwm = {"n": 0}
+
+    def write_hwm(test, process):
+        hwm["n"] += 1
+        return {"type": "invoke", "f": "write", "value": hwm["n"] - 1}
+
+    def read_hwm(test, process):
+        import random as _r
+        return {"type": "invoke", "f": "read",
+                "value": _r.randrange(max(1, hwm["n"]))}
+
+    test = noop_test()
+    test.update({
+        "name": "crate-dirty-read",
+        "db": db_ns.noop(),
+        "client": CrateDirtyReadClient(),
+        "nemesis": nemesis.partition_random_halves(),
+        "checker": compose({"dirty-read": dirty_read_checker()}),
+        "generator": gen.phases(
+            gen.time_limit(
+                opts.get("time-limit", 60),
+                gen.clients(gen.mix([write_hwm, read_hwm]),
+                            gen.seq(_cycle()))),
+            gen.nemesis(gen.once({"type": "info", "f": "stop"})),
+            gen.sleep(5),
+            gen.clients(gen.once({"f": "strong-read", "value": None}))),
+    })
+    test.update({k: v for k, v in opts.items()
+                 if k in ("nodes", "concurrency", "ssh", "time-limit",
+                          "store-dir", "store-root", "net")})
+    return test
+
+
+def tidb_register_test(opts: dict) -> dict:
+    """tidb register over independent keys (tidb/register.clj shape)."""
+    from jepsen_tpu import independent
+    from jepsen_tpu.checker.wgl import linearizable
+    from jepsen_tpu.models import CASRegister
+    keys = itertools.count()
+    test = noop_test()
+    test.update({
+        "name": "tidb-register",
+        "db": TiDB(),
+        "client": TiDBRegisterClient(),
+        "nemesis": nemesis.partition_random_halves(),
+        "model": CASRegister(),
+        "checker": compose({
+            "perf": perf(),
+            "indep": independent.checker(
+                linearizable(CASRegister(),
+                             backend=opts.get("backend", "cpu"))),
+        }),
+        "generator": gen.time_limit(
+            opts.get("time-limit", 60),
+            gen.clients(
+                independent.concurrent_generator(
+                    opts.get("threads-per-key", 5), keys,
+                    lambda k: gen.limit(
+                        opts.get("ops-per-key", 100),
+                        gen.stagger(1 / 10, wl.register_gen()))),
+                gen.seq(_cycle()))),
+    })
+    test.update({k: v for k, v in opts.items()
+                 if k in ("nodes", "concurrency", "ssh", "time-limit",
+                          "store-dir", "store-root", "net")})
+    return test
+
+
+def tidb_sets_test(opts: dict) -> dict:
+    """tidb sets (tidb/sets.clj shape): unique inserts + final read."""
+    from jepsen_tpu.suites.cockroachdb import SetsClient
+    counter = itertools.count()
+
+    class TiSets(SetsClient):
+        def _sql(self, test, statement):
+            return galera.sql(test, self.node, statement)
+
+    def add(test, process):
+        return {"type": "invoke", "f": "add", "value": next(counter)}
+
+    test = noop_test()
+    test.update({
+        "name": "tidb-sets",
+        "db": TiDB(),
+        "client": TiSets(),
+        "nemesis": nemesis.partition_random_halves(),
+        "checker": compose({"perf": perf(), "set": set_checker()}),
+        "generator": gen.phases(
+            gen.time_limit(
+                opts.get("time-limit", 60),
+                gen.clients(gen.stagger(1 / 10, add),
+                            gen.seq(_cycle()))),
+            gen.nemesis(gen.once({"type": "info", "f": "stop"})),
+            gen.sleep(5),
+            gen.clients(gen.once({"f": "read", "value": None}))),
+    })
+    test.update({k: v for k, v in opts.items()
+                 if k in ("nodes", "concurrency", "ssh", "time-limit",
+                          "store-dir", "store-root", "net")})
+    return test
+
+
+def percona_sets_test(opts: dict) -> dict:
+    """percona set workload (percona.clj:319-340) — galera shape over
+    the XtraDB cluster DB."""
+    test = galera.sets_test(opts)
+    test["name"] = "percona-set"
+    test["db"] = PerconaDB()
+    return test
+
+
+def percona_bank_test(opts: dict) -> dict:
+    """percona bank (percona.clj:341-361)."""
+    test = galera.bank_test(opts)
+    test["name"] = "percona-bank"
+    test["db"] = PerconaDB()
+    return test
+
+
 def _cycle():
     while True:
         yield gen.sleep(5)

@@ -357,3 +357,51 @@ class TestESSets:
         from jepsen_tpu.suites.elasticsearch import sets_test
         m = sets_test({"time-limit": 1, "nodes": ["n1"]})
         assert m["name"] == "elasticsearch-set"
+
+
+class TestCrateWorkloads:
+    def _client(self, script):
+        """CrateLostUpdatesClient with a scripted _sql."""
+        from jepsen_tpu.suites.sql_family import CrateLostUpdatesClient
+        c = CrateLostUpdatesClient("n1")
+        calls = []
+
+        def fake_sql(stmt, args=()):
+            calls.append((stmt, list(args)))
+            for pat, resp in script:
+                if pat in stmt:
+                    return resp.pop(0) if isinstance(resp, list) else resp
+            return {}
+        c._sql = fake_sql
+        return c, calls
+
+    def test_lost_updates_version_guarded_append(self):
+        from jepsen_tpu.history import Op
+        c, calls = self._client([
+            ("SELECT elements", {"rows": [["1,2", 7]]}),
+            ("UPDATE jepsen.sets", {"rowcount": 1}),
+        ])
+        o = Op(type="invoke", f="add", value=3, process=0, time=0)
+        assert c.invoke({}, o).type == "ok"
+        upd = next(cl for cl in calls if "UPDATE" in cl[0])
+        assert upd[1] == ["1,2,3", 0, 7]     # version-checked write-back
+
+    def test_lost_updates_retries_then_fails(self):
+        from jepsen_tpu.history import Op
+        c, calls = self._client([
+            ("SELECT elements", {"rows": [["", 1]]}),
+            ("UPDATE jepsen.sets", {"rowcount": 0}),  # conflict forever
+        ])
+        o = Op(type="invoke", f="add", value=5, process=0, time=0)
+        out = c.invoke({}, o)
+        assert out.type == "fail" and out.error == "version-conflict"
+        assert sum(1 for cl in calls if "UPDATE" in cl[0]) == c.RETRIES
+
+    def test_read_parses_element_list(self):
+        from jepsen_tpu.history import Op
+        c, _ = self._client([
+            ("REFRESH", {}),
+            ("SELECT elements", {"rows": [["4,1,9", 3]]}),
+        ])
+        o = Op(type="invoke", f="read", value=None, process=0, time=0)
+        assert c.invoke({}, o).value == [1, 4, 9]

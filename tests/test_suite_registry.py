@@ -24,7 +24,9 @@ ALL_SUITES = sorted([
     "cockroachdb-bank-multitable", "galera", "galera-set", "galera-bank",
     "elasticsearch-set", "aerospike", "aerospike-counter",
     "mongodb", "mongodb-transfer", "mongodb-rocks", "elasticsearch",
-    "tidb", "percona", "mysql-cluster", "postgres-rds", "crate",
+    "tidb", "tidb-register", "tidb-sets", "percona", "percona-set",
+    "percona-bank", "mysql-cluster", "postgres-rds", "crate",
+    "crate-lost-updates", "crate-dirty-read",
     "logcabin", "robustirc", "rethinkdb", "ravendb", "chronos",
 ])
 
