@@ -283,12 +283,26 @@ class RethinkClient(client_ns.Client):
     control plane ships a short python snippet; document_cas.clj:146-148
     does the same update-if-current logic via the JVM driver)."""
 
-    def __init__(self, node=None, write_acks: str = "majority"):
+    def __init__(self, node=None, write_acks: str = "majority",
+                 read_mode: str = "majority"):
         self.node = node
         self.write_acks = write_acks
+        self.read_mode = read_mode
 
     def open(self, test, node):
-        return RethinkClient(node, self.write_acks)
+        return RethinkClient(node, self.write_acks, self.read_mode)
+
+    def setup(self, test):
+        """Apply the acks matrix to the cluster (document_cas.clj
+        set-write-acks!, :30-37): update the table_config row, spinning
+        is the caller's retry policy."""
+        self.node = self.node or test["nodes"][0]
+        self._reql(
+            test,
+            "r.db('rethinkdb').table('table_config')"
+            ".filter({'db': 'jepsen', 'name': 'cas'})"
+            f".update({{'write_acks': '{self.write_acks}', "
+            "'durability': 'hard'}).run(c)")
 
     def _reql(self, test, expr: str) -> str:
         script = (
@@ -303,7 +317,9 @@ class RethinkClient(client_ns.Client):
         try:
             if op.f == "read":
                 out = self._reql(
-                    test, "r.db('jepsen').table('cas').get(0).run(c)")
+                    test,
+                    "r.db('jepsen').table('cas', read_mode="
+                    f"'{self.read_mode}').get(0).run(c)")
                 doc = json.loads(out or "null")
                 return op.replace(type="ok",
                                   value=doc.get("v") if doc else None)
@@ -351,12 +367,13 @@ class RethinkClient(client_ns.Client):
 def rethinkdb_test(opts: dict) -> dict:
     """Document CAS with the write/read-acks matrix (rethinkdb.clj,
     document_cas.clj) and a reconfigure nemesis."""
+    wa = opts.get("write-acks", "majority")
+    rm = opts.get("read-mode", "majority")
     test = noop_test()
     test.update({
-        "name": f"rethinkdb-{opts.get('write-acks', 'majority')}",
+        "name": f"rethinkdb-write-{wa}-read-{rm}",
         "db": RethinkDB(),
-        "client": RethinkClient(write_acks=opts.get("write-acks",
-                                                    "majority")),
+        "client": RethinkClient(write_acks=wa, read_mode=rm),
         "nemesis": reconfigure_nemesis(),
         "model": CASRegister(),
         "checker": compose({

@@ -158,3 +158,30 @@ class TestThinClients:
             got = c.invoke(t, op("read"))
             assert got.type == "ok" and got.value == 3
             assert c.invoke(t, op("cas", (3, 4))).type == "ok"
+
+
+class TestRethinkAcksMatrix:
+    def test_matrix_applies_to_cluster_and_reads(self):
+        from jepsen_tpu.suites.small import RethinkClient
+        t = dummy_test(**{"nodes": ["n1"], "ssh": {
+            "mode": "dummy", "dummy-responses": {"table_config": "{}"}}})
+        with control.session_pool(t):
+            c = RethinkClient("n1", write_acks="single",
+                              read_mode="outdated")
+            c.setup(t)
+            cfg = next(s for s in logs(t)["n1"] if "table_config" in s)
+            assert "write_acks" in cfg and "single" in cfg
+            c2 = c.open(t, "n1")
+            try:
+                c2.invoke(t, op("read"))
+            except Exception:
+                pass
+            rd = next(s for s in logs(t)["n1"]
+                      if "read_mode" in s and "get(0)" in s)
+            assert "read_mode" in rd and "outdated" in rd
+
+    def test_test_name_carries_matrix_point(self):
+        from jepsen_tpu.suites.small import rethinkdb_test
+        m = rethinkdb_test({"time-limit": 1, "write-acks": "single",
+                            "read-mode": "outdated"})
+        assert m["name"] == "rethinkdb-write-single-read-outdated"
