@@ -638,13 +638,14 @@ def _result(done: bool, lossy: bool, wovf: bool, best_k: int, levels: int,
 #: for most *valid* histories the first rung completes regardless of
 #: reachable-space size, since unexpanded pool rows double as the
 #: backtrack stack; the readonly closure absorbs whole read runs per
-#: step, so a slim first rung (measured 2.6x faster than 1024/64 on the
-#: 10k-op flagship with near-identical level counts) decides most
-#: histories. Bigger rungs refute exhaustively (pool death with no
+#: step, so a slim first rung decides most histories an order of
+#: magnitude faster than a wide one (10k-op flagship: 1.07s at 128/8 vs
+#: 9.9s at 1024/64 on the CPU backend, near-identical level counts).
+#: Bigger rungs refute exhaustively (pool death with no
 #: truncation) or recover witnesses a slim pool greedily dropped; wider
 #: rungs exist for high-concurrency histories (host-side rung selection
 #: skips the narrow ones when the needed window is provably larger).
-ESCALATION = ((256, 32, 32), (4096, 32, 256), (4096, 64, 256),
+ESCALATION = ((128, 32, 8), (1024, 32, 64), (4096, 64, 256),
               (16384, 128, 1024))
 
 
