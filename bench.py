@@ -198,6 +198,15 @@ def _secondary_metrics():
           f"backend={rq.get('backend')} in {_t.time()-t0:.2f}s",
           file=sys.stderr)
 
+    # config 7 (stretch): 10x the north star — a 100k-op history
+    h = simulate_register_history(100_000, n_procs=N_PROCS, n_vals=16,
+                                  seed=4, crash_p=0.0002)
+    t0 = _t.time()
+    r = check_history_tpu(h, CASRegister())
+    print(f"# secondary: 100k-op history: {r['valid']} "
+          f"levels={r.get('levels')} in {_t.time()-t0:.2f}s "
+          f"(incl. compile)", file=sys.stderr)
+
 
 # ---------------------------------------------------------------------------
 # Orchestrator

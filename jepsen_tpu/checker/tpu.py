@@ -515,8 +515,12 @@ def _jit_batch(kernel_id: int, capacity: int, window: int,
     return jax.jit(batched)
 
 
-#: Max crashed ('info') ops per key (the crashed-set mask is two words).
-CRASH_MAX = 64
+#: Max crashed ('info') ops per key (four crashed-mask words). Crash-
+#: heavy searches are the hardest axis (every crashed op is optional
+#: at every point), so wide-crash histories lean on the canonical-order
+#: and subset-dominance prunings and may escalate far — still usually
+#: faster than the CPU fallback they previously forced.
+CRASH_MAX = 128
 
 
 def _split_packed(p: PackedHistory, breq: int, cr: int,

@@ -902,3 +902,28 @@ class TestScale:
                                       crash_p=0.002)
         r = check_history_tpu(h, CASRegister())
         assert r["valid"] is True
+
+
+class TestCrashWidth128:
+    def test_90_crashed_ops_decided(self):
+        # four crashed-mask words (MC=3 after bucketing): previously an
+        # instant unknown past 64 crashed
+        from jepsen_tpu.testing import simulate_register_history
+        h = simulate_register_history(400, n_procs=6, n_vals=8, seed=3,
+                                      crash_p=0.35)
+        p = pack_history(h, CAS_REGISTER_KERNEL)
+        assert p.n - p.n_required > 64
+        assert check_packed(p, CAS_REGISTER_KERNEL)["valid"] is True
+        # the device search must at least never contradict; deciding this
+        # crash-heavy shape can take the upper rungs, so allow unknown
+        r = check_packed_tpu(p, CAS_REGISTER_KERNEL, capacity=2048,
+                             window=32, expand=64)
+        assert r["valid"] is not False
+
+    @pytest.mark.slow
+    def test_100k_op_history_device_path(self):
+        from jepsen_tpu.testing import simulate_register_history
+        h = simulate_register_history(100_000, n_procs=5, n_vals=16,
+                                      seed=4, crash_p=0.0002)
+        r = check_history_tpu(h, CASRegister())
+        assert r["valid"] is True
