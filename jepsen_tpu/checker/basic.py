@@ -74,6 +74,29 @@ def _render(s):
     return sorted(s, key=repr)
 
 
+def expand_queue_drain_ops(history: History) -> History:
+    """Expand ok ``drain`` ops whose value is a collection of dequeued
+    elements into individual synthetic dequeue pairs, so the queue
+    accounting below counts each element (checker.clj:180-212).
+
+    The in-tree queue clients (disque, rabbitmq) already write drains as
+    individual dequeue pairs into the live history; this expansion keeps
+    offline histories recorded in the reference's collection-valued
+    drain shape checkable too. Non-ok drains observe nothing and are
+    dropped."""
+    out = History()
+    for o in history:
+        if o.f != "drain":
+            out.append(o)
+            continue
+        if o.is_ok and isinstance(o.value, (list, tuple, set)):
+            for v in o.value:
+                out.append(o.replace(type="invoke", f="dequeue", value=v))
+                out.append(o.replace(type="ok", f="dequeue", value=v))
+        # invoke/fail/info drains: nothing observed
+    return out
+
+
 class QueueChecker(Checker):
     """Every dequeue must come from somewhere (checker.clj:109-129):
     assume every attempted enqueue (invoke) may have succeeded, require every
@@ -84,6 +107,7 @@ class QueueChecker(Checker):
 
     def check(self, test, history: History, opts=None) -> Dict[str, Any]:
         m = self.model
+        history = expand_queue_drain_ops(history)
         for o in history:
             step_op = None
             if o.f == "enqueue" and o.is_invoke:
@@ -111,6 +135,7 @@ class TotalQueue(Checker):
     """
 
     def check(self, test, history: History, opts=None) -> Dict[str, Any]:
+        history = expand_queue_drain_ops(history)
         attempts: Multiset = Multiset()
         enqueues: Multiset = Multiset()
         dequeues: Multiset = Multiset()

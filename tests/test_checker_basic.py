@@ -194,3 +194,45 @@ class TestCompose:
         r = check_safe(Boom(), {}, H())
         assert r["valid"] == UNKNOWN
         assert "boom" in r["error"]
+
+
+class TestDrainExpansion:
+    """expand-queue-drain-ops (checker.clj:180-212): collection-valued ok
+    drains expand into per-element dequeue pairs."""
+
+    def _h(self, rows):
+        from jepsen_tpu.history import History, Op
+        h = History()
+        for i, (p, t, f, v) in enumerate(rows):
+            h.append(Op(type=t, f=f, value=v, process=p, time=i))
+        return h
+
+    def test_total_queue_counts_drained_elements(self):
+        from jepsen_tpu.checker.basic import total_queue
+        h = self._h([(0, "invoke", "enqueue", "a"),
+                     (0, "ok", "enqueue", "a"),
+                     (0, "invoke", "enqueue", "b"),
+                     (0, "ok", "enqueue", "b"),
+                     (1, "invoke", "drain", None),
+                     (1, "ok", "drain", ["a", "b"])])
+        out = total_queue().check({}, h)
+        assert out["valid"] is True and out["lost-count"] == 0
+        # without the drained elements, both enqueues would be lost
+        h2 = self._h([(0, "invoke", "enqueue", "a"),
+                      (0, "ok", "enqueue", "a"),
+                      (1, "invoke", "drain", None),
+                      (1, "ok", "drain", [])])
+        out2 = total_queue().check({}, h2)
+        assert out2["valid"] is False and out2["lost-count"] == 1
+
+    def test_queue_checker_steps_drained_elements(self):
+        from jepsen_tpu.checker.basic import queue
+        from jepsen_tpu.models import UnorderedQueue
+        h = self._h([(0, "invoke", "enqueue", 1),
+                     (0, "ok", "enqueue", 1),
+                     (1, "invoke", "drain", None),
+                     (1, "ok", "drain", [1])])
+        assert queue(UnorderedQueue()).check({}, h)["valid"] is True
+        bad = self._h([(1, "invoke", "drain", None),
+                       (1, "ok", "drain", [9])])
+        assert queue(UnorderedQueue()).check({}, bad)["valid"] is False
