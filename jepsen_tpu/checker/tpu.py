@@ -652,15 +652,33 @@ def _result(done: bool, lossy: bool, wovf: bool, best_k: int, levels: int,
 ESCALATION = ((128, 32, 8), (1024, 32, 64), (4096, 64, 256),
               (16384, 128, 1024))
 
+#: Capacity/expand escalation, window chosen separately per history.
+CAPACITY_LADDER = ((128, 8), (1024, 64), (4096, 256), (16384, 1024))
+
+
+def _window_bucket(wneed: int) -> int:
+    """The smallest supported window covering the history's needed
+    candidate width (capped at MAX_WINDOW: beyond it refutation is
+    impossible anyway, but a witness may still be found)."""
+    for w in (32, 64, 128):
+        if wneed <= w:
+            return w
+    return MAX_WINDOW
+
+
+def _ladder_for(wneed: int):
+    """Capacity escalates at exactly the window this history needs —
+    decoupled from width, so a narrow crash-heavy history never pays
+    for multi-word masks and a wide history starts slim too (a slim
+    pool with a wide window is still cheap: E x W stays small)."""
+    w = _window_bucket(wneed)
+    return tuple((c, w, e) for c, e in CAPACITY_LADDER)
+
 
 def _select_rungs(wneed: int):
-    """Escalation rungs whose window can actually cover the history's
-    needed candidate window (host-computed). Rungs below it would only
-    burn a compile to report window overflow. When even MAX_WINDOW is too
-    narrow, run just the widest rung: a witness may still be found (done
-    is sound regardless of wovf), and refutation was impossible anyway."""
-    rungs = tuple(r for r in ESCALATION if r[1] >= wneed)
-    return rungs or (ESCALATION[-1],)
+    """Back-compat shim over _ladder_for (kept for callers/tests that
+    reason about rung windows)."""
+    return _ladder_for(wneed)
 
 
 def check_packed_tpu(p: PackedHistory, kernel: KernelSpec,
@@ -689,7 +707,7 @@ def check_packed_tpu(p: PackedHistory, kernel: KernelSpec,
         _check_window(window or WINDOW)
         ladder = ((capacity, window or WINDOW, expand),)
     else:
-        ladder = _select_rungs(_window_needed(p))
+        ladder = _ladder_for(_window_needed(p))
     out: Dict[str, Any] = {}
     for cap, win, exp in ladder:
         fn = _jit_single(_kernel_key(kernel), cap, win, exp)
@@ -806,7 +824,10 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
         _check_window(window or WINDOW)
         ladder = ((capacity, window or WINDOW, expand),)
     else:
-        ladder = ESCALATION
+        # capacity ladder at the narrow window first (most keys), then
+        # the wide rungs the per-row deferral routes wide keys to
+        ladder = (tuple((c, 32, e) for c, e in CAPACITY_LADDER)
+                  + ((4096, 64, 256), (16384, 128, 1024)))
 
     for step, (cap, win, exp) in enumerate(ladder):
         if not rows:

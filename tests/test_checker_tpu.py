@@ -564,17 +564,21 @@ class TestWideShapes:
         r = check_packed_tpu(p, CAS_REGISTER_KERNEL)
         assert r["valid"] is True
 
-    def test_rung_selection_skips_narrow_windows(self):
+    def test_rung_selection_matches_needed_window(self):
         from jepsen_tpu.checker.tpu import (
-            ESCALATION, _select_rungs, _window_needed)
+            CAPACITY_LADDER, MAX_WINDOW, _ladder_for, _window_needed)
         h = wide_history(100, 2, seed=5)
         p = pack_history(h, CAS_REGISTER_KERNEL)
-        rungs = _select_rungs(_window_needed(p))
+        rungs = _ladder_for(_window_needed(p))
+        # capacity escalates at exactly the window this history needs
         assert all(w >= _window_needed(p) for _, w, _ in rungs)
-        # narrow histories keep the cheap first rung
-        assert _select_rungs(5) == ESCALATION
-        # impossibly wide: still runs the widest rung (witness may exist)
-        assert _select_rungs(4000) == (ESCALATION[-1],)
+        assert len(rungs) == len(CAPACITY_LADDER)
+        # narrow histories escalate capacity at the narrow window only —
+        # no multi-word-mask rungs for a history that can't use them
+        assert all(w == 32 for _, w, _ in _ladder_for(5))
+        # impossibly wide: every rung runs at MAX_WINDOW (witness may
+        # still be found; refutation was impossible anyway)
+        assert all(w == MAX_WINDOW for _, w, _ in _ladder_for(4000))
 
 
 class TestMaskHelpers:
