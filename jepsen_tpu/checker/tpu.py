@@ -735,7 +735,8 @@ def warm_ladder(p: PackedHistory, kernel: KernelSpec,
     # the rung for this padded shape without paying a full search.
     cols = dict(cols)
     cols["nr"] = np.int32(0)
-    ladder = ESCALATION[:rungs] if rungs else ESCALATION
+    full = _ladder_for(_window_needed(p))
+    ladder = full[:rungs] if rungs else full
     for cap, win, exp in ladder:
         fn = _jit_single(_kernel_key(kernel), cap, win, exp)
         jax.block_until_ready(fn(*(cols[c] for c in _COLS)))
