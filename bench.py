@@ -108,11 +108,6 @@ def child_main(platform: str) -> int:
     print(f"# warm check: valid={result2['valid']} in {warm:.2f}s",
           file=sys.stderr)
 
-    if not os.environ.get("JEPSEN_BENCH_SKIP_SECONDARY"):
-        try:
-            _secondary_metrics()
-        except Exception as e:  # noqa: BLE001 — must not eat the line
-            print(f"# secondary metrics failed: {e!r}", file=sys.stderr)
     if result["valid"] is not True or result2["valid"] is not True:
         # A wrong or unknown verdict on a valid-by-construction history is
         # a bench failure, not a number.
@@ -121,6 +116,9 @@ def child_main(platform: str) -> int:
                           "error": f"verdict {result['valid']!r}"}))
         return 1
 
+    # Contract line FIRST: if a slow device makes the secondaries blow
+    # the orchestrator's timeout, the headline is already on stdout (and
+    # the orchestrator salvages a timed-out child's output).
     print(json.dumps({
         "metric": METRIC,
         "value": round(warm, 3),
@@ -130,6 +128,13 @@ def child_main(platform: str) -> int:
         "cold_s": round(cold, 3),
         "cold_vs_baseline": round(TARGET_S / cold, 2),
     }))
+    sys.stdout.flush()
+
+    if not os.environ.get("JEPSEN_BENCH_SKIP_SECONDARY"):
+        try:
+            _secondary_metrics()
+        except Exception as e:  # noqa: BLE001 — must not eat the line
+            print(f"# secondary metrics failed: {e!r}", file=sys.stderr)
     return 0
 
 
@@ -228,8 +233,21 @@ def _run_child(platform: str, timeout: float, skip_secondary: bool = False):
             [sys.executable, os.path.abspath(__file__)],
             env=env, capture_output=True, text=True, timeout=timeout)
     except subprocess.TimeoutExpired as e:
-        tail = (e.stderr or "")[-2000:] if isinstance(e.stderr, str) else ""
-        print(tail, file=sys.stderr)
+        def _s(x):
+            if isinstance(x, bytes):
+                return x.decode(errors="replace")
+            return x or ""
+        print(_s(e.stderr)[-2000:], file=sys.stderr)
+        # the headline prints before the secondaries: a child killed mid-
+        # secondary still yields its number
+        for line in reversed(_s(e.stdout).splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    return (json.loads(line),
+                            f"{platform}: ok (timeout during secondaries)")
+                except json.JSONDecodeError:
+                    continue
         return None, f"{platform}: timeout after {timeout:.0f}s"
     except Exception as e:  # noqa: BLE001
         return None, f"{platform}: spawn failed: {e!r}"
