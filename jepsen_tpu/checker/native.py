@@ -1,0 +1,172 @@
+"""Native-engine linearizability checking: the C++ WGL twin.
+
+Wraps ``jepsen_tpu/native/wgl_engine.cc`` — the same search as
+:func:`jepsen_tpu.checker.wgl.check_packed` (the reference's knossos WGL,
+checker.clj:85-94) compiled to machine code for the host side. Returns
+the same result-dict shape, so counterexample rendering and the severity
+merge treat the engines interchangeably. Histories the fixed-width masks
+cannot represent (candidate offsets past 128, >128 crashed ops) come
+back UNKNOWN and callers fall back to the unbounded Python search.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from jepsen_tpu.checker import UNKNOWN
+from jepsen_tpu.checker.wgl import _describe_op
+from jepsen_tpu.models.core import KernelSpec
+from jepsen_tpu.ops.encode import PackedHistory
+
+#: KernelSpec.name -> engine kernel id (wgl_engine.cc KERNEL_*).
+KERNEL_IDS = {
+    "cas-register": 0,
+    "mutex": 1,
+    "noop": 2,
+    "set": 3,
+    "unordered-queue": 4,
+    "fifo-queue": 5,
+}
+
+_VALID, _INVALID, _BUDGET, _WINDOW, _BAD_KERNEL, _CANCELLED = 1, 0, 2, 3, 4, 5
+
+_lib_state: Dict[str, Any] = {}
+_lib_lock = threading.Lock()
+
+
+def _lib():
+    """Load + prototype the engine once per process (None if unbuildable)."""
+    with _lib_lock:
+        if "lib" in _lib_state:
+            return _lib_state["lib"]
+        from jepsen_tpu import native
+        lib = native.load("wgl_engine")
+        if lib is not None:
+            try:
+                lib.jepsen_wgl_abi_version.restype = ctypes.c_int64
+                if lib.jepsen_wgl_abi_version() != 1:
+                    lib = None  # stale cached .so from an older ABI
+            except AttributeError:
+                lib = None
+        if lib is not None:
+            lib.jepsen_wgl_check.restype = ctypes.c_int64
+            lib.jepsen_wgl_check.argtypes = [
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_int64),
+            ]
+        _lib_state["lib"] = lib
+        return lib
+
+
+def available() -> bool:
+    """True iff the native engine compiled and loaded on this host."""
+    return _lib() is not None
+
+
+def check_packed_native(p: PackedHistory, kernel: KernelSpec,
+                        max_configs: Optional[int] = None,
+                        should_stop=None) -> Dict[str, Any]:
+    """Check one packed single-key history with the C++ engine.
+
+    Mirrors wgl.check_packed's contract exactly: {'valid': True|False|
+    'unknown', ...}. ``should_stop`` (a nullary callable, the competition
+    protocol) is polled by a watcher thread that flips the engine's stop
+    flag — ctypes releases the GIL for the call's duration, so the racer
+    runs genuinely in parallel with the Python algorithms.
+    """
+    lib = _lib()
+    if lib is None:
+        return {"valid": UNKNOWN, "engine": "native",
+                "error": "native engine unavailable on this host"}
+    kid = KERNEL_IDS.get(kernel.name)
+    if kid is None:
+        return {"valid": UNKNOWN, "engine": "native",
+                "error": f"kernel {kernel.name!r} has no native id"}
+    if p.n_required == 0:
+        return {"valid": True, "configs-explored": 0, "engine": "native"}
+    if max_configs is not None and max_configs <= 0:
+        # match the Python engines (explored > max_configs after one pop);
+        # 0 is the C ABI's "unbounded" sentinel, never pass it through
+        return {"valid": UNKNOWN, "engine": "native",
+                "error": f"config budget {max_configs} exhausted",
+                "configs-explored": 0, "max-linearized-prefix": 0}
+
+    cols = [np.ascontiguousarray(a, dtype=np.int32)
+            for a in (p.f, p.v1, p.v2, p.inv, p.ret)]
+    ptrs = [c.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)) for c in cols]
+    out = (ctypes.c_int64 * 19)()
+    stop_flag = ctypes.c_uint8(0)
+
+    watcher = None
+    stop_watcher = threading.Event()
+    if should_stop is not None:
+        def _watch():
+            while not stop_watcher.wait(0.005):
+                if should_stop():
+                    stop_flag.value = 1
+                    return
+        watcher = threading.Thread(target=_watch, daemon=True)
+        watcher.start()
+    try:
+        status = lib.jepsen_wgl_check(
+            kid, int(p.init_state), p.n, p.n_required, *ptrs,
+            0 if max_configs is None else int(max_configs),
+            ctypes.pointer(stop_flag), out)
+    finally:
+        stop_watcher.set()
+        if watcher is not None:
+            watcher.join(timeout=1.0)
+
+    explored = int(out[0])
+    best_k = int(out[1])
+    if status == _VALID:
+        return {"valid": True, "configs-explored": explored,
+                "engine": "native"}
+    if status == _INVALID:
+        n_states = int(out[2])
+        return {"valid": False, "configs-explored": explored,
+                "max-linearized-prefix": best_k,
+                "frontier-op": (_describe_op(p, best_k)
+                                if best_k < p.n else None),
+                "final-states": sorted(int(out[3 + i])
+                                       for i in range(n_states)),
+                "engine": "native"}
+    if status == _BUDGET:
+        return {"valid": UNKNOWN, "engine": "native",
+                "error": f"config budget {max_configs} exhausted",
+                "configs-explored": explored,
+                "max-linearized-prefix": best_k}
+    if status == _WINDOW:
+        return {"valid": UNKNOWN, "engine": "native",
+                "error": "candidate window exceeds the native engine's "
+                         "128-offset masks",
+                "configs-explored": explored}
+    if status == _CANCELLED:
+        return {"valid": UNKNOWN, "engine": "native",
+                "configs-explored": explored, "error": "cancelled"}
+    return {"valid": UNKNOWN, "engine": "native",
+            "error": f"native engine status {status}"}
+
+
+def check_history_native(history, model, max_configs: Optional[int] = None,
+                         should_stop=None) -> Dict[str, Any]:
+    """Pack + check a History against a model with the native engine.
+
+    UNKNOWN when the model has no integer kernel or the history exceeds
+    the kernel's word encoding (same fallbacks as the device path).
+    """
+    from jepsen_tpu.ops.encode import pack_with_init
+    try:
+        packed, kernel = pack_with_init(history, model)
+    except ValueError as e:
+        return {"valid": UNKNOWN, "engine": "native", "error": str(e)}
+    return check_packed_native(packed, kernel, max_configs, should_stop)

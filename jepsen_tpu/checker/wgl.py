@@ -307,13 +307,18 @@ class LinearizableChecker(Checker):
     selects knossos :competition | :linear | :wgl the same way):
       'wgl'          — Wing-Gong-Lowe frontier search (this module)
       'linear'       — just-in-time linearization (checker.jitlin)
-      'competition'  — both raced in threads, first answer wins
+      'native'       — the C++ WGL engine (checker.native); falls back
+                       to Python WGL when unavailable or on UNKNOWN
+                       (window overflow / unsupported encoding)
+      'competition'  — all available engines raced in threads, first
+                       definitive answer wins (the native racer runs
+                       GIL-free, so the race is genuinely parallel)
     """
 
     def __init__(self, model: Optional[Model] = None, backend: str = "cpu",
                  max_configs: Optional[int] = None,
                  algorithm: str = "wgl"):
-        if algorithm not in ("wgl", "linear", "competition"):
+        if algorithm not in ("wgl", "linear", "native", "competition"):
             raise ValueError(f"unknown algorithm {algorithm!r}")
         self.model = model
         self.backend = backend
@@ -349,6 +354,8 @@ class LinearizableChecker(Checker):
         from jepsen_tpu.checker.jitlin import (
             check_jit_model, check_jit_packed, competition)
         if pk is None:
+            # object-model path: the native engine needs a packed integer
+            # encoding, so only the two Python algorithms apply
             if self.algorithm == "linear":
                 return check_jit_model(history, model, self.max_configs)
             if self.algorithm == "competition":
@@ -364,13 +371,32 @@ class LinearizableChecker(Checker):
         packed, kernel = pk
         if self.algorithm == "linear":
             return check_jit_packed(packed, kernel, self.max_configs)
+        if self.algorithm == "native":
+            from jepsen_tpu.checker import native as native_mod
+            res = native_mod.check_packed_native(
+                packed, kernel, self.max_configs)
+            if res["valid"] is not UNKNOWN:
+                return res
+            if "budget" in res.get("error", ""):
+                # the budget verdict is final — Python would re-explore
+                # the same capped config count and answer the same
+                return res
+            # window overflow or engine unavailable: the unbounded
+            # Python search always answers
+            return check_packed(packed, kernel, self.max_configs)
         if self.algorithm == "competition":
-            return competition({
+            from jepsen_tpu.checker import native as native_mod
+            racers = {
                 "wgl": lambda stop: check_packed(
                     packed, kernel, self.max_configs, should_stop=stop),
                 "linear": lambda stop: check_jit_packed(
                     packed, kernel, self.max_configs, should_stop=stop),
-            })
+            }
+            if native_mod.available():
+                racers["native"] = lambda stop: \
+                    native_mod.check_packed_native(
+                        packed, kernel, self.max_configs, should_stop=stop)
+            return competition(racers)
         return check_packed(packed, kernel, self.max_configs)
 
     def _render(self, test, history: History, model: Model, out: dict):

@@ -1,0 +1,90 @@
+"""Native (C++) runtime components, compiled on demand.
+
+The framework's compute path is JAX/XLA; the host-side runtime around it
+uses real native code where the hot loop would otherwise be
+interpreter-bound — the same compile-on-first-use pattern as the on-node
+clock helpers (nemesis/resources/*.cc, reference
+jepsen/src/jepsen/nemesis/time.clj:11-27: tiny C sources shipped and
+built with the system compiler, no package manager involved).
+
+Artifacts are cached in ``_build/`` next to the sources, keyed by a
+content hash of the source + compile flags, so editing a source or
+bumping flags transparently rebuilds while repeat imports cost one stat.
+Set ``JEPSEN_TPU_NO_NATIVE=1`` to disable all native engines (every
+caller has a pure-Python fallback).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_HERE, "_build")
+_CXX = os.environ.get("JEPSEN_TPU_CXX", "g++")
+_FLAGS = ["-O2", "-std=c++17", "-shared", "-fPIC"]
+
+_lock = threading.Lock()
+_cache: dict = {}
+
+
+def disabled() -> bool:
+    return os.environ.get("JEPSEN_TPU_NO_NATIVE", "") not in ("", "0")
+
+
+def _source_path(name: str) -> str:
+    return os.path.join(_HERE, f"{name}.cc")
+
+
+def build(name: str) -> Optional[str]:
+    """Compile ``<name>.cc`` into a cached shared library; return its path,
+    or None when native code is disabled/unbuildable."""
+    if disabled():
+        return None
+    src = _source_path(name)
+    try:
+        with open(src, "rb") as fh:
+            blob = fh.read()
+    except OSError:
+        return None
+    key = hashlib.sha256(blob + " ".join(_FLAGS).encode()).hexdigest()[:16]
+    out = os.path.join(_BUILD_DIR, f"{name}_{key}.so")
+    if os.path.exists(out):
+        return out
+    with _lock:
+        if os.path.exists(out):
+            return out
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        tmp = out + f".tmp.{os.getpid()}"
+        try:
+            subprocess.run([_CXX, *_FLAGS, "-o", tmp, src], check=True,
+                           capture_output=True, timeout=120)
+            os.replace(tmp, out)  # atomic: concurrent builders converge
+        except (subprocess.SubprocessError, OSError):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return None
+    return out
+
+
+def load(name: str) -> Optional[ctypes.CDLL]:
+    """build() + dlopen, memoized per process. None when unavailable."""
+    with _lock:
+        if name in _cache:
+            return _cache[name]
+    path = build(name)
+    lib = None
+    if path is not None:
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            lib = None
+    with _lock:
+        _cache[name] = lib
+    return lib

@@ -1,0 +1,389 @@
+// Native WGL linearizability engine over packed integer-kernel histories.
+//
+// This is the C++ twin of jepsen_tpu/checker/wgl.py::check_packed — the
+// same Wing-Gong-Lowe frontier search the reference outsources to knossos
+// (jepsen/project.clj:9, algorithms selected at checker.clj:85-94), over
+// the same (k, mask, state) canonical configurations and the same
+// reductions (greedy pure-op closure, crashed no-effect rule). It exists
+// for the host side of the framework: the TPU path batches thousands of
+// configurations per vector lane, but single-history CPU checking — the
+// competition racer, the WGL differential oracle, suites run without an
+// accelerator — was interpreter-bound. One process-wide contract keeps
+// the three engines honest: identical verdicts on every history
+// (tests/test_native_wgl.py fuzzes native vs Python vs device).
+//
+// Representation notes (equivalent to the Python search, not identical):
+// * the Python mask is one arbitrary-precision int over offsets j-k for
+//   required AND crashed ops; here required offsets get a 128-bit window
+//   mask (m0,m1) and crashed ops a 128-bit absolute mask (c0,c1). The
+//   mapping is bijective, so the visited-set dedup matches 1:1.
+// * offsets past 128 (or >128 crashed ops) return UNKNOWN_WINDOW and the
+//   caller falls back to the unbounded Python search — mirroring how the
+//   device search reports window overflow.
+//
+// Built on demand by jepsen_tpu/native/__init__.py (g++ -O2 -shared),
+// the same compile-on-use pattern as the on-node clock helpers
+// (nemesis/resources/*.cc, reference nemesis/time.clj:11-27).
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+// f-codes: models/core.py:309-316.
+constexpr int32_t F_READ = 0;
+constexpr int32_t F_WRITE = 1;
+constexpr int32_t F_CAS = 2;
+constexpr int32_t F_ACQUIRE = 3;
+constexpr int32_t F_RELEASE = 4;
+constexpr int32_t F_ADD = 5;
+constexpr int32_t F_ENQUEUE = 6;
+constexpr int32_t F_DEQUEUE = 7;
+constexpr int32_t NIL_ID = -1;
+
+constexpr int KERNEL_CAS_REGISTER = 0;
+constexpr int KERNEL_MUTEX = 1;
+constexpr int KERNEL_NOOP = 2;
+constexpr int KERNEL_SET = 3;
+constexpr int KERNEL_UQUEUE = 4;
+constexpr int KERNEL_FIFO = 5;
+
+constexpr int64_t VALID = 1;
+constexpr int64_t INVALID = 0;
+constexpr int64_t UNKNOWN_BUDGET = 2;
+constexpr int64_t UNKNOWN_WINDOW = 3;
+constexpr int64_t BAD_KERNEL = 4;
+constexpr int64_t CANCELLED = 5;
+
+constexpr int WINDOW = 128;       // required-offset mask width (2x u64)
+constexpr int CRASH_WINDOW = 128; // crashed absolute mask width
+constexpr int FIFO_SLOTS = 7;
+
+// --- integer kernels: models/core.py:365-421,578-593,801-818 -------------
+
+template <int K>
+inline bool step(int32_t s, int32_t fc, int32_t v1, int32_t v2,
+                 int32_t* s2) {
+  if constexpr (K == KERNEL_CAS_REGISTER) {
+    if (fc == F_READ) { *s2 = s; return v1 == NIL_ID || s == v1; }
+    if (fc == F_WRITE) { *s2 = v1; return true; }
+    if (fc == F_CAS) { *s2 = (s == v1) ? v2 : s; return s == v1; }
+    *s2 = s; return false;
+  } else if constexpr (K == KERNEL_MUTEX) {
+    if (fc == F_ACQUIRE) { *s2 = 1; return s == 0; }
+    if (fc == F_RELEASE) { *s2 = 0; return s == 1; }
+    *s2 = s; return false;
+  } else if constexpr (K == KERNEL_NOOP) {
+    *s2 = s; return true;
+  } else if constexpr (K == KERNEL_SET) {
+    if (fc == F_ADD) {
+      int32_t unit = v1 >= 0 ? v1 : 0;
+      *s2 = (v2 == 1) ? s + unit : (s | unit);
+      return true;
+    }
+    *s2 = s;
+    return v1 == NIL_ID || s == v1;  // read
+  } else if constexpr (K == KERNEL_UQUEUE) {
+    int32_t sh = v1 >= 0 ? v1 : 0;
+    int32_t unit = int32_t(1) << sh;
+    int32_t cnt = (s >> sh) & v2;
+    if (fc == F_ENQUEUE) { *s2 = (v2 > 0) ? s + unit : s; return true; }
+    bool deq_ok = (fc == F_DEQUEUE) && v1 >= 0 && cnt > 0;
+    *s2 = deq_ok ? s - unit : s;
+    return deq_ok;
+  } else if constexpr (K == KERNEL_FIFO) {
+    int length = 0;
+    for (int i = 0; i < FIFO_SLOTS; ++i)
+      if ((s >> (4 * i)) & 15) ++length;
+    if (fc == F_ENQUEUE) {
+      bool ok = length < FIFO_SLOTS;
+      *s2 = ok ? (s | (v1 << (4 * length))) : s;
+      return ok;
+    }
+    bool deq_ok = (fc == F_DEQUEUE) && v1 > 0 && (s & 15) == v1;
+    *s2 = deq_ok ? (s >> 4) : s;
+    return deq_ok;
+  }
+  *s2 = s;
+  return false;
+}
+
+// Pure-op predicate: the step can never change the state at ANY state
+// where it succeeds (KernelSpec.readonly, models/core.py:944,963,974,988).
+template <int K>
+inline bool readonly_op(int32_t fc, int32_t v1, int32_t v2) {
+  if constexpr (K == KERNEL_CAS_REGISTER)
+    return fc == F_READ || (fc == F_CAS && v1 == v2);
+  else if constexpr (K == KERNEL_NOOP)
+    return true;
+  else if constexpr (K == KERNEL_SET)
+    return fc == F_READ;
+  else if constexpr (K == KERNEL_UQUEUE)
+    return fc == F_ENQUEUE && v2 == 0;  // sink enqueue
+  else
+    return false;
+}
+
+// --- configuration + visited set -----------------------------------------
+
+struct Cfg {
+  int32_t k;
+  int32_t state;
+  uint64_t m0, m1;  // required-candidate mask, offsets j-k in [0,128)
+  uint64_t c0, c1;  // crashed mask, absolute index j-n_req in [0,128)
+
+  bool operator==(const Cfg& o) const {
+    return k == o.k && state == o.state && m0 == o.m0 && m1 == o.m1 &&
+           c0 == o.c0 && c1 == o.c1;
+  }
+};
+
+inline uint64_t mix(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ull;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ull;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebull;
+  return x ^ (x >> 31);
+}
+
+inline uint64_t cfg_hash(const Cfg& c) {
+  uint64_t h = mix((uint64_t(uint32_t(c.k)) << 32) | uint32_t(c.state));
+  h = mix(h ^ c.m0);
+  h = mix(h ^ c.m1);
+  h = mix(h ^ c.c0);
+  return mix(h ^ c.c1);
+}
+
+// Open-addressing visited set (linear probing, power-of-two capacity).
+class Seen {
+ public:
+  explicit Seen(size_t cap = 1 << 14) { rehash(cap); }
+
+  // Insert; returns true if newly added.
+  bool add(const Cfg& c) {
+    if ((count_ + 1) * 10 >= cap_ * 7) rehash(cap_ * 2);
+    size_t i = cfg_hash(c) & (cap_ - 1);
+    while (slots_[i].k != -1) {
+      if (slots_[i] == c) return false;
+      i = (i + 1) & (cap_ - 1);
+    }
+    slots_[i] = c;
+    ++count_;
+    return true;
+  }
+
+ private:
+  void rehash(size_t cap) {
+    std::vector<Cfg> old = std::move(slots_);
+    cap_ = cap;
+    slots_.assign(cap_, Cfg{-1, 0, 0, 0, 0, 0});
+    count_ = 0;
+    for (const Cfg& c : old)
+      if (c.k != -1) {
+        size_t i = cfg_hash(c) & (cap_ - 1);
+        while (slots_[i].k != -1) i = (i + 1) & (cap_ - 1);
+        slots_[i] = c;
+        ++count_;
+      }
+  }
+
+  std::vector<Cfg> slots_;
+  size_t cap_ = 0;
+  size_t count_ = 0;
+};
+
+inline bool mask_get(uint64_t m0, uint64_t m1, int off) {
+  return off < 64 ? (m0 >> off) & 1 : (m1 >> (off - 64)) & 1;
+}
+
+inline void mask_set(uint64_t* m0, uint64_t* m1, int off) {
+  if (off < 64)
+    *m0 |= 1ull << off;
+  else
+    *m1 |= 1ull << (off - 64);
+}
+
+// Advance the frontier past contiguously-linearized offsets: consume
+// leading ones of (m0,m1), returning how many were consumed.
+inline int mask_advance(uint64_t* m0, uint64_t* m1) {
+  int adv = 0;
+  while (*m0 & 1) {
+    *m0 = (*m0 >> 1) | (*m1 << 63);
+    *m1 >>= 1;
+    ++adv;
+  }
+  return adv;
+}
+
+inline void mask_shr1(uint64_t* m0, uint64_t* m1) {
+  *m0 = (*m0 >> 1) | (*m1 << 63);
+  *m1 >>= 1;
+}
+
+struct Search {
+  const int32_t *f, *v1, *v2, *inv, *ret;
+  int32_t n, n_req;
+  uint64_t max_configs;
+  const volatile uint8_t* stop;
+
+  std::vector<Cfg> stack;
+  Seen seen;
+  uint64_t explored = 0;
+  int32_t best_k = 0;
+  int32_t best_states[16];
+  int n_best = 0;
+
+  // minv_suffix[j] = min(inv[j..n_req-1]); detects required candidates
+  // beyond the 128-offset window in O(1) per pop.
+  std::vector<int32_t> minv_suffix;
+
+  void note_best(int32_t k, int32_t state) {
+    if (k > best_k) {
+      best_k = k;
+      best_states[0] = state;
+      n_best = 1;
+    } else if (k == best_k && n_best < 16) {
+      for (int i = 0; i < n_best; ++i)
+        if (best_states[i] == state) return;
+      best_states[n_best++] = state;
+    }
+  }
+};
+
+template <int K>
+int64_t run(Search& S) {
+  S.minv_suffix.assign(size_t(S.n_req) + 1, INT32_MAX);
+  for (int32_t j = S.n_req - 1; j >= 0; --j)
+    S.minv_suffix[j] = S.inv[j] < S.minv_suffix[j + 1] ? S.inv[j]
+                                                       : S.minv_suffix[j + 1];
+  if (S.n - S.n_req > CRASH_WINDOW) return UNKNOWN_WINDOW;
+
+  Cfg init{0, int32_t(0), 0, 0, 0, 0};
+  init.state = S.best_states[0];  // caller stashed init_state there
+  S.note_best(0, init.state);
+  S.stack.push_back(init);
+  S.seen.add(init);
+
+  // successor scratch: (j, s2) pairs for impure candidates
+  int32_t imp_j[WINDOW + CRASH_WINDOW];
+  int32_t imp_s[WINDOW + CRASH_WINDOW];
+
+  while (!S.stack.empty()) {
+    Cfg c = S.stack.back();
+    S.stack.pop_back();
+    ++S.explored;
+    if (S.max_configs && S.explored > S.max_configs) return UNKNOWN_BUDGET;
+    if (S.stop && (S.explored & 1023) == 0 && *S.stop) return CANCELLED;
+
+    const int32_t rk = S.ret[c.k];
+    // required candidates past the representable window?
+    if (c.k + WINDOW < S.n_req && S.minv_suffix[c.k + WINDOW] < rk)
+      return UNKNOWN_WINDOW;
+
+    uint64_t p0 = 0, p1 = 0;  // pure closure mask
+    int n_imp = 0;
+    const int32_t jmax =
+        (S.n_req < c.k + WINDOW ? S.n_req : c.k + WINDOW);
+    for (int32_t j = c.k; j < jmax; ++j) {
+      if (S.inv[j] >= rk) continue;
+      const int off = j - c.k;
+      if (mask_get(c.m0, c.m1, off)) continue;
+      int32_t s2;
+      if (!step<K>(c.state, S.f[j], S.v1[j], S.v2[j], &s2)) continue;
+      if (readonly_op<K>(S.f[j], S.v1[j], S.v2[j]))
+        mask_set(&p0, &p1, off);
+      else {
+        imp_j[n_imp] = j;
+        imp_s[n_imp++] = s2;
+      }
+    }
+    if (!(p0 | p1)) {
+      // crashed (optional) candidates, skipped entirely under a pure
+      // closure — the closure successor ignores impure candidates too.
+      for (int32_t j = S.n_req; j < S.n; ++j) {
+        if (S.inv[j] >= rk) continue;
+        const int coff = j - S.n_req;
+        if (mask_get(c.c0, c.c1, coff)) continue;
+        int32_t s2;
+        if (!step<K>(c.state, S.f[j], S.v1[j], S.v2[j], &s2)) continue;
+        if (s2 == c.state) continue;  // no-effect crashed op: never take
+        imp_j[n_imp] = j;
+        imp_s[n_imp++] = s2;
+      }
+    }
+
+    if (p0 | p1) {
+      Cfg s = c;
+      s.m0 |= p0;
+      s.m1 |= p1;
+      s.k += mask_advance(&s.m0, &s.m1);
+      S.note_best(s.k, s.state);
+      if (s.k >= S.n_req) return VALID;
+      if (S.seen.add(s)) S.stack.push_back(s);
+      continue;
+    }
+    for (int i = 0; i < n_imp; ++i) {
+      const int32_t j = imp_j[i];
+      Cfg s = c;
+      s.state = imp_s[i];
+      if (j >= S.n_req) {
+        mask_set(&s.c0, &s.c1, j - S.n_req);
+      } else if (j == c.k) {
+        mask_shr1(&s.m0, &s.m1);
+        s.k += 1 + mask_advance(&s.m0, &s.m1);
+      } else {
+        mask_set(&s.m0, &s.m1, j - c.k);
+      }
+      S.note_best(s.k, s.state);
+      if (s.k >= S.n_req) return VALID;
+      if (S.seen.add(s)) S.stack.push_back(s);
+    }
+  }
+  return INVALID;
+}
+
+}  // namespace
+
+extern "C" {
+
+// out: [explored, best_k, n_states, states[0..15]] (19 slots).
+// Returns VALID/INVALID/UNKNOWN_BUDGET/UNKNOWN_WINDOW/BAD_KERNEL/CANCELLED.
+int64_t jepsen_wgl_check(int32_t kernel_id, int32_t init_state, int32_t n,
+                         int32_t n_req, const int32_t* f, const int32_t* v1,
+                         const int32_t* v2, const int32_t* inv,
+                         const int32_t* ret, uint64_t max_configs,
+                         const volatile uint8_t* stop, int64_t* out) {
+  Search S;
+  S.f = f;
+  S.v1 = v1;
+  S.v2 = v2;
+  S.inv = inv;
+  S.ret = ret;
+  S.n = n;
+  S.n_req = n_req;
+  S.max_configs = max_configs;
+  S.stop = stop;
+  S.best_states[0] = init_state;  // run() reads the init state from here
+
+  int64_t status;
+  switch (kernel_id) {
+    case KERNEL_CAS_REGISTER: status = run<KERNEL_CAS_REGISTER>(S); break;
+    case KERNEL_MUTEX: status = run<KERNEL_MUTEX>(S); break;
+    case KERNEL_NOOP: status = run<KERNEL_NOOP>(S); break;
+    case KERNEL_SET: status = run<KERNEL_SET>(S); break;
+    case KERNEL_UQUEUE: status = run<KERNEL_UQUEUE>(S); break;
+    case KERNEL_FIFO: status = run<KERNEL_FIFO>(S); break;
+    default: return BAD_KERNEL;
+  }
+  out[0] = int64_t(S.explored);
+  out[1] = S.best_k;
+  out[2] = S.n_best;
+  for (int i = 0; i < S.n_best; ++i) out[3 + i] = S.best_states[i];
+  return status;
+}
+
+// ABI version, checked by checker/native.py before prototyping the entry
+// point — a stale cached .so from an older ABI is refused, not called.
+int64_t jepsen_wgl_abi_version(void) { return 1; }
+
+}  // extern "C"
