@@ -212,6 +212,27 @@ def _secondary_metrics():
           f"levels={r.get('levels')} in {_t.time()-t0:.2f}s "
           f"(incl. compile)", file=sys.stderr)
 
+    # host-side native engine (C++ WGL twin): the same verdicts with
+    # zero compile cost — the framework's single-history CPU path
+    from jepsen_tpu.checker.native import (
+        available, check_history_native, check_keyed_native)
+    if available():
+        h10 = simulate_register_history(N_OPS, n_procs=N_PROCS, n_vals=16,
+                                        seed=42, crash_p=0.002)
+        t0 = _t.time()
+        rn = check_history_native(h10, CASRegister())
+        print(f"# secondary: native engine 10k-op: {rn['valid']} in "
+              f"{_t.time()-t0:.3f}s", file=sys.stderr)
+        t0 = _t.time()
+        rn = check_history_native(h, CASRegister())
+        print(f"# secondary: native engine 100k-op: {rn['valid']} in "
+              f"{_t.time()-t0:.3f}s", file=sys.stderr)
+        t0 = _t.time()
+        rk = check_keyed_native(keyed, CASRegister())
+        nk = sum(1 for x in rk["results"].values() if x["valid"] is True)
+        print(f"# secondary: native engine 50 keys x 200 ops: {nk}/50 "
+              f"valid in {_t.time()-t0:.3f}s", file=sys.stderr)
+
 
 # ---------------------------------------------------------------------------
 # Orchestrator

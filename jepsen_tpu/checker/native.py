@@ -157,6 +157,36 @@ def check_packed_native(p: PackedHistory, kernel: KernelSpec,
             "error": f"native engine status {status}"}
 
 
+def check_keyed_native(keyed: Dict[Any, Any], model,
+                       max_configs: Optional[int] = None) -> Dict[str, Any]:
+    """Check a {key: history} map on the native engine, keys in parallel.
+
+    The API twin of checker.tpu.check_keyed_tpu (the independent-key
+    data-parallel axis, reference independent.clj:246-296): here each
+    key's search is one GIL-free engine call, fanned out over OS threads
+    by real_pmap, so the batch scales with host cores. Keys the engine
+    cannot settle (window overflow, unsupported encoding) come back
+    UNKNOWN; callers fall back per key, same contract as the device
+    batch.
+    """
+    from jepsen_tpu.util import real_pmap
+
+    ks = list(keyed.keys())
+
+    def one(k):
+        return check_history_native(keyed[k], model, max_configs)
+
+    results = dict(zip(ks, real_pmap(one, ks)))
+    valid: Any = True
+    for r in results.values():
+        if r["valid"] is False:
+            valid = False
+            break
+        if r["valid"] is UNKNOWN:
+            valid = UNKNOWN
+    return {"valid": valid, "results": results, "engine": "native"}
+
+
 def check_history_native(history, model, max_configs: Optional[int] = None,
                          should_stop=None) -> Dict[str, Any]:
     """Pack + check a History against a model with the native engine.

@@ -151,6 +151,33 @@ class TestWideShapes:
         assert r["valid"] is UNKNOWN
 
 
+class TestKeyedBatch:
+    def test_keyed_matches_per_key(self):
+        from jepsen_tpu.checker.native import check_keyed_native
+        rng = random.Random(21)
+        keyed = {k: random_register_history(rng, n_procs=3, n_ops=10,
+                                            n_vals=3, crash_p=0.1)
+                 for k in range(12)}
+        out = check_keyed_native(keyed, CASRegister())
+        assert set(out["results"]) == set(keyed)
+        for k, h in keyed.items():
+            want = check_history_native(h, CASRegister())["valid"]
+            assert out["results"][k]["valid"] is want
+        want_all = all(r["valid"] is True for r in out["results"].values())
+        assert out["valid"] is (True if want_all else False) or \
+            out["valid"] is UNKNOWN
+
+    def test_keyed_invalid_key_fails_batch(self):
+        from jepsen_tpu.checker.native import check_keyed_native
+        good = H((0, "invoke", "write", 1), (0, "ok", "write", 1))
+        bad = H((0, "invoke", "write", 0), (0, "ok", "write", 0),
+                (1, "invoke", "read", None), (1, "ok", "read", 1))
+        out = check_keyed_native({"g": good, "b": bad}, CASRegister())
+        assert out["valid"] is False
+        assert out["results"]["g"]["valid"] is True
+        assert out["results"]["b"]["valid"] is False
+
+
 class TestControls:
     def test_budget_exhaustion(self):
         rng = random.Random(16)
