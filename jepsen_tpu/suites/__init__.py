@@ -44,6 +44,12 @@ SUITES = {
     "mongodb-rocks": ("small", "mongodb_rocks_test"),
     "elasticsearch": ("elasticsearch", "dirty_read_test"),
     "elasticsearch-set": ("elasticsearch", "sets_test"),
+    "elasticsearch-set-cas": ("elasticsearch", "set_cas_test"),
+    "elasticsearch-set-isolate-primaries":
+        ("elasticsearch", "set_isolate_primaries_test"),
+    "elasticsearch-set-pause": ("elasticsearch", "set_pause_test"),
+    "elasticsearch-set-crash": ("elasticsearch", "set_crash_test"),
+    "elasticsearch-set-bridge": ("elasticsearch", "set_bridge_test"),
     "tidb": ("sql_family", "tidb_bank_test"),
     "tidb-register": ("sql_family", "tidb_register_test"),
     "tidb-sets": ("sql_family", "tidb_sets_test"),

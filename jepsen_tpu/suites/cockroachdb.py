@@ -496,16 +496,21 @@ def compose_nemeses(maps: Sequence[Optional[dict]]) -> dict:
             "client": client, "during": during, "final": final}
 
 
-def nemesis_product(c1: Sequence[str], c2: Sequence[str]) -> List[tuple]:
+def nemesis_product(c1: Sequence[str], c2: Sequence[str],
+                    registry: Optional[Dict[str, Callable[[], dict]]] = None,
+                    ) -> List[tuple]:
     """Cartesian product of named nemeses minus duplicates, same-pair
-    reorders, and double-clock pairs (runner.clj:94-110)."""
+    reorders, and double-clock pairs (runner.clj:94-110). ``registry``
+    defaults to this module's NEMESES; other suites (tidb) pass their
+    own."""
+    reg = NEMESES if registry is None else registry
     pairs, seen = [], set()
     for n1 in c1:
         for n2 in c2:
             key = frozenset((n1, n2))
             if (n1 == n2
-                    or (NEMESES[n1]().get("clocks")
-                        and NEMESES[n2]().get("clocks"))
+                    or (reg[n1]().get("clocks")
+                        and reg[n2]().get("clocks"))
                     or key in seen):
                 continue
             seen.add(key)

@@ -249,6 +249,247 @@ def _nemesis_cycle():
         yield gen.once({"type": "info", "f": "stop"})
 
 
+# ---------------------------------------------------------------------------
+# Cluster introspection + ES-specific nemeses (core.clj:181-367)
+# ---------------------------------------------------------------------------
+
+
+def primaries(nodes, timeout: float = 5.0) -> dict:
+    """node -> the node it believes is the current primary (master), via
+    each node's own /_cluster/state (core.clj:181-202); None when the
+    node is unreachable or has no master."""
+    from jepsen_tpu.util import real_pmap
+
+    def one(node):
+        try:
+            req = urllib.request.Request(_url(node, "/_cluster/state"))
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                state = json.loads(resp.read().decode())
+            master = state.get("master_node")
+            name = state.get("nodes", {}).get(master, {}).get("name")
+            return node, name
+        except (urllib.error.URLError, OSError, ValueError):
+            return node, None
+
+    return dict(real_pmap(one, list(nodes)))
+
+
+def self_primaries(nodes) -> list:
+    """Nodes that think they themselves are the primary
+    (core.clj:204-211) — the split-brain candidates."""
+    return [n for n, p in primaries(nodes).items() if p == str(n)]
+
+
+def mostly_small_nonempty_subset(xs):
+    """A random subset with log-decreasing size (core.clj:323-342):
+    mostly one or two elements, occasionally many, never zero."""
+    import math
+    import random as _r
+    xs = list(xs)
+    if not xs:
+        return xs
+    k = int(math.exp(_r.random() * math.log(len(xs) + 1)))
+    _r.shuffle(xs)
+    return xs[:max(1, k)]
+
+
+def isolate_self_primaries_nemesis():
+    """Partition every self-proclaimed primary into its own island, the
+    rest of the cluster together (core.clj:344-353) — the classic ES
+    split-brain amplifier."""
+    def grudge(nodes):
+        ps = self_primaries(nodes)
+        rest = [n for n in nodes if n not in ps]
+        return nemesis.complete_grudge([rest] + [[p] for p in ps])
+    return nemesis.partitioner(grudge)
+
+
+def _crash_start(test, node):
+    from jepsen_tpu import control
+    with control.sudo():
+        control.execute(test, node, "killall -9 java || true")
+    return ["killed", str(node)]
+
+
+def _crash_stop(test, node):
+    from jepsen_tpu import control
+    with control.sudo():
+        control.exec(test, node, "service", "elasticsearch", "start")
+    return ["restarted", str(node)]
+
+
+def crash_nemesis():
+    """kill -9 a log-small random subset of nodes, restart on stop
+    (core.clj:355-360)."""
+    return nemesis.node_start_stopper(
+        mostly_small_nonempty_subset, _crash_start, _crash_stop)
+
+
+def crash_primary_nemesis():
+    """kill -9 one random self-primary (core.clj:362-367)."""
+    import random as _r
+
+    def targeter(nodes):
+        ps = self_primaries(nodes)
+        return [_r.choice(ps)] if ps else []
+    return nemesis.node_start_stopper(targeter, _crash_start, _crash_stop)
+
+
+# ---------------------------------------------------------------------------
+# CAS (MVCC) set client + the create-test nemesis variants (sets.clj)
+# ---------------------------------------------------------------------------
+
+
+class CASSetClient(ESClient):
+    """A set as ONE document updated with version-guarded (MVCC) CAS
+    read/modify/write cycles (sets.clj:96-160 CASSetClient): add = get
+    doc + put values+[v] with ?version=N (conflict -> fail, timeout ->
+    info); read = refresh + get, returning the sorted value list."""
+
+    DOC = "0"
+
+    def open(self, test, node):
+        return CASSetClient(node, self.timeout)
+
+    def setup(self, test):
+        # initial empty set document (sets.clj:112-113); 409 = already
+        # created by another worker's setup
+        try:
+            self._req(f"/{INDEX}/cas-sets/{self.DOC}?op_type=create",
+                      "PUT", {"values": []})
+        except urllib.error.HTTPError as e:
+            if e.code != 409:
+                raise
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "add":
+                path = f"/{INDEX}/cas-sets/{self.DOC}"
+                try:
+                    cur = self._req(path + "?preference=_primary")
+                except urllib.error.HTTPError as e:
+                    if e.code == 404:
+                        return op.replace(type="fail",
+                                          error="doc-not-found")
+                    raise
+                if not cur.get("found"):
+                    return op.replace(type="fail", error="doc-not-found")
+                version = cur["_version"]
+                values = list(cur.get("_source", {}).get("values", []))
+                values.append(op.value)
+                try:
+                    self._req(f"{path}?version={version}", "PUT",
+                              {"values": values})
+                except urllib.error.HTTPError as e:
+                    if e.code == 409:
+                        return op.replace(type="fail", error="conflict")
+                    raise
+                return op.replace(type="ok")
+            if op.f == "read":
+                self._req(f"/{INDEX}/_refresh", "POST")
+                cur = self._req(f"/{INDEX}/cas-sets/{self.DOC}"
+                                "?preference=_primary")
+                vals = sorted(cur.get("_source", {}).get("values", []))
+                return op.replace(type="ok", value=vals)
+            raise ValueError(f"unknown op {op.f!r}")
+        except (TimeoutError, OSError) as e:
+            crash = "info" if op.f == "add" else "fail"
+            return op.replace(type=crash, error=type(e).__name__)
+
+
+def _recover():
+    """Stop the nemesis, then let the cluster settle (sets.clj:170-176)."""
+    return gen.nemesis(gen.phases(
+        gen.once({"type": "info", "f": "stop"}),
+        gen.sleep(20)))
+
+
+def _read_once():
+    return gen.clients(gen.once({"f": "read", "value": None}))
+
+
+def _create_set_test(opts: dict, variant: str, nem_client,
+                     sleep_start: float, sleep_stop: float,
+                     time_limit: int, client=None) -> dict:
+    """Shared shape of the sets.clj create-* tests (sets.clj:185-272):
+    staggered unique adds under a start/stop nemesis cycle, recover,
+    one final read, set-algebra checker."""
+    import itertools
+    counter = itertools.count()
+
+    def add(test, process):
+        return {"type": "invoke", "f": "add", "value": next(counter)}
+
+    base = sets_test(opts)
+
+    def cycle():
+        while True:
+            yield gen.sleep(sleep_start)
+            yield gen.once({"type": "info", "f": "start"})
+            yield gen.sleep(sleep_stop)
+            yield gen.once({"type": "info", "f": "stop"})
+
+    base.update({
+        "name": f"elasticsearch-set-{variant}",
+        "nemesis": nem_client,
+        "generator": gen.phases(
+            gen.time_limit(
+                opts.get("time-limit", time_limit),
+                gen.clients(gen.stagger(1 / 10, add), gen.seq(cycle()))),
+            _recover(),
+            _read_once()),
+    })
+    if client is not None:
+        base["client"] = client
+    return base
+
+
+def set_isolate_primaries_test(opts: dict) -> dict:
+    """create-isolate-primaries-test (sets.clj:196-213)."""
+    return _create_set_test(opts, "isolate-primaries",
+                            isolate_self_primaries_nemesis(), 30, 200, 800)
+
+
+def set_pause_test(opts: dict) -> dict:
+    """create-pause-test (sets.clj:215-233): SIGSTOP a random
+    self-primary's JVM."""
+    import random as _r
+
+    def targeter(nodes):
+        ps = self_primaries(nodes)
+        return [_r.choice(ps)] if ps else []
+    return _create_set_test(
+        opts, "pause", nemesis.hammer_time("java", targeter=targeter),
+        10, 120, 600)
+
+
+def set_crash_test(opts: dict) -> dict:
+    """create-crash-test (sets.clj:235-252): rapid kill/restart churn."""
+    return _create_set_test(opts, "crash", crash_nemesis(), 1, 1, 600)
+
+
+def set_bridge_test(opts: dict) -> dict:
+    """create-bridge-test (sets.clj:254-272): intersecting majority
+    rings."""
+    import random as _r
+
+    def grudge(nodes):
+        nodes = list(nodes)
+        _r.shuffle(nodes)
+        return nemesis.bridge(nodes)
+    return _create_set_test(opts, "bridge", nemesis.partitioner(grudge),
+                            10, 120, 600)
+
+
+def set_cas_test(opts: dict) -> dict:
+    """The MVCC CAS-document set under the partition nemesis
+    (sets.clj:160 cas-set-client)."""
+    t = sets_test(opts)
+    t["name"] = "elasticsearch-set-cas"
+    t["client"] = CASSetClient()
+    return t
+
+
 def main(argv=None):
     from jepsen_tpu import cli
     cli.main(cli.merge_commands(cli.single_test_cmd(dirty_read_test),

@@ -62,12 +62,9 @@ class MongoDB(db_ns.DB, db_ns.Primary, db_ns.LogFiles):
             control.exec(test, node, "service", "mongod", "start")
 
     def setup_primary(self, test, node):
-        members = ", ".join(
-            f'{{_id: {i}, host: "{n}:27017"}}'
-            for i, n in enumerate(test["nodes"]))
-        mongo_eval(test, node,
-                   f"rs.initiate({{_id: 'jepsen', "
-                   f"members: [{members}]}})")
+        replica_set_initiate(test, node)
+        await_join(test, node, test["nodes"])
+        await_primary(test, node)
 
     def teardown(self, test, node):
         with control.sudo():
@@ -76,6 +73,105 @@ class MongoDB(db_ns.DB, db_ns.Primary, db_ns.LogFiles):
 
     def log_files(self, test, node):
         return ["/var/log/mongodb/mongod.log"]
+
+
+# ---------------------------------------------------------------------------
+# Replica-set orchestration (mongodb core.clj:123-303)
+# ---------------------------------------------------------------------------
+
+
+def replica_set_status(test, node) -> dict:
+    """Parsed rs.status() (core.clj:123-126); JSON.stringify makes the
+    shell's extended-JSON output parseable."""
+    import json as _json
+    out = mongo_eval(test, node, "JSON.stringify(rs.status())")
+    return _json.loads(out)
+
+
+def replica_set_initiate(test, node):
+    """rs.initiate with the full member list (core.clj:128-149)."""
+    members = ", ".join(
+        f'{{_id: {i}, host: "{n}:27017"}}'
+        for i, n in enumerate(test["nodes"]))
+    return mongo_eval(test, node,
+                      f"rs.initiate({{_id: 'jepsen', "
+                      f"members: [{members}]}})")
+
+
+def replica_set_config(test, node) -> dict:
+    """Parsed rs.conf() (core.clj:156-162)."""
+    import json as _json
+    out = mongo_eval(test, node, "JSON.stringify(rs.conf())")
+    return _json.loads(out)
+
+
+def replica_set_reconfigure(test, node, conf: dict):
+    """rs.reconfig with a bumped config version (core.clj:164-167)."""
+    import json as _json
+    conf = dict(conf)
+    conf["version"] = int(conf.get("version", 0)) + 1
+    return mongo_eval(test, node,
+                      f"rs.reconfig({_json.dumps(conf)}, {{force: true}})")
+
+
+def primaries(test, nodes) -> list:
+    """Nodes reporting themselves PRIMARY in rs.status()
+    (core.clj:175-182): during partitions more than one node can claim
+    the title — exactly what the checkers are hunting."""
+    out = []
+    for node in nodes:
+        try:
+            st = replica_set_status(test, node)
+        except Exception:  # noqa: BLE001 — unreachable node: no claim
+            continue
+        for m in st.get("members", []):
+            if m.get("self") and m.get("stateStr") == "PRIMARY":
+                out.append(node)
+    return out
+
+
+def primary(test, node):
+    """The primary as seen from one node, or None (core.clj:184-203)."""
+    try:
+        st = replica_set_status(test, node)
+    except Exception:  # noqa: BLE001
+        return None
+    for m in st.get("members", []):
+        if m.get("stateStr") == "PRIMARY":
+            return str(m.get("name", "")).split(":")[0] or None
+    return None
+
+
+def await_primary(test, node, timeout: float = 300.0):
+    """Spin until an elected primary is visible from ``node``
+    (core.clj:228-232)."""
+    import time as _t
+    deadline = _t.time() + timeout
+    while _t.time() < deadline:
+        if primary(test, node):
+            return
+        _t.sleep(1)
+    raise TimeoutError(f"no mongodb primary visible from {node} "
+                       f"after {timeout}s")
+
+
+def await_join(test, node, nodes, timeout: float = 300.0):
+    """Spin until every member is in a healthy replica-set state
+    (core.clj:234-249: PRIMARY/SECONDARY/ARBITER)."""
+    import time as _t
+    healthy = {"PRIMARY", "SECONDARY", "ARBITER"}
+    deadline = _t.time() + timeout
+    while _t.time() < deadline:
+        try:
+            st = replica_set_status(test, node)
+            states = [m.get("stateStr") for m in st.get("members", [])]
+            if len(states) == len(nodes) and \
+                    all(s in healthy for s in states):
+                return
+        except Exception:  # noqa: BLE001 — not initiated yet
+            pass
+        _t.sleep(1)
+    raise TimeoutError(f"replica set did not converge after {timeout}s")
 
 
 class DocumentCASClient(client_ns.Client):

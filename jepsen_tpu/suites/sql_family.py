@@ -50,35 +50,47 @@ class TiDB(db_ns.DB, db_ns.LogFiles):
                                     "https://download.pingcap.org/"
                                     "tidb-latest-linux-amd64.tar.gz"),
                            TIDB_DIR)
-        initial = ",".join(f"pd{i}=http://{n}:2380"
-                           for i, n in enumerate(test["nodes"]))
-        pds = ",".join(f"{n}:2379" for n in test["nodes"])
-        i = test["nodes"].index(node)
-        cu.start_daemon(test, node, f"{TIDB_DIR}/bin/pd-server",
-                        "--name", f"pd{i}",
-                        "--client-urls", f"http://{node}:2379",
-                        "--peer-urls", f"http://{node}:2380",
-                        "--initial-cluster", initial,
-                        logfile=f"{TIDB_DIR}/pd.log",
-                        pidfile=f"{TIDB_DIR}/pd.pid", chdir=TIDB_DIR)
-        cu.start_daemon(test, node, f"{TIDB_DIR}/bin/tikv-server",
-                        "--pd", pds, "--addr", f"{node}:20160",
-                        "--data-dir", f"{TIDB_DIR}/tikv",
-                        logfile=f"{TIDB_DIR}/tikv.log",
-                        pidfile=f"{TIDB_DIR}/tikv.pid", chdir=TIDB_DIR)
-        cu.start_daemon(test, node, f"{TIDB_DIR}/bin/tidb-server",
-                        "--store", "tikv", "--path", pds,
-                        logfile=f"{TIDB_DIR}/tidb.log",
-                        pidfile=f"{TIDB_DIR}/tidb.pid", chdir=TIDB_DIR)
+        tidb_quickstart(test, node)
 
     def teardown(self, test, node):
-        for d in ("tidb", "tikv", "pd"):
-            cu.stop_daemon(test, node, f"{TIDB_DIR}/{d}.pid",
-                           cmd=f"{d}-server")
+        tidb_stop(test, node)
         control.exec(test, node, "rm", "-rf", f"{TIDB_DIR}/tikv")
 
     def log_files(self, test, node):
         return [f"{TIDB_DIR}/{d}.log" for d in ("pd", "tikv", "tidb")]
+
+
+def tidb_quickstart(test, node):
+    """Start the pd/tikv/tidb daemon triple without reinstalling
+    (tidb/db.clj:78-121 quickstart!) — the startkill nemesis's restart
+    half must not pay the tarball install."""
+    initial = ",".join(f"pd{i}=http://{n}:2380"
+                       for i, n in enumerate(test["nodes"]))
+    pds = ",".join(f"{n}:2379" for n in test["nodes"])
+    i = test["nodes"].index(node)
+    cu.start_daemon(test, node, f"{TIDB_DIR}/bin/pd-server",
+                    "--name", f"pd{i}",
+                    "--client-urls", f"http://{node}:2379",
+                    "--peer-urls", f"http://{node}:2380",
+                    "--initial-cluster", initial,
+                    logfile=f"{TIDB_DIR}/pd.log",
+                    pidfile=f"{TIDB_DIR}/pd.pid", chdir=TIDB_DIR)
+    cu.start_daemon(test, node, f"{TIDB_DIR}/bin/tikv-server",
+                    "--pd", pds, "--addr", f"{node}:20160",
+                    "--data-dir", f"{TIDB_DIR}/tikv",
+                    logfile=f"{TIDB_DIR}/tikv.log",
+                    pidfile=f"{TIDB_DIR}/tikv.pid", chdir=TIDB_DIR)
+    cu.start_daemon(test, node, f"{TIDB_DIR}/bin/tidb-server",
+                    "--store", "tikv", "--path", pds,
+                    logfile=f"{TIDB_DIR}/tidb.log",
+                    pidfile=f"{TIDB_DIR}/tidb.pid", chdir=TIDB_DIR)
+
+
+def tidb_stop(test, node):
+    """Stop all three daemons, tidb first (tidb/db.clj:123-128)."""
+    for d in ("tidb", "tikv", "pd"):
+        cu.stop_daemon(test, node, f"{TIDB_DIR}/{d}.pid",
+                       cmd=f"{d}-server")
 
 
 class TiDBRegisterClient(RegisterClient):
@@ -88,6 +100,143 @@ class TiDBRegisterClient(RegisterClient):
         return galera.sql(test, self.node, statement)
 
 
+# ---------------------------------------------------------------------------
+# TiDB nemesis packages (tidb/nemesis.clj) — the cockroach named-map
+# scheme ({name, during, final, client, clocks}) with TiDB targets
+# ---------------------------------------------------------------------------
+
+#: The three daemon binaries startstop picks between (nemesis.clj:126-132).
+TIDB_BINS = ("pd-server", "tikv-server", "tidb-server")
+
+
+def tidb_nemesis_double_gen() -> dict:
+    """Interleaved schedule for a composed nemesis pair
+    (tidb/nemesis.clj:39-59): overlap the two faults half a duration at
+    a time — fault 1 starts, fault 2 joins mid-way, fault 1 lifts while
+    fault 2 persists, then the roles swap. Ops carry plain start/stop
+    fs; compose_nemeses's tagging wraps them per package."""
+    from jepsen_tpu.suites.cockroachdb import (
+        NEMESIS_DELAY, NEMESIS_DURATION)
+
+    half = NEMESIS_DURATION / 2
+
+    def cycle():
+        while True:
+            for first, second in (("start1", "start2"), ("start2",
+                                                         "start1")):
+                yield gen.sleep(NEMESIS_DELAY)
+                yield gen.once({"type": "info", "f": first})
+                yield gen.sleep(half)
+                yield gen.once({"type": "info", "f": second})
+                yield gen.sleep(half)
+                yield gen.once({"type": "info",
+                                "f": first.replace("start", "stop")})
+                yield gen.sleep(half)
+                yield gen.once({"type": "info",
+                                "f": second.replace("start", "stop")})
+    return {"during": gen.seq(cycle()),
+            "final": gen.seq([gen.once({"type": "info", "f": "stop1"}),
+                              gen.once({"type": "info", "f": "stop2"})])}
+
+
+def tidb_none() -> dict:
+    from jepsen_tpu.suites import cockroachdb as cr
+    return cr.none()
+
+
+def tidb_parts() -> dict:
+    from jepsen_tpu.suites import cockroachdb as cr
+    return cr.parts()
+
+
+def tidb_majring() -> dict:
+    from jepsen_tpu.suites import cockroachdb as cr
+    return cr.majring()
+
+
+def tidb_startstop(n: int = 1) -> dict:
+    """SIGSTOP/SIGCONT one of the three TiDB daemons on n random nodes
+    (tidb/nemesis.clj:126-132 picks the binary at package-construction
+    time)."""
+    import random as _r
+
+    from jepsen_tpu.suites import cockroachdb as cr
+    binary = _r.choice(TIDB_BINS)
+    return {**cr.nemesis_single_gen(),
+            "name": f"startstop{n if n > 1 else ''}",
+            "client": nemesis.hammer_time(binary,
+                                          targeter=cr._take_n(n)),
+            "clocks": False}
+
+
+def tidb_startkill(n: int = 1) -> dict:
+    """Kill + quickstart the whole daemon triple on n random nodes
+    (tidb/nemesis.clj:134-142: node-start-stopper over db/stop! +
+    db/quickstart!)."""
+    from jepsen_tpu.suites import cockroachdb as cr
+    return {**cr.nemesis_single_gen(),
+            "name": f"startkill{n if n > 1 else ''}",
+            "client": nemesis.node_start_stopper(
+                cr._take_n(n), tidb_stop, tidb_quickstart),
+            "clocks": False}
+
+
+#: Named registry (tidb/nemesis.clj:110-144 + runner opt-spec).
+TIDB_NEMESES = {
+    "none": tidb_none,
+    "parts": tidb_parts,
+    "majring": tidb_majring,
+    "startstop": tidb_startstop,
+    "startstop2": lambda: tidb_startstop(2),
+    "startkill": tidb_startkill,
+    "startkill2": lambda: tidb_startkill(2),
+}
+
+#: Workload constructors the matrix multiplies against (core.clj:108-110).
+TIDB_WORKLOADS = ("tidb", "tidb-register", "tidb-sets")
+
+
+def _tidb_nemesis_parts(opts: dict):
+    """(client, during-gen, final-gen) for a tidb test: the composed
+    package from opts['nemesis-map'] when the matrix supplies one, else
+    the legacy partition + 5s start/stop cycle."""
+    nm = opts.get("nemesis-map")
+    if nm:
+        return (nm.get("client") or nemesis.noop(), nm.get("during"),
+                nm.get("final"))
+    return (nemesis.partition_random_halves(), gen.seq(_cycle()),
+            gen.once({"type": "info", "f": "stop"}))
+
+
+def tidb_tests(opts: dict) -> List[dict]:
+    """Expand the TiDB test matrix: every requested workload x every
+    (nemesis1, nemesis2) product pair, composed per test
+    (tidb/core.clj:95-126: doseq over test-fns x nemesis-product,
+    nemesis/compose per run)."""
+    from jepsen_tpu.suites import cockroachdb as cr
+
+    names1 = opts.get("nemeses", ["none"])
+    names2 = opts.get("nemeses2", ["none"])
+    workloads = opts.get("workloads", TIDB_WORKLOADS)
+    ctors = {
+        "tidb": tidb_bank_test,
+        "tidb-register": tidb_register_test,
+        "tidb-sets": tidb_sets_test,
+    }
+    tests = []
+    for w in workloads:
+        for n1, n2 in cr.nemesis_product(names1, names2,
+                                         registry=TIDB_NEMESES):
+            pair = [TIDB_NEMESES[n1](), TIDB_NEMESES[n2]()]
+            merged = cr.compose_nemeses([m for m in pair
+                                         if m["name"] != "blank"]
+                                        or [pair[0]])
+            t = ctors[w]({**opts, "nemesis-map": merged})
+            t["name"] = f"{t['name']}-{merged['name']}"
+            tests.append(t)
+    return tests
+
+
 def tidb_bank_test(opts: dict) -> dict:
     n = opts.get("accounts", 5)
     starting = opts.get("starting-balance", 10)
@@ -95,25 +244,26 @@ def tidb_bank_test(opts: dict) -> dict:
     class TiBank(BankSQLClient):
         pass
 
+    nem_client, nem_during, nem_final = _tidb_nemesis_parts(opts)
     test = noop_test()
     test.update({
         "name": "tidb-bank",
         "db": TiDB(),
         "client": TiBank(n, starting),
-        "nemesis": nemesis.partition_random_halves(),
+        "nemesis": nem_client,
         "checker": compose({
             "perf": perf(),
             "bank": wl.bank_checker(n, n * starting)}),
-        "generator": gen.phases(
+        "generator": gen.phases(*filter(None, [
             gen.time_limit(
                 opts.get("time-limit", 60),
                 gen.clients(
                     gen.stagger(1 / 10, gen.mix(
                         [wl.bank_read, wl.bank_diff_transfer(n)])),
-                    gen.seq(_cycle()))),
-            gen.nemesis(gen.once({"type": "info", "f": "stop"})),
+                    nem_during)),
+            gen.nemesis(nem_final) if nem_final is not None else None,
             gen.sleep(5),
-            gen.clients(gen.once({"f": "read", "value": None}))),
+            gen.clients(gen.once({"f": "read", "value": None}))])),
     })
     test.update({k: v for k, v in opts.items()
                  if k in ("nodes", "concurrency", "ssh", "time-limit",
@@ -548,12 +698,13 @@ def tidb_register_test(opts: dict) -> dict:
     from jepsen_tpu.checker.wgl import linearizable
     from jepsen_tpu.models import CASRegister
     keys = itertools.count()
+    nem_client, nem_during, _ = _tidb_nemesis_parts(opts)
     test = noop_test()
     test.update({
         "name": "tidb-register",
         "db": TiDB(),
         "client": TiDBRegisterClient(),
-        "nemesis": nemesis.partition_random_halves(),
+        "nemesis": nem_client,
         "model": CASRegister(),
         "checker": compose({
             "perf": perf(),
@@ -569,7 +720,7 @@ def tidb_register_test(opts: dict) -> dict:
                     lambda k: gen.limit(
                         opts.get("ops-per-key", 100),
                         gen.stagger(1 / 10, wl.register_gen()))),
-                gen.seq(_cycle()))),
+                nem_during)),
     })
     test.update({k: v for k, v in opts.items()
                  if k in ("nodes", "concurrency", "ssh", "time-limit",
@@ -589,21 +740,22 @@ def tidb_sets_test(opts: dict) -> dict:
     def add(test, process):
         return {"type": "invoke", "f": "add", "value": next(counter)}
 
+    nem_client, nem_during, nem_final = _tidb_nemesis_parts(opts)
     test = noop_test()
     test.update({
         "name": "tidb-sets",
         "db": TiDB(),
         "client": TiSets(),
-        "nemesis": nemesis.partition_random_halves(),
+        "nemesis": nem_client,
         "checker": compose({"perf": perf(), "set": set_checker()}),
-        "generator": gen.phases(
+        "generator": gen.phases(*filter(None, [
             gen.time_limit(
                 opts.get("time-limit", 60),
                 gen.clients(gen.stagger(1 / 10, add),
-                            gen.seq(_cycle()))),
-            gen.nemesis(gen.once({"type": "info", "f": "stop"})),
+                            nem_during)),
+            gen.nemesis(nem_final) if nem_final is not None else None,
             gen.sleep(5),
-            gen.clients(gen.once({"f": "read", "value": None}))),
+            gen.clients(gen.once({"f": "read", "value": None}))])),
     })
     test.update({k: v for k, v in opts.items()
                  if k in ("nodes", "concurrency", "ssh", "time-limit",

@@ -358,6 +358,79 @@ class TestESSets:
         m = sets_test({"time-limit": 1, "nodes": ["n1"]})
         assert m["name"] == "elasticsearch-set"
 
+    def test_variant_test_maps_build(self):
+        from jepsen_tpu.suites import elasticsearch as es
+        for ctor, name in [
+                (es.set_cas_test, "elasticsearch-set-cas"),
+                (es.set_isolate_primaries_test,
+                 "elasticsearch-set-isolate-primaries"),
+                (es.set_pause_test, "elasticsearch-set-pause"),
+                (es.set_crash_test, "elasticsearch-set-crash"),
+                (es.set_bridge_test, "elasticsearch-set-bridge")]:
+            m = ctor({"time-limit": 1, "nodes": ["n1", "n2", "n3"]})
+            assert m["name"] == name
+            assert m["generator"] is not None
+            assert m["nemesis"] is not None
+
+    def test_mostly_small_nonempty_subset(self):
+        from jepsen_tpu.suites.elasticsearch import (
+            mostly_small_nonempty_subset)
+        xs = [1, 2, 3, 4, 5]
+        sizes = [len(mostly_small_nonempty_subset(xs))
+                 for _ in range(300)]
+        assert all(1 <= s <= 5 for s in sizes)
+        # log-decreasing: small subsets dominate (sets.clj docstring's
+        # frequency table: ~38% singletons)
+        assert sizes.count(1) > sizes.count(5)
+
+    def test_isolate_self_primaries_grudge(self, monkeypatch):
+        from jepsen_tpu.suites import elasticsearch as es
+        monkeypatch.setattr(es, "self_primaries",
+                            lambda nodes: ["n1", "n3"])
+        nem = es.isolate_self_primaries_nemesis()
+        grudge = nem.grudge_fn(["n1", "n2", "n3", "n4"])
+        # each self-primary is fully cut off from everyone else
+        assert grudge["n1"] == {"n2", "n3", "n4"}
+        assert grudge["n3"] == {"n1", "n2", "n4"}
+        # the rest only drop the primaries, not each other
+        assert grudge["n2"] == {"n1", "n3"}
+
+    def test_cas_set_client_version_guarded_add(self):
+        from jepsen_tpu.history import Op
+        from jepsen_tpu.suites.elasticsearch import CASSetClient
+        c = CASSetClient("n1")
+        calls = []
+
+        def fake_req(path, method="GET", payload=None):
+            calls.append((path, method, payload))
+            if method == "GET":
+                return {"found": True, "_version": 4,
+                        "_source": {"values": [1, 2]}}
+            return {}
+        c._req = fake_req
+        o = Op(type="invoke", f="add", value=3, process=0, time=0)
+        out = c.invoke({}, o)
+        assert out.type == "ok"
+        put = [cl for cl in calls if cl[1] == "PUT"]
+        assert put and "version=4" in put[0][0]
+        assert put[0][2] == {"values": [1, 2, 3]}
+
+    def test_cas_set_client_conflict_fails(self):
+        import urllib.error
+        from jepsen_tpu.history import Op
+        from jepsen_tpu.suites.elasticsearch import CASSetClient
+        c = CASSetClient("n1")
+
+        def fake_req(path, method="GET", payload=None):
+            if method == "GET":
+                return {"found": True, "_version": 4,
+                        "_source": {"values": []}}
+            raise urllib.error.HTTPError(path, 409, "conflict", {}, None)
+        c._req = fake_req
+        o = Op(type="invoke", f="add", value=9, process=0, time=0)
+        out = c.invoke({}, o)
+        assert out.type == "fail" and out.error == "conflict"
+
 
 class TestCrateWorkloads:
     def _client(self, script):
@@ -405,3 +478,61 @@ class TestCrateWorkloads:
         ])
         o = Op(type="invoke", f="read", value=None, process=0, time=0)
         assert c.invoke({}, o).value == [1, 4, 9]
+
+
+class TestTiDBNemesisMatrix:
+    """tidb/nemesis.clj package registry + tidb/core.clj:95-126 matrix."""
+
+    def test_registry_packages_well_formed(self):
+        from jepsen_tpu.suites.sql_family import TIDB_NEMESES
+        for name, ctor in TIDB_NEMESES.items():
+            m = ctor()
+            assert {"name", "client", "during", "final",
+                    "clocks"} <= set(m), name
+
+    def test_startstop_targets_a_tidb_binary(self):
+        from jepsen_tpu.suites.sql_family import (
+            TIDB_BINS, tidb_startstop)
+        # the binary is chosen at package-construction time
+        # (nemesis.clj:126-132); over a few draws every name is legal
+        for _ in range(8):
+            m = tidb_startstop()
+            assert m["name"] == "startstop"
+
+    def test_matrix_expands_workloads_x_products(self):
+        from jepsen_tpu.suites.sql_family import tidb_tests
+        ts = tidb_tests({"nemeses": ["none", "parts"],
+                         "nemeses2": ["none", "startkill"],
+                         "workloads": ["tidb", "tidb-sets"]})
+        names = [t["name"] for t in ts]
+        # product pairs: (none,startkill) (parts,none) (parts,startkill)
+        assert len(ts) == 2 * 3
+        assert "tidb-bank-parts+startkill" in names
+        assert "tidb-sets-startkill" in names
+        for t in ts:
+            assert t["generator"] is not None
+            assert t["nemesis"] is not None
+
+    def test_composed_package_drives_the_generator(self):
+        from jepsen_tpu import generator as gen
+        from jepsen_tpu.suites.sql_family import (
+            TIDB_NEMESES, tidb_sets_test)
+        from jepsen_tpu.suites.cockroachdb import compose_nemeses
+        merged = compose_nemeses([TIDB_NEMESES["parts"](),
+                                  TIDB_NEMESES["startkill"]()])
+        t = tidb_sets_test({"nemesis-map": merged, "time-limit": 1})
+        # the final phase must emit the composed (name, f)-tagged stops
+        from jepsen_tpu.history import NEMESIS
+        fs = []
+        g = merged["final"]
+        for _ in range(10):
+            op = g.op(t, NEMESIS)
+            if op is None:
+                break
+            fs.append(op.f)
+        assert ("parts", "stop") in fs and ("startkill", "stop") in fs
+
+    def test_double_gen_interleaves(self):
+        from jepsen_tpu.suites.sql_family import tidb_nemesis_double_gen
+        g = tidb_nemesis_double_gen()
+        assert g["during"] is not None and g["final"] is not None

@@ -261,3 +261,59 @@ class TestMongoClient:
             out = c.invoke(t2, op("transfer",
                                   {"from": 0, "to": 1, "amount": 2}))
             assert out.type == "fail"
+
+
+class TestMongoReplicaSet:
+    """Replica-set orchestration (mongodb core.clj:123-303)."""
+
+    def _status(self, members):
+        return {"set": "jepsen",
+                "members": [{"name": f"{n}:27017", "stateStr": s,
+                             "self": selfp}
+                            for n, s, selfp in members]}
+
+    def test_primaries_finds_split_brain(self, monkeypatch):
+        import json
+        states = {
+            "n1": self._status([("n1", "PRIMARY", True),
+                                ("n2", "SECONDARY", False)]),
+            "n2": self._status([("n1", "SECONDARY", False),
+                                ("n2", "PRIMARY", True)]),
+            "n3": self._status([("n3", "SECONDARY", True)]),
+        }
+        monkeypatch.setattr(
+            mongodb, "mongo_eval",
+            lambda test, node, js: json.dumps(states[str(node)]))
+        ps = mongodb.primaries({}, ["n1", "n2", "n3"])
+        assert ps == ["n1", "n2"]  # both believe they hold the crown
+
+    def test_primary_view_from_node(self, monkeypatch):
+        import json
+        st = self._status([("n1", "PRIMARY", False),
+                           ("n2", "SECONDARY", True)])
+        monkeypatch.setattr(mongodb, "mongo_eval",
+                            lambda test, node, js: json.dumps(st))
+        assert mongodb.primary({}, "n2") == "n1"
+
+    def test_await_join_spins_until_healthy(self, monkeypatch):
+        import json
+        seq = [self._status([("n1", "STARTUP", True)]),
+               self._status([("n1", "PRIMARY", True),
+                             ("n2", "SECONDARY", False)])]
+        calls = []
+
+        def fake_eval(test, node, js):
+            calls.append(js)
+            return json.dumps(seq.pop(0) if len(seq) > 1 else seq[0])
+        monkeypatch.setattr(mongodb, "mongo_eval", fake_eval)
+        monkeypatch.setattr("time.sleep", lambda s: None)
+        mongodb.await_join({}, "n1", ["n1", "n2"], timeout=10)
+        assert len(calls) >= 2
+
+    def test_reconfigure_bumps_version(self, monkeypatch):
+        sent = []
+        monkeypatch.setattr(mongodb, "mongo_eval",
+                            lambda test, node, js: sent.append(js) or "{}")
+        mongodb.replica_set_reconfigure(
+            {}, "n1", {"version": 3, "members": []})
+        assert '"version": 4' in sent[0] and "force: true" in sent[0]

@@ -22,7 +22,10 @@ ALL_SUITES = sorted([
     "cockroachdb-sets", "cockroachdb-comments", "cockroachdb-monotonic",
     "cockroachdb-sequential", "cockroachdb-g2",
     "cockroachdb-bank-multitable", "galera", "galera-set", "galera-bank",
-    "elasticsearch-set", "aerospike", "aerospike-counter",
+    "elasticsearch-set", "elasticsearch-set-cas",
+    "elasticsearch-set-isolate-primaries", "elasticsearch-set-pause",
+    "elasticsearch-set-crash", "elasticsearch-set-bridge",
+    "aerospike", "aerospike-counter",
     "mongodb", "mongodb-transfer", "mongodb-rocks", "elasticsearch",
     "tidb", "tidb-register", "tidb-sets", "percona", "percona-set",
     "percona-bank", "mysql-cluster", "postgres-rds", "crate",
