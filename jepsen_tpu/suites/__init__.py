@@ -64,6 +64,7 @@ SUITES = {
     "logcabin": ("small", "logcabin_test"),
     "robustirc": ("small", "robustirc_test"),
     "rethinkdb": ("small", "rethinkdb_test"),
+    "rethinkdb-aggressive": ("small", "rethinkdb_aggressive_test"),
     "ravendb": ("small", "ravendb_test"),
 }
 

@@ -65,6 +65,89 @@ def observed_nodes(test: dict, node) -> str:
     return m.group(1) if m else ""
 
 
+# ---------------------------------------------------------------------------
+# Info parsing + roster convergence (core.clj:52-98, 139-195)
+# ---------------------------------------------------------------------------
+
+
+def _maybe_number(s: str):
+    try:
+        return int(s)
+    except ValueError:
+        try:
+            return float(s)
+        except ValueError:
+            return s
+
+
+def server_info(test: dict, node, key: str = "statistics") -> dict:
+    """Parse an asinfo k=v;k=v response into a dict with numbers coerced
+    (core.clj:82-98 server-info + the kv-split family 52-75)."""
+    out = asinfo(test, node, key).strip()
+    info = {}
+    for kv in out.split(";"):
+        if "=" in kv:
+            k, v = kv.split("=", 1)
+            info[k] = _maybe_number(v)
+    return info
+
+
+def roster(test: dict, node) -> dict:
+    """roster:namespace=... parsed to {field: [node-ids]}
+    (core.clj:139-147): fields split on colons, node lists on commas;
+    'null' means empty."""
+    out = asinfo(test, node, f"roster:namespace={NAMESPACE}").strip()
+    parsed = {}
+    for field in out.split(":"):
+        if "=" not in field:
+            continue
+        k, v = field.split("=", 1)
+        parsed[k] = [] if v in ("", "null") else v.split(",")
+    return parsed
+
+
+def _poll(fn, pred, tries: int = 30, sleep: float = 1.0):
+    """Call fn until pred(result) holds; the reference's poll macro
+    (core.clj:156-167): 30 one-second tries then RuntimeError."""
+    import time as _t
+    for i in range(tries):
+        result = fn()
+        if pred(result):
+            return result
+        _t.sleep(sleep)
+    raise TimeoutError(f"aerospike poll timed out after {tries} tries")
+
+
+def wait_for_all_nodes_observed(test: dict, node) -> list:
+    """Spin until the roster has observed every node (core.clj:169-173);
+    returns the observed node-id list (roster-set consumes it)."""
+    want = len(test["nodes"])
+    return _poll(lambda: roster(test, node).get("observed_nodes", []),
+                 lambda r: len(r) == want)
+
+
+def wait_for_all_nodes_pending(test: dict, node) -> list:
+    """core.clj:175-179: the pending roster carries every node."""
+    want = len(test["nodes"])
+    return _poll(lambda: roster(test, node).get("pending_roster", []),
+                 lambda r: len(r) == want)
+
+
+def wait_for_all_nodes_active(test: dict, node) -> list:
+    """core.clj:181-185: the active roster carries every node."""
+    want = len(test["nodes"])
+    return _poll(lambda: roster(test, node).get("roster", []),
+                 lambda r: len(r) == want)
+
+
+def wait_for_migrations(test: dict, node) -> dict:
+    """core.clj:187-195: partition migrations quiesced."""
+    return _poll(
+        lambda: server_info(test, node),
+        lambda s: (s.get("migrate_allowed") == "true"
+                   and s.get("migrate_partitions_remaining") == 0))
+
+
 class AerospikeDB(db_ns.DB, db_ns.Primary, db_ns.LogFiles):
     """deb install, config upload, service start + roster on primary
     (core.clj:213-278)."""
@@ -77,10 +160,16 @@ class AerospikeDB(db_ns.DB, db_ns.Primary, db_ns.LogFiles):
             control.exec(test, node, "service", "aerospike", "start")
 
     def setup_primary(self, test, node):
-        observed = observed_nodes(test, node)
-        if observed:
-            roster_set(test, node, observed)
-            recluster(test, node)
+        """The full roster dance (core.clj:264-277): wait for the
+        cluster to observe every node, set the roster to exactly that
+        list, wait for it to go pending, recluster, then wait for the
+        active roster and for migrations to quiesce."""
+        observed = wait_for_all_nodes_observed(test, node)
+        roster_set(test, node, ",".join(observed))
+        wait_for_all_nodes_pending(test, node)
+        recluster(test, node)
+        wait_for_all_nodes_active(test, node)
+        wait_for_migrations(test, node)
 
     def teardown(self, test, node):
         with control.sudo():

@@ -278,6 +278,62 @@ def reconfigure_nemesis():
     return Reconfigure()
 
 
+def reconfigure_grudge(nodes, new_primary):
+    """A partition likely to strand the outgoing topology
+    (rethinkdb.clj:234-249): half the cluster (never containing the new
+    primary) against the rest — or, half the time, a plain random
+    bisection; occasionally no partition at all."""
+    import random as _r
+    nodes = list(nodes)
+    if _r.random() < 0.5:
+        others = [n for n in nodes if n != new_primary]
+        _r.shuffle(others)
+        side1 = set(others[:len(nodes) // 2])
+        side2 = [n for n in nodes if n not in side1]
+        return nemesis.complete_grudge([sorted(side1), side2])
+    _r.shuffle(nodes)
+    return nemesis.complete_grudge(nemesis.bisect(nodes))
+
+
+def aggressive_reconfigure_nemesis(db: str = "jepsen", table: str = "cas"):
+    """rethinkdb.clj:251-331: each op picks a fresh random
+    primary+replica set, reconfigures the table, HEALS the network, then
+    applies a partition computed to strand the old topology — the
+    combination that actually broke RethinkDB's guarantees. Stateful:
+    the previous grudge feeds the next one."""
+    import random as _r
+
+    class AggressiveReconfigure(nemesis.Nemesis):
+        def __init__(self):
+            self.state = {"primary": None, "replicas": [], "grudge": {}}
+
+        def invoke(self, test, op):
+            nodes = list(test["nodes"])
+            size = _r.randrange(1, len(nodes) + 1)
+            replicas = _r.sample(nodes, size)
+            primary = _r.choice(replicas)
+            grudge = reconfigure_grudge(nodes, primary)
+            control.execute(
+                test, primary,
+                f"rethinkdb admin --join {primary}:29015 reconfigure "
+                f"{db}.{table} --shards 1 "
+                f"--replicas {len(replicas)} || true")
+            net = test.get("net")
+            if net is not None:
+                net.heal(test)
+            nemesis.partition(test, grudge)
+            self.state = {"primary": primary, "replicas": replicas,
+                          "grudge": grudge}
+            return op.replace(type="info", value=dict(self.state))
+
+        def teardown(self, test):
+            net = test.get("net")
+            if net is not None:
+                net.heal(test)
+
+    return AggressiveReconfigure()
+
+
 class RethinkClient(client_ns.Client):
     """Document CAS via ReQL executed with the driver on the *node* (the
     control plane ships a short python snippet; document_cas.clj:146-148
@@ -369,12 +425,15 @@ def rethinkdb_test(opts: dict) -> dict:
     document_cas.clj) and a reconfigure nemesis."""
     wa = opts.get("write-acks", "majority")
     rm = opts.get("read-mode", "majority")
+    aggressive = opts.get("aggressive-reconfigure", False)
     test = noop_test()
     test.update({
-        "name": f"rethinkdb-write-{wa}-read-{rm}",
+        "name": f"rethinkdb-write-{wa}-read-{rm}"
+                + ("-aggressive" if aggressive else ""),
         "db": RethinkDB(),
         "client": RethinkClient(write_acks=wa, read_mode=rm),
-        "nemesis": reconfigure_nemesis(),
+        "nemesis": (aggressive_reconfigure_nemesis() if aggressive
+                    else reconfigure_nemesis()),
         "model": CASRegister(),
         "checker": compose({
             "perf": perf(),
@@ -389,6 +448,12 @@ def rethinkdb_test(opts: dict) -> dict:
                  if k in ("nodes", "concurrency", "ssh", "time-limit",
                           "store-dir", "store-root", "net")})
     return test
+
+
+def rethinkdb_aggressive_test(opts: dict) -> dict:
+    """The acks-matrix CAS test under the aggressive reconfigure+
+    partition nemesis (rethinkdb.clj:251-331)."""
+    return rethinkdb_test({**opts, "aggressive-reconfigure": True})
 
 
 # ---------------------------------------------------------------------------

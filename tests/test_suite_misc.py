@@ -317,3 +317,60 @@ class TestMongoReplicaSet:
         mongodb.replica_set_reconfigure(
             {}, "n1", {"version": 3, "members": []})
         assert '"version": 4' in sent[0] and "force: true" in sent[0]
+
+
+class TestAerospikeRoster:
+    """Roster convergence + info parsing (aerospike core.clj:52-195)."""
+
+    def _patch(self, monkeypatch, responses):
+        # responses: list of (pattern, reply) consumed in order per match
+        def fake_asinfo(test, node, command):
+            for pat, replies in responses:
+                if pat in command:
+                    return replies.pop(0) if len(replies) > 1 \
+                        else replies[0]
+            raise AssertionError(f"unexpected asinfo {command!r}")
+        monkeypatch.setattr(aerospike, "asinfo", fake_asinfo)
+        monkeypatch.setattr("time.sleep", lambda s: None)
+
+    def test_server_info_parses_and_coerces(self, monkeypatch):
+        self._patch(monkeypatch, [
+            ("statistics",
+             ["cluster_size=3;migrate_allowed=true;"
+              "migrate_partitions_remaining=0;uptime=12.5"])])
+        s = aerospike.server_info({}, "n1")
+        assert s["cluster_size"] == 3
+        assert s["migrate_allowed"] == "true"
+        assert s["uptime"] == 12.5
+
+    def test_roster_parses_fields(self, monkeypatch):
+        self._patch(monkeypatch, [
+            ("roster:", ["roster=null:pending_roster=A1,B2:"
+                         "observed_nodes=A1,B2,C3"])])
+        r = aerospike.roster({}, "n1")
+        assert r["roster"] == []
+        assert r["pending_roster"] == ["A1", "B2"]
+        assert r["observed_nodes"] == ["A1", "B2", "C3"]
+
+    def test_wait_for_observed_spins(self, monkeypatch):
+        self._patch(monkeypatch, [
+            ("roster:", ["observed_nodes=A1",
+                         "observed_nodes=A1",
+                         "observed_nodes=A1,B2,C3"])])
+        t = {"nodes": ["n1", "n2", "n3"]}
+        got = aerospike.wait_for_all_nodes_observed(t, "n1")
+        assert got == ["A1", "B2", "C3"]
+
+    def test_wait_for_migrations(self, monkeypatch):
+        self._patch(monkeypatch, [
+            ("statistics",
+             ["migrate_allowed=false;migrate_partitions_remaining=9",
+              "migrate_allowed=true;migrate_partitions_remaining=0"])])
+        s = aerospike.wait_for_migrations({}, "n1")
+        assert s["migrate_partitions_remaining"] == 0
+
+    def test_poll_times_out(self, monkeypatch):
+        monkeypatch.setattr("time.sleep", lambda s: None)
+        import pytest as _pytest
+        with _pytest.raises(TimeoutError):
+            aerospike._poll(lambda: 1, lambda r: False, tries=3)

@@ -30,7 +30,8 @@ ALL_SUITES = sorted([
     "tidb", "tidb-register", "tidb-sets", "percona", "percona-set",
     "percona-bank", "mysql-cluster", "postgres-rds", "crate",
     "crate-lost-updates", "crate-dirty-read",
-    "logcabin", "robustirc", "rethinkdb", "ravendb", "chronos",
+    "logcabin", "robustirc", "rethinkdb", "rethinkdb-aggressive",
+    "ravendb", "chronos",
 ])
 
 
@@ -188,3 +189,43 @@ class TestRethinkAcksMatrix:
         m = rethinkdb_test({"time-limit": 1, "write-acks": "single",
                             "read-mode": "outdated"})
         assert m["name"] == "rethinkdb-write-single-read-outdated"
+
+
+class TestRethinkAggressiveReconfigure:
+    """rethinkdb.clj:234-331 aggressive reconfigure + targeted grudge."""
+
+    def test_grudge_shapes(self):
+        from jepsen_tpu.suites.small import reconfigure_grudge
+        nodes = ["n1", "n2", "n3", "n4", "n5"]
+        for _ in range(40):
+            g = reconfigure_grudge(nodes, "n3")
+            # a complete grudge over a two-component split: every node
+            # drops exactly the other side
+            assert set(g) <= set(nodes)
+            for n, dropped in g.items():
+                assert n not in dropped
+                assert dropped <= set(nodes)
+
+    def test_nemesis_reconfigures_heals_and_partitions(self):
+        from jepsen_tpu.suites.small import aggressive_reconfigure_nemesis
+        from jepsen_tpu import net as net_ns
+        healed = []
+
+        class SpyNet(net_ns.NoopNet):
+            def heal(self, test):
+                healed.append(True)
+
+        t = dummy_test(**{"nodes": ["n1", "n2", "n3"],
+                          "ssh": {"mode": "dummy",
+                                  "dummy-responses": {"reconfigure": ""}}})
+        t["net"] = SpyNet()
+        with control.session_pool(t):
+            nm = aggressive_reconfigure_nemesis()
+            out = nm.invoke(t, op("reconfigure"))
+            assert out.type == "info"
+            assert out.value["primary"] in t["nodes"]
+            assert set(out.value["replicas"]) <= set(t["nodes"])
+            assert healed  # net healed before the fresh partition
+            cmd = next(c for cmds in logs(t).values() for c in cmds
+                       if "reconfigure" in c)
+            assert "jepsen.cas" in cmd
