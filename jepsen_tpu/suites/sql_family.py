@@ -434,6 +434,71 @@ def postgres_rds_bank_test(opts: dict) -> dict:
 # CrateDB (SQL over HTTP; version divergence)
 # ---------------------------------------------------------------------------
 
+CRATE_DIR = "/opt/crate"
+
+
+def crate_majority(n: int) -> int:
+    """n//2 + 1 (crate/core.clj:289-292)."""
+    return n // 2 + 1
+
+
+class CrateDB(db_ns.DB, db_ns.LogFiles):
+    """Crate node lifecycle (crate/core.clj:278-377): jdk8 + tarball
+    install under a dedicated user, crate.yml with unicast discovery and
+    majority minimum_master_nodes (the split-brain dial the
+    version-divergence workload turns), vm.max_map_count bump, daemon
+    start, wait for the HTTP port."""
+
+    def __init__(self, tarball: Optional[str] = None):
+        self.tarball = tarball
+
+    def setup(self, test, node):
+        tarball = (self.tarball or test.get("tarball")
+                   or "https://cdn.crate.io/downloads/releases/"
+                      "crate-0.57.2.tar.gz")
+        debian.install(test, node, ["apt-transport-https",
+                                    "openjdk-8-jdk"])
+        cu.ensure_user(test, node, "crate")
+        cu.install_archive(test, node, tarball, CRATE_DIR)
+        n = len(test["nodes"])
+        hosts = ", ".join(f'"{h}:44300"' for h in test["nodes"])
+        conf = (f"cluster.name: jepsen\n"
+                f"node.name: {node}\n"
+                f"network.host: 0.0.0.0\n"
+                f"transport.tcp.port: 44300\n"
+                f"discovery.zen.ping.unicast.hosts: [{hosts}]\n"
+                f"discovery.zen.minimum_master_nodes: "
+                f"{crate_majority(n)}\n"
+                f"gateway.expected_nodes: {n}\n")
+        with control.sudo():
+            control.execute(
+                test, node,
+                f"echo {control.escape(conf)} > "
+                f"{CRATE_DIR}/config/crate.yml")
+            control.execute(test, node,
+                            f"chown -R crate:crate {CRATE_DIR}")
+            control.execute(test, node,
+                            "sysctl -w vm.max_map_count=262144")
+            control.execute(test, node, f"mkdir -p {CRATE_DIR}/logs")
+        cu.start_daemon(test, node, f"{CRATE_DIR}/bin/crate",
+                        logfile=f"{CRATE_DIR}/logs/stdout.log",
+                        pidfile=f"{CRATE_DIR}/crate.pid",
+                        chdir=CRATE_DIR)
+
+    def teardown(self, test, node):
+        cu.grepkill(test, node, "crate")
+        control.execute(test, node,
+                        f"rm -rf {CRATE_DIR}/logs/* {CRATE_DIR}/data/* "
+                        f"|| true")
+
+    def log_files(self, test, node):
+        return [f"{CRATE_DIR}/logs/crate.log",
+                f"{CRATE_DIR}/logs/stdout.log"]
+
+
+# ---------------------------------------------------------------------------
+# ---------------------------------------------------------------------------
+
 
 class CrateClient(client_ns.Client):
     """crate/core.clj over the HTTP /_sql endpoint: versioned updates.
@@ -511,7 +576,7 @@ def crate_version_divergence_test(opts: dict) -> dict:
     test = noop_test()
     test.update({
         "name": "crate-version-divergence",
-        "db": db_ns.noop(),
+        "db": CrateDB(),
         "client": CrateClient(),
         "nemesis": nemesis.partition_random_halves(),
         "checker": compose({
@@ -595,7 +660,7 @@ def crate_lost_updates_test(opts: dict) -> dict:
     test = noop_test()
     test.update({
         "name": "crate-lost-updates",
-        "db": db_ns.noop(),
+        "db": CrateDB(),
         "client": CrateLostUpdatesClient(),
         "nemesis": nemesis.partition_random_halves(),
         "checker": compose({"set": set_checker()}),
@@ -673,7 +738,7 @@ def crate_dirty_read_test(opts: dict) -> dict:
     test = noop_test()
     test.update({
         "name": "crate-dirty-read",
-        "db": db_ns.noop(),
+        "db": CrateDB(),
         "client": CrateDirtyReadClient(),
         "nemesis": nemesis.partition_random_halves(),
         "checker": compose({"dirty-read": dirty_read_checker()}),

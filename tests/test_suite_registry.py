@@ -229,3 +229,35 @@ class TestRethinkAggressiveReconfigure:
             cmd = next(c for cmds in logs(t).values() for c in cmds
                        if "reconfigure" in c)
             assert "jepsen.cas" in cmd
+
+
+class TestCrateDB:
+    """Crate node lifecycle (crate/core.clj:278-377)."""
+
+    def test_setup_writes_majority_config(self):
+        from jepsen_tpu.suites.sql_family import CrateDB, crate_majority
+        assert crate_majority(5) == 3 and crate_majority(4) == 3
+        t = dummy_test(**{"nodes": ["n1", "n2", "n3", "n4", "n5"],
+                          "ssh": {"mode": "dummy", "dummy-responses": {
+                              "stat ": (1, "", "nope"),
+                              "ls -A": "crate-0.57.2",
+                              "dirname": "/opt"}}})
+        with control.session_pool(t):
+            CrateDB(tarball="http://x/crate.tar.gz").setup(t, "n1")
+            cmds = logs(t)["n1"]
+            conf = next(c for c in cmds if "crate.yml" in c)
+            assert "minimum_master_nodes: 3" in conf
+            assert '"n1:44300"' in conf and '"n5:44300"' in conf
+            assert any("vm.max_map_count" in c for c in cmds)
+            assert any("bin/crate" in c for c in cmds)
+
+    def test_teardown_kills_and_wipes(self):
+        from jepsen_tpu.suites.sql_family import CrateDB
+        t = dummy_test(**{"nodes": ["n1"],
+                          "ssh": {"mode": "dummy", "dummy-responses": {}}})
+        with control.session_pool(t):
+            CrateDB().teardown(t, "n1")
+            cmds = logs(t)["n1"]
+            assert any("crate" in c and ("kill" in c or "pkill" in c)
+                       for c in cmds)
+            assert any("rm -rf" in c and "data" in c for c in cmds)
