@@ -1,0 +1,48 @@
+"""Mesh/multi-host helpers (jepsen_tpu.parallel) on the virtual 8-device
+CPU mesh from conftest."""
+
+import random
+
+from jepsen_tpu import parallel
+from jepsen_tpu.models import CASRegister
+
+from test_checker_tpu import random_register_history
+
+
+class TestMesh:
+    def test_make_mesh_all_devices(self):
+        mesh = parallel.make_mesh()
+        assert dict(mesh.shape) == {"keys": parallel.device_count()}
+
+    def test_make_mesh_subset_and_overflow(self):
+        import pytest
+        mesh = parallel.make_mesh(4)
+        assert dict(mesh.shape) == {"keys": 4}
+        with pytest.raises(ValueError):
+            parallel.make_mesh(parallel.device_count() + 1)
+
+    def test_shardings(self):
+        mesh = parallel.make_mesh(2)
+        s = parallel.keyed_sharding(mesh)
+        assert s.spec == ("keys",) or tuple(s.spec) == ("keys",)
+        r = parallel.replicated_sharding(mesh)
+        assert tuple(r.spec) == ()
+
+
+class TestMultihost:
+    def test_initialize_skips_without_coordinator(self, monkeypatch):
+        monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+        monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+        assert parallel.initialize_multihost() is False
+
+
+class TestDistributedCheck:
+    def test_keyed_check_over_auto_mesh(self):
+        rng = random.Random(5)
+        keyed = {k: random_register_history(rng, n_procs=3, n_ops=8,
+                                            n_vals=3)
+                 for k in range(16)}
+        out = parallel.check_keyed_distributed(keyed, CASRegister())
+        assert out["backend"] == "tpu"
+        assert set(out["results"]) == set(keyed)
+        assert out["valid"] in (True, False)
