@@ -305,6 +305,8 @@ class LinearizableChecker(Checker):
                when the model has no integer kernel.
     algorithm (the host-search algorithm — reference checker.clj:85-94
     selects knossos :competition | :linear | :wgl the same way):
+      'auto'         — (default) 'native' when the C++ engine compiled
+                       on this host, else 'wgl'
       'wgl'          — Wing-Gong-Lowe frontier search (this module)
       'linear'       — just-in-time linearization (checker.jitlin)
       'native'       — the C++ WGL engine (checker.native); falls back
@@ -317,9 +319,18 @@ class LinearizableChecker(Checker):
 
     def __init__(self, model: Optional[Model] = None, backend: str = "cpu",
                  max_configs: Optional[int] = None,
-                 algorithm: str = "wgl"):
-        if algorithm not in ("wgl", "linear", "native", "competition"):
+                 algorithm: str = "auto"):
+        if algorithm not in ("auto", "wgl", "linear", "native",
+                             "competition"):
             raise ValueError(f"unknown algorithm {algorithm!r}")
+        if algorithm == "auto":
+            # the C++ engine returns identical verdicts AND identical
+            # explored-config counts (same search order), so when it
+            # compiled on this host it is a pure speedup; its UNKNOWNs
+            # (window overflow, no integer encoding) fall back to the
+            # Python search below
+            from jepsen_tpu.checker import native as native_mod
+            algorithm = "native" if native_mod.available() else "wgl"
         self.model = model
         self.backend = backend
         self.max_configs = max_configs
