@@ -436,6 +436,10 @@ class LinearizableChecker(Checker):
                 # knossos :final-paths equivalent (one concrete maximal
                 # linearization order, checker.clj:104-107)
                 out["final-path"] = a["final-path"]
+            if a.get("frontier-states"):
+                # knossos :configs equivalent — the reachable frontier
+                # model states, truncated to 10 like checker.clj:104-107
+                out["configs"] = a["frontier-states"][:10]
         except Exception as e:  # noqa: BLE001
             out["counterexample-error"] = repr(e)
 

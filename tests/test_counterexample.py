@@ -110,6 +110,8 @@ class TestAnalysis:
         out = linearizable(CASRegister()).check(test, _failing_history())
         assert out["valid"] is False
         assert out["final-path"]          # e.g. ['write 1', 'cas (1, 2)']
+        # knossos :configs equivalent, truncated to 10 (checker.clj:104-107)
+        assert out["configs"] and len(out["configs"]) <= 10
         svg = (tmp_path / "linear.svg").read_text()
         assert "maximal path" in svg
 
