@@ -4,8 +4,19 @@ Rebuild of jepsen.nemesis.time (jepsen/src/jepsen/nemesis/time.clj): the
 precision clock faults (one-shot bumps, monotonic-anchored strobes) need
 real syscalls and must run even when the node's package manager is broken,
 so they stay tiny native binaries (resources/bump_time.cc,
-strobe_time.cc) uploaded and compiled *on the DB node* with the system
-compiler (time.clj:11-27), then invoked over the control plane.
+strobe_time.cc, adj_time.cc) uploaded and compiled *on the DB node* with
+the system compiler (time.clj:11-27), then invoked over the control
+plane.
+
+Inventory note: the reference also ships strobe-time-experiment.c
+(jepsen/resources/strobe-time-experiment.c, 205 LoC) — an earlier
+prototype of the SAME monotonic-anchored strobe algorithm that does not
+compile as written (`int64_t nanos timespec_to_nanos(...)` at :30,
+`null` at :145). Its working idea — alternate wall = monotonic + offset
+/ + offset + delta from a single anchor, restore, print the adjustment
+count — is exactly what resources/strobe_time.cc implements, so the
+experiment is deliberately subsumed rather than rebuilt as a second
+binary.
 """
 
 from __future__ import annotations

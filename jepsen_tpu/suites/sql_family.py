@@ -224,9 +224,10 @@ def tidb_tests(opts: dict) -> List[dict]:
         "tidb-sets": tidb_sets_test,
     }
     tests = []
+    pairs = cr.nemesis_product(names1, names2, registry=TIDB_NEMESES) \
+        or [(names1[0], names2[0])]  # e.g. none x none: one blank run
     for w in workloads:
-        for n1, n2 in cr.nemesis_product(names1, names2,
-                                         registry=TIDB_NEMESES):
+        for n1, n2 in pairs:
             pair = [TIDB_NEMESES[n1](), TIDB_NEMESES[n2]()]
             merged = cr.compose_nemeses([m for m in pair
                                          if m["name"] != "blank"]
@@ -851,3 +852,31 @@ def _cycle():
         yield gen.once({"type": "info", "f": "start"})
         yield gen.sleep(5)
         yield gen.once({"type": "info", "f": "stop"})
+
+
+def tidb_main(argv=None):
+    """TiDB runner (tidb/core.clj:95-126 test-cmd): --workload,
+    --nemesis/--nemesis2 name lists expanding to the composed product
+    matrix; the FIRST matrix point runs per invocation (loop via
+    --test-count like the reference's doseq)."""
+    from jepsen_tpu import cli
+    from jepsen_tpu.suites import cockroachdb as cr
+
+    def opt_spec(p):
+        p.add_argument("--workload", default="tidb",
+                       choices=sorted(TIDB_WORKLOADS))
+        p.add_argument("--nemesis", action="append", default=None,
+                       choices=sorted(TIDB_NEMESES))
+        p.add_argument("--nemesis2", action="append", default=None,
+                       choices=sorted(TIDB_NEMESES))
+
+    def test_fn(opts):
+        n1s = opts.get("nemesis") or ["none"]
+        n2s = opts.get("nemesis2") or ["none"]
+        ts = tidb_tests({**opts, "nemeses": n1s, "nemeses2": n2s,
+                         "workloads": [opts.get("workload", "tidb")]})
+        return ts[0]
+
+    cli.main(cli.merge_commands(
+        cli.single_test_cmd(test_fn, opt_spec=opt_spec),
+        cli.serve_cmd()), argv)

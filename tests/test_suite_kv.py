@@ -536,3 +536,19 @@ class TestTiDBNemesisMatrix:
         from jepsen_tpu.suites.sql_family import tidb_nemesis_double_gen
         g = tidb_nemesis_double_gen()
         assert g["during"] is not None and g["final"] is not None
+
+    def test_matrix_none_x_none_is_one_blank_run(self):
+        from jepsen_tpu.suites.sql_family import tidb_tests
+        ts = tidb_tests({"nemeses": ["none"], "nemeses2": ["none"],
+                         "workloads": ["tidb"]})
+        assert len(ts) == 1
+        assert ts[0]["name"] == "tidb-bank-blank"
+
+    def test_cli_builds_first_matrix_point(self, tmp_path, capsys):
+        import pytest as _pytest
+        from jepsen_tpu.suites.sql_family import tidb_main
+        # --help smoke: opt spec wires workload + nemesis choices
+        with _pytest.raises(SystemExit):
+            tidb_main(["test", "--help"])
+        out = capsys.readouterr().out
+        assert "--workload" in out and "--nemesis2" in out
