@@ -175,6 +175,32 @@ def serve_cmd() -> dict:
     return {"serve": {"parser": build_parser, "run": run}}
 
 
+def suite_run_cmd() -> dict:
+    """The 'run' subcommand: run any registered suite by name — possible
+    here because all suites live in one package (the reference spreads
+    them over separate leiningen projects, each with its own -main)."""
+
+    def build_parser():
+        from jepsen_tpu import suites
+        p = Parser(prog="run", description="Run a registered suite.")
+        p.add_argument("--suite", required=True,
+                       choices=sorted(suites.SUITES))
+        add_test_opts(p)
+        return p
+
+    def run_(opts) -> int:
+        from jepsen_tpu import core, suites
+        ctor = suites.registry(strict=True)[opts.pop("suite")]
+        for _ in range(opts.get("test-count", 1)):
+            test = core.run(ctor(dict(opts)))
+            if test["results"].get("valid") is not True:
+                return TEST_FAILED
+        return OK
+
+    return {"run": {"parser": build_parser, "opt_fn": test_opt_fn,
+                    "run": run_}}
+
+
 def merge_commands(*cmds: dict) -> dict:
     out: Dict[str, dict] = {}
     for c in cmds:
@@ -221,5 +247,5 @@ def main(subcommands: Dict[str, dict],
     sys.exit(run(subcommands, argv if argv is not None else sys.argv[1:]))
 
 
-if __name__ == "__main__":  # default main: the results server (cli.clj -main)
-    main(serve_cmd())
+if __name__ == "__main__":  # default main: suite runner + results server
+    main(merge_commands(suite_run_cmd(), serve_cmd()))

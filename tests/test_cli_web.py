@@ -176,3 +176,27 @@ class TestWeb:
             assert ei.value.code in (403, 404)
         finally:
             server.shutdown()
+
+
+class TestSuiteRunCmd:
+    """The generic 'run --suite <name>' subcommand."""
+
+    def test_registered_suites_are_choices(self, capsys):
+        from jepsen_tpu import cli, suites
+        rc = cli.run(cli.suite_run_cmd(), ["run", "--help"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "--suite" in out and "etcd" in out
+
+    def test_unknown_suite_exits_254(self, capsys):
+        from jepsen_tpu import cli
+        rc = cli.run(cli.suite_run_cmd(), ["run", "--suite", "bogus"])
+        assert rc == cli.INVALID_ARGS
+
+    def test_default_main_lists_run_and_serve(self, capsys):
+        from jepsen_tpu import cli
+        rc = cli.run(cli.merge_commands(cli.suite_run_cmd(),
+                                        cli.serve_cmd()), [])
+        assert rc == cli.INVALID_ARGS
+        out = capsys.readouterr().out
+        assert "run" in out and "serve" in out
