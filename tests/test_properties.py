@@ -1,0 +1,145 @@
+"""Property-based tests (hypothesis): semantic invariances the checkers
+must satisfy on EVERY history, not just the golden ones.
+
+The native engine makes these affordable — each verdict is sub-ms, so
+hypothesis can push hundreds of structured histories through invariance
+checks that would be minutes on the Python search.
+"""
+
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from jepsen_tpu.checker import UNKNOWN
+from jepsen_tpu.checker.native import available, check_history_native
+from jepsen_tpu.checker.wgl import check_model
+from jepsen_tpu.history import History, Op
+from jepsen_tpu.models import CASRegister
+
+pytestmark = pytest.mark.skipif(
+    not available(), reason="native engine unavailable (no g++?)")
+
+
+# ---------------------------------------------------------------------------
+# History strategy: well-formed concurrent register histories
+# ---------------------------------------------------------------------------
+
+N_PROCS = 4
+N_VALS = 3
+
+
+@st.composite
+def register_histories(draw, min_ops=2, max_ops=14):
+    """A well-formed concurrent history: invokes only on free processes,
+    completions only for open invocations, ok/fail/info all possible."""
+    n_ops = draw(st.integers(min_ops, max_ops))
+    rows, open_ops = [], {}
+    t = 0
+    budget = n_ops
+    while budget > 0 or open_ops:
+        can_invoke = budget > 0 and len(open_ops) < N_PROCS
+        do_invoke = can_invoke and (not open_ops
+                                    or draw(st.booleans()))
+        if do_invoke:
+            p = draw(st.sampled_from(
+                [q for q in range(N_PROCS) if q not in open_ops]))
+            f = draw(st.sampled_from(["read", "write", "cas"]))
+            if f == "read":
+                v = None
+            elif f == "write":
+                v = draw(st.integers(0, N_VALS - 1))
+            else:
+                v = (draw(st.integers(0, N_VALS - 1)),
+                     draw(st.integers(0, N_VALS - 1)))
+            op = Op(type="invoke", f=f, value=v, process=p, time=t)
+            rows.append(op)
+            open_ops[p] = op
+            budget -= 1
+        else:
+            p = draw(st.sampled_from(sorted(open_ops)))
+            inv = open_ops.pop(p)
+            kind = draw(st.sampled_from(["ok", "ok", "fail", "info"]))
+            v = inv.value
+            if kind == "ok" and inv.f == "read":
+                v = draw(st.one_of(st.none(),
+                                   st.integers(0, N_VALS - 1)))
+            rows.append(Op(type=kind, f=inv.f, value=v, process=p,
+                           time=t))
+        t += 1
+    return History(rows)
+
+
+def verdict(h):
+    v = check_history_native(h, CASRegister())["valid"]
+    assert v is not UNKNOWN
+    return v
+
+
+# ---------------------------------------------------------------------------
+# Invariances
+# ---------------------------------------------------------------------------
+
+
+class TestVerdictInvariances:
+    @settings(max_examples=120, deadline=None)
+    @given(register_histories())
+    def test_native_matches_python_oracle(self, h):
+        assert verdict(h) is check_model(h, CASRegister())["valid"]
+
+    @settings(max_examples=80, deadline=None)
+    @given(register_histories(), st.randoms())
+    def test_process_renaming_preserves_verdict(self, h, rng):
+        """Process ids are labels: any bijective renaming leaves the
+        real-time partial order (and so the verdict) unchanged."""
+        perm = list(range(N_PROCS))
+        rng.shuffle(perm)
+        h2 = History([o.replace(process=perm[o.process]) for o in h])
+        assert verdict(h2) is verdict(h)
+
+    @settings(max_examples=80, deadline=None)
+    @given(register_histories())
+    def test_removing_failed_pairs_preserves_verdict(self, h):
+        """A fail completion asserts the op did NOT happen; the pair
+        contributes nothing to the model and drops from the search."""
+        if not any(o.is_fail for o in h):
+            return
+        # drop each fail completion AND its matching invocation
+        open_inv = {}
+        keep = []
+        for o in h:
+            if o.is_invoke:
+                open_inv[o.process] = o
+                keep.append(o)
+            elif o.is_fail:
+                inv = open_inv.pop(o.process)
+                keep.remove(inv)
+            else:
+                open_inv.pop(o.process, None)
+                keep.append(o)
+        assert verdict(History(keep)) is verdict(h)
+
+    @settings(max_examples=80, deadline=None)
+    @given(register_histories(), st.integers(0, N_VALS - 1))
+    def test_adding_crashed_write_keeps_valid_valid(self, h, v):
+        """A crashed (info) op MAY be linearized or not — pure extra
+        freedom, so it can never invalidate a valid history."""
+        if verdict(h) is not True:
+            return
+        free = [p for p in range(10, 14)]
+        extra = Op(type="invoke", f="write", value=v, process=free[0],
+                   time=-1)
+        crash = Op(type="info", f="write", value=v, process=free[0],
+                   time=10**9)
+        h2 = History([extra, *h, crash])
+        assert verdict(h2) is True
+
+    @settings(max_examples=60, deadline=None)
+    @given(register_histories())
+    def test_double_history_concatenation_never_unknown(self, h):
+        """Sequential self-concatenation (fresh processes for the second
+        copy) must still produce a definitive verdict."""
+        shift = max((o.time for o in h), default=0) + 1
+        second = [o.replace(process=o.process + N_PROCS,
+                            time=o.time + shift) for o in h]
+        v = check_history_native(History([*h, *second]),
+                                 CASRegister())["valid"]
+        assert v is not UNKNOWN
