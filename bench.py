@@ -212,6 +212,47 @@ def _secondary_metrics():
           f"levels={r.get('levels')} in {_t.time()-t0:.2f}s "
           f"(incl. compile)", file=sys.stderr)
 
+    # configs 1/3/4: the CPU-tier baselines — 200-op linearizable via
+    # the host facade, and the counter/set/total-queue folds at 10k ops
+    from jepsen_tpu.checker import linearizable
+    from jepsen_tpu.checker.basic import counter, set_checker, total_queue
+    from jepsen_tpu.models import CASRegister as _Reg
+
+    h200 = simulate_register_history(200, n_procs=5, n_vals=8, seed=11)
+    t0 = _t.time()
+    r1 = linearizable(_Reg()).check({}, h200)
+    print(f"# secondary: 200-op linearizable (host facade): {r1['valid']} "
+          f"[{r1.get('engine', 'py')}] in {_t.time()-t0:.3f}s",
+          file=sys.stderr)
+
+    rows = []
+    t = 0
+    for v in range(5000):
+        rows.append(Op(type="invoke", f="add", value=1, process=v % 5,
+                       time=t)); t += 1
+        rows.append(Op(type="ok", f="add", value=1, process=v % 5,
+                       time=t)); t += 1
+    rows.append(Op(type="invoke", f="read", value=None, process=7, time=t))
+    rows.append(Op(type="ok", f="read", value=5000, process=7, time=t + 1))
+    t0 = _t.time()
+    rc = counter().check({}, History.of(rows))
+    print(f"# secondary: 10k-op counter fold: {rc['valid']} in "
+          f"{_t.time()-t0:.3f}s", file=sys.stderr)
+
+    rows = []
+    t = 0
+    for v in range(5000):
+        for f in ("enqueue", "dequeue"):
+            rows.append(Op(type="invoke", f=f, value=v,
+                           process=0 if f == "enqueue" else 1, time=t))
+            rows.append(Op(type="ok", f=f, value=v,
+                           process=0 if f == "enqueue" else 1, time=t + 1))
+            t += 2
+    t0 = _t.time()
+    rt = total_queue().check({}, History.of(rows))
+    print(f"# secondary: 10k-op total-queue fold: {rt['valid']} in "
+          f"{_t.time()-t0:.3f}s", file=sys.stderr)
+
     # host-side native engine (C++ WGL twin): the same verdicts with
     # zero compile cost — the framework's single-history CPU path
     from jepsen_tpu.checker.native import (

@@ -446,5 +446,5 @@ class LinearizableChecker(Checker):
 
 def linearizable(model: Optional[Model] = None, backend: str = "cpu",
                  max_configs: Optional[int] = None,
-                 algorithm: str = "wgl") -> LinearizableChecker:
+                 algorithm: str = "auto") -> LinearizableChecker:
     return LinearizableChecker(model, backend, max_configs, algorithm)
