@@ -48,7 +48,7 @@ def _lib():
         if lib is not None:
             try:
                 lib.jepsen_wgl_abi_version.restype = ctypes.c_int64
-                if lib.jepsen_wgl_abi_version() != 1:
+                if lib.jepsen_wgl_abi_version() != 2:
                     lib = None  # stale cached .so from an older ABI
             except AttributeError:
                 lib = None
@@ -56,7 +56,7 @@ def _lib():
             lib.jepsen_wgl_check.restype = ctypes.c_int64
             lib.jepsen_wgl_check.argtypes = [
                 ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
-                ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_int32,
                 ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
                 ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
                 ctypes.POINTER(ctypes.c_int32),
@@ -117,10 +117,19 @@ def check_packed_native(p: PackedHistory, kernel: KernelSpec,
         watcher = threading.Thread(target=_watch, daemon=True)
         watcher.start()
     try:
-        status = lib.jepsen_wgl_check(
-            kid, int(p.init_state), p.n, p.n_required, *ptrs,
-            0 if max_configs is None else int(max_configs),
-            ctypes.pointer(stop_flag), out)
+        # Window escalation: start at the 128-offset masks every realistic
+        # history fits, widen to 256/512 on overflow (wider configs cost
+        # hash/equality time, so narrow histories must not pay for them).
+        # >128 crashed ops overflow the separate crash mask — wider
+        # windows can't fix that, so don't escalate for it.
+        mask_ladder = ((2,) if p.n - p.n_required > 128 else (2, 4, 8))
+        for mw in mask_ladder:
+            status = lib.jepsen_wgl_check(
+                kid, mw, int(p.init_state), p.n, p.n_required, *ptrs,
+                0 if max_configs is None else int(max_configs),
+                ctypes.pointer(stop_flag), out)
+            if status != _WINDOW:
+                break
     finally:
         stop_watcher.set()
         if watcher is not None:
@@ -148,7 +157,7 @@ def check_packed_native(p: PackedHistory, kernel: KernelSpec,
     if status == _WINDOW:
         return {"valid": UNKNOWN, "engine": "native",
                 "error": "candidate window exceeds the native engine's "
-                         "128-offset masks",
+                         "widest (512-offset) masks, or >128 crashed ops",
                 "configs-explored": explored}
     if status == _CANCELLED:
         return {"valid": UNKNOWN, "engine": "native",

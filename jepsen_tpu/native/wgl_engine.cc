@@ -14,12 +14,15 @@
 //
 // Representation notes (equivalent to the Python search, not identical):
 // * the Python mask is one arbitrary-precision int over offsets j-k for
-//   required AND crashed ops; here required offsets get a 128-bit window
-//   mask (m0,m1) and crashed ops a 128-bit absolute mask (c0,c1). The
-//   mapping is bijective, so the visited-set dedup matches 1:1.
-// * offsets past 128 (or >128 crashed ops) return UNKNOWN_WINDOW and the
-//   caller falls back to the unbounded Python search — mirroring how the
-//   device search reports window overflow.
+//   required AND crashed ops; here required offsets get a fixed-width
+//   window mask (Mask<MW>, window = 64*MW bits, MW in {2,4,8}) and
+//   crashed ops a 128-bit absolute mask (c0,c1). The mapping is
+//   bijective, so the visited-set dedup matches 1:1.
+// * offsets past the window (or >128 crashed ops) return UNKNOWN_WINDOW;
+//   the wrapper escalates MW 2 -> 4 -> 8 and only then falls back to the
+//   unbounded Python search — mirroring how the device search escalates
+//   on window overflow (and exceeding its 128 cap: MW=4/8 check shapes
+//   the device path can only answer with a found witness).
 //
 // Built on demand by jepsen_tpu/native/__init__.py (g++ -O2 -shared),
 // the same compile-on-use pattern as the on-node clock helpers
@@ -56,7 +59,6 @@ constexpr int64_t UNKNOWN_WINDOW = 3;
 constexpr int64_t BAD_KERNEL = 4;
 constexpr int64_t CANCELLED = 5;
 
-constexpr int WINDOW = 128;       // required-offset mask width (2x u64)
 constexpr int CRASH_WINDOW = 128; // crashed absolute mask width
 constexpr int FIFO_SLOTS = 7;
 
@@ -126,16 +128,59 @@ inline bool readonly_op(int32_t fc, int32_t v1, int32_t v2) {
 }
 
 // --- configuration + visited set -----------------------------------------
+//
+// The required-candidate mask is templated on its word count MW (window
+// = 64*MW offsets): MW=2 covers every realistic concurrency (and is
+// what the device search supports), MW=4/8 extend EXACT native checking
+// to 256/512-wide histories the device path can only answer with a
+// witness. The wrapper escalates MW on UNKNOWN_WINDOW, so narrow
+// histories never pay for wide configs.
 
+template <int MW>
+struct Mask {
+  uint64_t w[MW];
+
+  bool operator==(const Mask& o) const {
+    for (int i = 0; i < MW; ++i)
+      if (w[i] != o.w[i]) return false;
+    return true;
+  }
+  bool any() const {
+    uint64_t x = 0;
+    for (int i = 0; i < MW; ++i) x |= w[i];
+    return x != 0;
+  }
+  bool get(int off) const { return (w[off >> 6] >> (off & 63)) & 1; }
+  void set(int off) { w[off >> 6] |= 1ull << (off & 63); }
+  void orwith(const Mask& o) {
+    for (int i = 0; i < MW; ++i) w[i] |= o.w[i];
+  }
+  void shr1() {
+    for (int i = 0; i < MW - 1; ++i)
+      w[i] = (w[i] >> 1) | (w[i + 1] << 63);
+    w[MW - 1] >>= 1;
+  }
+  // Consume contiguous leading ones; returns how many were consumed.
+  int advance() {
+    int adv = 0;
+    while (w[0] & 1) {
+      shr1();
+      ++adv;
+    }
+    return adv;
+  }
+};
+
+template <int MW>
 struct Cfg {
   int32_t k;
   int32_t state;
-  uint64_t m0, m1;  // required-candidate mask, offsets j-k in [0,128)
-  uint64_t c0, c1;  // crashed mask, absolute index j-n_req in [0,128)
+  Mask<MW> m;          // required-candidate mask, offsets j-k
+  uint64_t c0, c1;     // crashed mask, absolute index j-n_req in [0,128)
 
   bool operator==(const Cfg& o) const {
-    return k == o.k && state == o.state && m0 == o.m0 && m1 == o.m1 &&
-           c0 == o.c0 && c1 == o.c1;
+    return k == o.k && state == o.state && m == o.m && c0 == o.c0 &&
+           c1 == o.c1;
   }
 };
 
@@ -146,21 +191,22 @@ inline uint64_t mix(uint64_t x) {
   return x ^ (x >> 31);
 }
 
-inline uint64_t cfg_hash(const Cfg& c) {
+template <int MW>
+inline uint64_t cfg_hash(const Cfg<MW>& c) {
   uint64_t h = mix((uint64_t(uint32_t(c.k)) << 32) | uint32_t(c.state));
-  h = mix(h ^ c.m0);
-  h = mix(h ^ c.m1);
+  for (int i = 0; i < MW; ++i) h = mix(h ^ c.m.w[i]);
   h = mix(h ^ c.c0);
   return mix(h ^ c.c1);
 }
 
 // Open-addressing visited set (linear probing, power-of-two capacity).
+template <int MW>
 class Seen {
  public:
   explicit Seen(size_t cap = 1 << 14) { rehash(cap); }
 
   // Insert; returns true if newly added.
-  bool add(const Cfg& c) {
+  bool add(const Cfg<MW>& c) {
     if ((count_ + 1) * 10 >= cap_ * 7) rehash(cap_ * 2);
     size_t i = cfg_hash(c) & (cap_ - 1);
     while (slots_[i].k != -1) {
@@ -174,11 +220,13 @@ class Seen {
 
  private:
   void rehash(size_t cap) {
-    std::vector<Cfg> old = std::move(slots_);
+    std::vector<Cfg<MW>> old = std::move(slots_);
     cap_ = cap;
-    slots_.assign(cap_, Cfg{-1, 0, 0, 0, 0, 0});
+    Cfg<MW> empty{};
+    empty.k = -1;
+    slots_.assign(cap_, empty);
     count_ = 0;
-    for (const Cfg& c : old)
+    for (const Cfg<MW>& c : old)
       if (c.k != -1) {
         size_t i = cfg_hash(c) & (cap_ - 1);
         while (slots_[i].k != -1) i = (i + 1) & (cap_ - 1);
@@ -187,54 +235,25 @@ class Seen {
       }
   }
 
-  std::vector<Cfg> slots_;
+  std::vector<Cfg<MW>> slots_;
   size_t cap_ = 0;
   size_t count_ = 0;
 };
 
-inline bool mask_get(uint64_t m0, uint64_t m1, int off) {
-  return off < 64 ? (m0 >> off) & 1 : (m1 >> (off - 64)) & 1;
-}
-
-inline void mask_set(uint64_t* m0, uint64_t* m1, int off) {
-  if (off < 64)
-    *m0 |= 1ull << off;
-  else
-    *m1 |= 1ull << (off - 64);
-}
-
-// Advance the frontier past contiguously-linearized offsets: consume
-// leading ones of (m0,m1), returning how many were consumed.
-inline int mask_advance(uint64_t* m0, uint64_t* m1) {
-  int adv = 0;
-  while (*m0 & 1) {
-    *m0 = (*m0 >> 1) | (*m1 << 63);
-    *m1 >>= 1;
-    ++adv;
-  }
-  return adv;
-}
-
-inline void mask_shr1(uint64_t* m0, uint64_t* m1) {
-  *m0 = (*m0 >> 1) | (*m1 << 63);
-  *m1 >>= 1;
-}
-
 struct Search {
   const int32_t *f, *v1, *v2, *inv, *ret;
   int32_t n, n_req;
+  int32_t init_state;
   uint64_t max_configs;
   const volatile uint8_t* stop;
 
-  std::vector<Cfg> stack;
-  Seen seen;
   uint64_t explored = 0;
   int32_t best_k = 0;
   int32_t best_states[16];
   int n_best = 0;
 
   // minv_suffix[j] = min(inv[j..n_req-1]); detects required candidates
-  // beyond the 128-offset window in O(1) per pop.
+  // beyond the representable window in O(1) per pop.
   std::vector<int32_t> minv_suffix;
 
   void note_best(int32_t k, int32_t state) {
@@ -250,60 +269,64 @@ struct Search {
   }
 };
 
-template <int K>
+template <int K, int MW>
 int64_t run(Search& S) {
+  constexpr int kWindow = 64 * MW;
   S.minv_suffix.assign(size_t(S.n_req) + 1, INT32_MAX);
   for (int32_t j = S.n_req - 1; j >= 0; --j)
     S.minv_suffix[j] = S.inv[j] < S.minv_suffix[j + 1] ? S.inv[j]
                                                        : S.minv_suffix[j + 1];
   if (S.n - S.n_req > CRASH_WINDOW) return UNKNOWN_WINDOW;
 
-  Cfg init{0, int32_t(0), 0, 0, 0, 0};
-  init.state = S.best_states[0];  // caller stashed init_state there
+  std::vector<Cfg<MW>> stack;
+  Seen<MW> seen;
+  Cfg<MW> init{};
+  init.state = S.init_state;
   S.note_best(0, init.state);
-  S.stack.push_back(init);
-  S.seen.add(init);
+  stack.push_back(init);
+  seen.add(init);
 
   // successor scratch: (j, s2) pairs for impure candidates
-  int32_t imp_j[WINDOW + CRASH_WINDOW];
-  int32_t imp_s[WINDOW + CRASH_WINDOW];
+  int32_t imp_j[kWindow + CRASH_WINDOW];
+  int32_t imp_s[kWindow + CRASH_WINDOW];
 
-  while (!S.stack.empty()) {
-    Cfg c = S.stack.back();
-    S.stack.pop_back();
+  while (!stack.empty()) {
+    Cfg<MW> c = stack.back();
+    stack.pop_back();
     ++S.explored;
     if (S.max_configs && S.explored > S.max_configs) return UNKNOWN_BUDGET;
     if (S.stop && (S.explored & 1023) == 0 && *S.stop) return CANCELLED;
 
     const int32_t rk = S.ret[c.k];
     // required candidates past the representable window?
-    if (c.k + WINDOW < S.n_req && S.minv_suffix[c.k + WINDOW] < rk)
+    if (c.k + kWindow < S.n_req && S.minv_suffix[c.k + kWindow] < rk)
       return UNKNOWN_WINDOW;
 
-    uint64_t p0 = 0, p1 = 0;  // pure closure mask
+    Mask<MW> pure{};
     int n_imp = 0;
     const int32_t jmax =
-        (S.n_req < c.k + WINDOW ? S.n_req : c.k + WINDOW);
+        (S.n_req < c.k + kWindow ? S.n_req : c.k + kWindow);
     for (int32_t j = c.k; j < jmax; ++j) {
       if (S.inv[j] >= rk) continue;
       const int off = j - c.k;
-      if (mask_get(c.m0, c.m1, off)) continue;
+      if (c.m.get(off)) continue;
       int32_t s2;
       if (!step<K>(c.state, S.f[j], S.v1[j], S.v2[j], &s2)) continue;
       if (readonly_op<K>(S.f[j], S.v1[j], S.v2[j]))
-        mask_set(&p0, &p1, off);
+        pure.set(off);
       else {
         imp_j[n_imp] = j;
         imp_s[n_imp++] = s2;
       }
     }
-    if (!(p0 | p1)) {
+    if (!pure.any()) {
       // crashed (optional) candidates, skipped entirely under a pure
       // closure — the closure successor ignores impure candidates too.
       for (int32_t j = S.n_req; j < S.n; ++j) {
         if (S.inv[j] >= rk) continue;
         const int coff = j - S.n_req;
-        if (mask_get(c.c0, c.c1, coff)) continue;
+        if ((coff < 64 ? (c.c0 >> coff) : (c.c1 >> (coff - 64))) & 1)
+          continue;
         int32_t s2;
         if (!step<K>(c.state, S.f[j], S.v1[j], S.v2[j], &s2)) continue;
         if (s2 == c.state) continue;  // no-effect crashed op: never take
@@ -312,34 +335,50 @@ int64_t run(Search& S) {
       }
     }
 
-    if (p0 | p1) {
-      Cfg s = c;
-      s.m0 |= p0;
-      s.m1 |= p1;
-      s.k += mask_advance(&s.m0, &s.m1);
+    if (pure.any()) {
+      Cfg<MW> s = c;
+      s.m.orwith(pure);
+      s.k += s.m.advance();
       S.note_best(s.k, s.state);
       if (s.k >= S.n_req) return VALID;
-      if (S.seen.add(s)) S.stack.push_back(s);
+      if (seen.add(s)) stack.push_back(s);
       continue;
     }
     for (int i = 0; i < n_imp; ++i) {
       const int32_t j = imp_j[i];
-      Cfg s = c;
+      Cfg<MW> s = c;
       s.state = imp_s[i];
       if (j >= S.n_req) {
-        mask_set(&s.c0, &s.c1, j - S.n_req);
+        const int coff = j - S.n_req;
+        if (coff < 64)
+          s.c0 |= 1ull << coff;
+        else
+          s.c1 |= 1ull << (coff - 64);
       } else if (j == c.k) {
-        mask_shr1(&s.m0, &s.m1);
-        s.k += 1 + mask_advance(&s.m0, &s.m1);
+        s.m.shr1();
+        s.k += 1 + s.m.advance();
       } else {
-        mask_set(&s.m0, &s.m1, j - c.k);
+        s.m.set(j - c.k);
       }
       S.note_best(s.k, s.state);
       if (s.k >= S.n_req) return VALID;
-      if (S.seen.add(s)) S.stack.push_back(s);
+      if (seen.add(s)) stack.push_back(s);
     }
   }
   return INVALID;
+}
+
+template <int MW>
+int64_t run_kernel(int32_t kernel_id, Search& S) {
+  switch (kernel_id) {
+    case KERNEL_CAS_REGISTER: return run<KERNEL_CAS_REGISTER, MW>(S);
+    case KERNEL_MUTEX: return run<KERNEL_MUTEX, MW>(S);
+    case KERNEL_NOOP: return run<KERNEL_NOOP, MW>(S);
+    case KERNEL_SET: return run<KERNEL_SET, MW>(S);
+    case KERNEL_UQUEUE: return run<KERNEL_UQUEUE, MW>(S);
+    case KERNEL_FIFO: return run<KERNEL_FIFO, MW>(S);
+    default: return BAD_KERNEL;
+  }
 }
 
 }  // namespace
@@ -347,9 +386,12 @@ int64_t run(Search& S) {
 extern "C" {
 
 // out: [explored, best_k, n_states, states[0..15]] (19 slots).
-// Returns VALID/INVALID/UNKNOWN_BUDGET/UNKNOWN_WINDOW/BAD_KERNEL/CANCELLED.
-int64_t jepsen_wgl_check(int32_t kernel_id, int32_t init_state, int32_t n,
-                         int32_t n_req, const int32_t* f, const int32_t* v1,
+// mask_words selects the required-offset window (64*mask_words): 2, 4,
+// or 8. Returns VALID/INVALID/UNKNOWN_BUDGET/UNKNOWN_WINDOW/BAD_KERNEL/
+// CANCELLED; on UNKNOWN_WINDOW the caller escalates mask_words.
+int64_t jepsen_wgl_check(int32_t kernel_id, int32_t mask_words,
+                         int32_t init_state, int32_t n, int32_t n_req,
+                         const int32_t* f, const int32_t* v1,
                          const int32_t* v2, const int32_t* inv,
                          const int32_t* ret, uint64_t max_configs,
                          const volatile uint8_t* stop, int64_t* out) {
@@ -361,18 +403,15 @@ int64_t jepsen_wgl_check(int32_t kernel_id, int32_t init_state, int32_t n,
   S.ret = ret;
   S.n = n;
   S.n_req = n_req;
+  S.init_state = init_state;
   S.max_configs = max_configs;
   S.stop = stop;
-  S.best_states[0] = init_state;  // run() reads the init state from here
 
   int64_t status;
-  switch (kernel_id) {
-    case KERNEL_CAS_REGISTER: status = run<KERNEL_CAS_REGISTER>(S); break;
-    case KERNEL_MUTEX: status = run<KERNEL_MUTEX>(S); break;
-    case KERNEL_NOOP: status = run<KERNEL_NOOP>(S); break;
-    case KERNEL_SET: status = run<KERNEL_SET>(S); break;
-    case KERNEL_UQUEUE: status = run<KERNEL_UQUEUE>(S); break;
-    case KERNEL_FIFO: status = run<KERNEL_FIFO>(S); break;
+  switch (mask_words) {
+    case 2: status = run_kernel<2>(kernel_id, S); break;
+    case 4: status = run_kernel<4>(kernel_id, S); break;
+    case 8: status = run_kernel<8>(kernel_id, S); break;
     default: return BAD_KERNEL;
   }
   out[0] = int64_t(S.explored);
@@ -384,6 +423,6 @@ int64_t jepsen_wgl_check(int32_t kernel_id, int32_t init_state, int32_t n,
 
 // ABI version, checked by checker/native.py before prototyping the entry
 // point — a stale cached .so from an older ABI is refused, not called.
-int64_t jepsen_wgl_abi_version(void) { return 1; }
+int64_t jepsen_wgl_abi_version(void) { return 2; }
 
 }  // extern "C"

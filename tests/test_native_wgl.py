@@ -125,10 +125,29 @@ class TestWideShapes:
         want = check_model(h, CASRegister())
         assert r["valid"] is want["valid"] is False
 
+    def test_wide_windows_escalate_exactly(self):
+        # 150/300 fully-overlapping ops: beyond the 128-offset tier (and
+        # beyond the device search's MAX_WINDOW) — the mask ladder
+        # escalates to the 256/512-bit tiers and still finds witnesses
+        for width in (150, 300):
+            h = wide_history(width, 1, seed=2)
+            r = check_history_native(h, CASRegister())
+            assert r["valid"] is True, (width, r)
+
+    def test_wide_window_exact_refutation(self):
+        # an exact refutation past width 128 is something the device
+        # path cannot produce (its masks cap at MAX_WINDOW=128); keep
+        # the write count low — refutation is exponential in fully-
+        # concurrent WRITES for any exact engine — while the candidate
+        # window still needs the 256-bit tier
+        bad = wide_history(150, 1, write_frac=0.05, seed=2, corrupt=True)
+        r = check_history_native(bad, CASRegister())
+        assert r["valid"] is False, r
+
     def test_window_overflow_goes_unknown(self):
-        # >128 fully-overlapping ops: candidate offsets exceed the fixed
-        # 128-bit masks; the engine must refuse, not answer wrongly
-        h = wide_history(150, 1, seed=2)
+        # >512 fully-overlapping ops: candidate offsets exceed even the
+        # widest mask tier; the engine must refuse, not answer wrongly
+        h = wide_history(600, 1, seed=2)
         r = check_history_native(h, CASRegister())
         assert r["valid"] is UNKNOWN
         assert "window" in r["error"]
