@@ -234,11 +234,24 @@ def robustirc_test(opts: dict) -> dict:
 
 class RethinkDB(db_ns.DB, db_ns.LogFiles):
     """rethinkdb.clj db: apt install, join flags, admin over the first
-    node."""
+    node; optional faketime wrapper around the binary
+    (rethinkdb.clj:33-50: each daemon start gets a random clock offset
+    and rate warp, the cheap way to run every node on a different
+    clock)."""
+
+    def __init__(self, faketime: bool = False):
+        self.faketime = faketime
 
     def setup(self, test, node):
+        import random as _r
+
+        from jepsen_tpu import faketime as ft
         from jepsen_tpu.os import debian
         debian.install(test, node, ["rethinkdb"])
+        if self.faketime:
+            ft.wrap(test, node, "/usr/bin/rethinkdb",
+                    init_offset=_r.randrange(100),
+                    rate=1 + _r.random() / 10)
         joins = " ".join(f"--join {n}:29015" for n in test["nodes"]
                          if n != node)
         cu.start_daemon(test, node, "/usr/bin/rethinkdb",
@@ -430,7 +443,7 @@ def rethinkdb_test(opts: dict) -> dict:
     test.update({
         "name": f"rethinkdb-write-{wa}-read-{rm}"
                 + ("-aggressive" if aggressive else ""),
-        "db": RethinkDB(),
+        "db": RethinkDB(faketime=opts.get("faketime", False)),
         "client": RethinkClient(write_acks=wa, read_mode=rm),
         "nemesis": (aggressive_reconfigure_nemesis() if aggressive
                     else reconfigure_nemesis()),

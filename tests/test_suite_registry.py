@@ -261,3 +261,25 @@ class TestCrateDB:
             assert any("crate" in c and ("kill" in c or "pkill" in c)
                        for c in cmds)
             assert any("rm -rf" in c and "data" in c for c in cmds)
+
+
+class TestRethinkFaketime:
+    def test_wrapper_installed_when_requested(self):
+        from jepsen_tpu.suites.small import RethinkDB
+        t = dummy_test(**{"nodes": ["n1"],
+                          "ssh": {"mode": "dummy", "dummy-responses": {
+                              "test -e": (1, "", "nope")}}})
+        with control.session_pool(t):
+            RethinkDB(faketime=True).setup(t, "n1")
+            cmds = logs(t)["n1"]
+            assert any("faketime" in c for c in cmds)
+            assert any("mv" in c and "/usr/bin/rethinkdb" in c
+                       for c in cmds)
+
+    def test_no_wrapper_by_default(self):
+        from jepsen_tpu.suites.small import RethinkDB
+        t = dummy_test(**{"nodes": ["n1"],
+                          "ssh": {"mode": "dummy", "dummy-responses": {}}})
+        with control.session_pool(t):
+            RethinkDB().setup(t, "n1")
+            assert not any("faketime" in c for c in logs(t)["n1"])
