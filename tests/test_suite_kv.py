@@ -552,3 +552,64 @@ class TestTiDBNemesisMatrix:
             tidb_main(["test", "--help"])
         out = capsys.readouterr().out
         assert "--workload" in out and "--nemesis2" in out
+
+    def test_double_gen_emits_interleaved_schedule(self, monkeypatch):
+        # drive the during-generator (sleeps stubbed) and check the
+        # interleave: start1, start2, stop1, stop2, then roles swapped
+        import jepsen_tpu.generator as gmod
+        monkeypatch.setattr(gmod, "_sleep", lambda dt: None)
+        from jepsen_tpu.history import NEMESIS
+        from jepsen_tpu.suites.sql_family import tidb_nemesis_double_gen
+        g = tidb_nemesis_double_gen()["during"]
+        fs = []
+        for _ in range(200):
+            op = g.op({"concurrency": 1, "nodes": ["n1"]}, NEMESIS)
+            if op is None:
+                continue
+            fs.append(op.f)
+            if len(fs) >= 8:
+                break
+        assert fs[:8] == ["start1", "start2", "stop1", "stop2",
+                          "start2", "start1", "stop2", "stop1"]
+
+
+class TestESPrimaries:
+    """primaries()/self_primaries() against a fake /_cluster/state."""
+
+    @pytest.fixture()
+    def fake_es(self):
+        class Handler(BaseHTTPRequestHandler):
+            states = {}
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):  # noqa: N802
+                body = json.dumps(self.states).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        yield Handler, f"127.0.0.1:{server.server_port}"
+        server.shutdown()
+
+    def test_primaries_reads_cluster_state(self, fake_es):
+        from jepsen_tpu.suites import elasticsearch as es
+        handler, addr = fake_es
+        handler.states = {
+            "master_node": "abc",
+            "nodes": {"abc": {"name": addr}},
+        }
+        got = es.primaries([addr])
+        # the node reports ITSELF as primary -> self-primary
+        assert got == {addr: addr}
+        assert es.self_primaries([addr]) == [addr]
+
+    def test_unreachable_node_reports_none(self):
+        from jepsen_tpu.suites import elasticsearch as es
+        got = es.primaries(["127.0.0.1:1"], timeout=0.3)
+        assert got == {"127.0.0.1:1": None}
+        assert es.self_primaries(["127.0.0.1:1"]) == []
