@@ -157,6 +157,37 @@ def run_etcd_lifecycle():
           os.path.join(d, "ssh-transcript.txt"))
 
 
+def run_wide_native():
+    """The aerospike 100-thread shape through the native engine: a
+    width-150 fully-overlapping register history (past the device
+    search's 128-offset masks) checked exactly — valid variant and a
+    refuted corrupt variant with its linear.svg."""
+    import json
+
+    from jepsen_tpu.checker.wgl import linearizable
+    from jepsen_tpu.models import CASRegister
+
+    import sys
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    from test_checker_tpu import wide_history
+
+    d = os.path.join(OUT, "wide-register-native")
+    os.makedirs(d, exist_ok=True)
+    out = {}
+    h = wide_history(150, 1, seed=2)
+    out["valid-variant"] = linearizable(CASRegister()).check(
+        {"store-dir": d}, h)
+    bad = wide_history(150, 1, write_frac=0.05, seed=2, corrupt=True)
+    out["corrupt-variant"] = linearizable(CASRegister()).check(
+        {"store-dir": d}, bad)
+    with open(os.path.join(d, "results.json"), "w") as fh:
+        json.dump(out, fh, indent=2, default=repr)
+    print("wide-register-native:",
+          out["valid-variant"]["valid"],
+          out["corrupt-variant"]["valid"],
+          f"(engine {out['valid-variant'].get('engine')})")
+
+
 if __name__ == "__main__":
     if os.path.isdir(OUT):
         shutil.rmtree(OUT)
@@ -164,4 +195,5 @@ if __name__ == "__main__":
     run_atom_cas()
     run_atom_cas_corrupted()
     run_etcd_lifecycle()
+    run_wide_native()
     print("artifacts under", OUT)

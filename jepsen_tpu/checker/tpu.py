@@ -643,8 +643,9 @@ def _result(done: bool, lossy: bool, wovf: bool, best_k: int, levels: int,
 #: reachable-space size, since unexpanded pool rows double as the
 #: backtrack stack; the readonly closure absorbs whole read runs per
 #: step, so a slim first rung decides most histories an order of
-#: magnitude faster than a wide one (10k-op flagship: 1.07s at 128/8 vs
-#: 9.9s at 1024/64 on the CPU backend, near-identical level counts).
+#: magnitude faster than a wide one (10k-op flagship on the CPU
+#: backend: 9.9s at 1024/64, 1.38s at 128/8, 0.62s at the 32/4 rung
+#: _capacity_ladder() picks there — near-identical level counts).
 #: Bigger rungs refute exhaustively (pool death with no
 #: truncation) or recover witnesses a slim pool greedily dropped; wider
 #: rungs exist for high-concurrency histories (host-side rung selection
