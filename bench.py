@@ -274,6 +274,17 @@ def _secondary_metrics():
         print(f"# secondary: native engine 50 keys x 200 ops: {nk}/50 "
               f"valid in {_t.time()-t0:.3f}s", file=sys.stderr)
 
+        # stretch: 100x the north star — 1M ops through the native
+        # engine (pack + search; the reference's checker "can take
+        # hours" at 1/100th of this)
+        h1m = simulate_register_history(1_000_000, n_procs=N_PROCS,
+                                        n_vals=16, seed=6,
+                                        crash_p=0.0001)
+        t0 = _t.time()
+        rn = check_history_native(h1m, CASRegister())
+        print(f"# secondary: native engine 1M-op: {rn['valid']} in "
+              f"{_t.time()-t0:.2f}s", file=sys.stderr)
+
 
 # ---------------------------------------------------------------------------
 # Orchestrator

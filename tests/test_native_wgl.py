@@ -277,3 +277,14 @@ class TestScale:
         dt = time.perf_counter() - t0
         assert r["valid"] is True
         assert dt < 5.0  # typically ~25 ms
+
+    def test_1m_ops(self):
+        import time
+        from jepsen_tpu.testing import simulate_register_history
+        h = simulate_register_history(1_000_000, n_procs=5, n_vals=16,
+                                      seed=6, crash_p=0.0001)
+        t0 = time.perf_counter()
+        r = check_history_native(h, CASRegister())
+        dt = time.perf_counter() - t0
+        assert r["valid"] is True
+        assert dt < 60.0  # typically ~3.5 s (pack + search)
