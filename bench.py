@@ -368,6 +368,16 @@ def main() -> int:
                 notes.append(note2)
                 if rec2 is not None and rec2.get("cold_s") is not None:
                     extras["cached_cold_s"] = rec2["cold_s"]
+            # CPU comparison line: the same measurement on the host
+            # backend, so a TPU run still records both platforms.
+            remaining = deadline - time.time()
+            if remaining > 150:
+                rec3, note3 = _run_child(
+                    "cpu", min(240.0, remaining - 60), skip_secondary=True)
+                notes.append(note3)
+                if rec3 is not None and rec3.get("value") is not None:
+                    extras["cpu_warm_s"] = rec3["value"]
+                    extras["cpu_cold_s"] = rec3.get("cold_s")
             emit(rec["value"], rec["vs_baseline"],
                  platform=rec.get("platform", "tpu"), **extras)
             return 0

@@ -21,6 +21,9 @@ Layer map (mirrors SURVEY.md §1, TPU-first):
 - jepsen_tpu.control                       — SSH control plane (+ dummy mode)
 - jepsen_tpu.independent                   — keyed data-parallel lifting (the
   axis the TPU checker shards across chips)
+- jepsen_tpu.parallel                      — device-mesh + multi-host helpers
+- jepsen_tpu.native                        — host-side C++ components compiled
+  on demand (the native linearizability engine)
 - jepsen_tpu.store / cli / web             — persistence, runner, browser
 """
 
