@@ -242,6 +242,21 @@ def _secondary_metrics():
     rows = []
     t = 0
     for v in range(5000):
+        rows.append(Op(type="invoke", f="add", value=v, process=v % 5,
+                       time=t)); t += 1
+        rows.append(Op(type="ok", f="add", value=v, process=v % 5,
+                       time=t)); t += 1
+    rows.append(Op(type="invoke", f="read", value=None, process=7, time=t))
+    rows.append(Op(type="ok", f="read", value=sorted(range(5000)),
+                   process=7, time=t + 1))
+    t0 = _t.time()
+    rs2 = set_checker().check({}, History.of(rows))
+    print(f"# secondary: 10k-op set fold: {rs2['valid']} in "
+          f"{_t.time()-t0:.3f}s", file=sys.stderr)
+
+    rows = []
+    t = 0
+    for v in range(5000):
         for f in ("enqueue", "dequeue"):
             rows.append(Op(type="invoke", f=f, value=v,
                            process=0 if f == "enqueue" else 1, time=t))

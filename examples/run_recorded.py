@@ -166,10 +166,7 @@ def run_wide_native():
 
     from jepsen_tpu.checker.wgl import linearizable
     from jepsen_tpu.models import CASRegister
-
-    import sys
-    sys.path.insert(0, os.path.join(REPO, "tests"))
-    from test_checker_tpu import wide_history
+    from jepsen_tpu.testing import wide_history
 
     d = os.path.join(OUT, "wide-register-native")
     os.makedirs(d, exist_ok=True)

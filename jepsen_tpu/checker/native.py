@@ -121,21 +121,31 @@ def check_packed_native(p: PackedHistory, kernel: KernelSpec,
         # history fits, widen to 256/512 on overflow (wider configs cost
         # hash/equality time, so narrow histories must not pay for them).
         # >128 crashed ops overflow the separate crash mask — wider
-        # windows can't fix that, so don't escalate for it.
+        # windows can't fix that, so don't escalate for it. One config
+        # budget is shared ACROSS tiers: a tier that burned B configs
+        # before overflowing leaves max_configs - B for the next, so the
+        # caller's cap bounds total work, and the reported
+        # configs-explored is the across-tier total.
         mask_ladder = ((2,) if p.n - p.n_required > 128 else (2, 4, 8))
+        spent = 0
         for mw in mask_ladder:
+            budget = (0 if max_configs is None
+                      else max(1, int(max_configs) - spent))
             status = lib.jepsen_wgl_check(
                 kid, mw, int(p.init_state), p.n, p.n_required, *ptrs,
-                0 if max_configs is None else int(max_configs),
-                ctypes.pointer(stop_flag), out)
+                budget, ctypes.pointer(stop_flag), out)
+            spent += int(out[0])
             if status != _WINDOW:
+                break
+            if max_configs is not None and spent >= int(max_configs):
+                status = _BUDGET
                 break
     finally:
         stop_watcher.set()
         if watcher is not None:
             watcher.join(timeout=1.0)
 
-    explored = int(out[0])
+    explored = spent
     best_k = int(out[1])
     if status == _VALID:
         return {"valid": True, "configs-explored": explored,

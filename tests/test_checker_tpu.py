@@ -20,6 +20,7 @@ from jepsen_tpu.history import History, Op
 from jepsen_tpu.models import CASRegister, Mutex
 from jepsen_tpu.models.core import CAS_REGISTER_KERNEL, MUTEX_KERNEL
 from jepsen_tpu.ops import pack_history
+from jepsen_tpu.testing import wide_history
 
 from test_linearizable import H, random_register_history
 
@@ -470,53 +471,8 @@ class TestUnorderedQueueKernel:
         assert r["backend"] == "tpu"
 
 
-def wide_history(n_procs=100, rounds=2, write_frac=0.12, seed=0,
-                 corrupt=False):
-    """Rounds of n_procs fully-overlapping ops against one register:
-    every op of a round is invoked before any completes, so candidate
-    offsets reach ~n_procs-1 and the device search NEEDS a multi-word
-    window (the aerospike 100-thread shape, reference
-    aerospike/src/aerospike/core.clj:566-575). Read-heavy with unique
-    write values keeps the witness value-chain-constrained — wide but
-    tractable, like real high-concurrency workloads. Linearizable by
-    construction unless ``corrupt``."""
-    rng = random.Random(seed)
-    h = History()
-    value = None
-    t = 0
-    nextv = 0
-    for _ in range(rounds):
-        ops = []
-        for p in range(n_procs):
-            if rng.random() < write_frac:
-                f, v = "write", nextv
-                nextv += 1
-            else:
-                f, v = "read", None
-            h.append(Op(type="invoke", f=f, value=v, process=p, time=t))
-            t += 1
-            ops.append((p, f, v))
-        rng.shuffle(ops)                   # commit order
-        comps = []
-        for p, f, v in ops:
-            if f == "write":
-                value = v
-                comps.append((p, "ok", f, v))
-            else:
-                comps.append((p, "ok", f, value))
-        rng.shuffle(comps)                 # return order, independent
-        for p, typ, f, v in comps:
-            h.append(Op(type=typ, f=f, value=v, process=p, time=t))
-            t += 1
-    if corrupt:
-        rows = list(h)
-        for i in range(len(rows) - 1, -1, -1):
-            o = rows[i]
-            if o.type == "ok" and o.f == "read":
-                rows[i] = o.replace(value=10**6)   # never-written value
-                break
-        h = History.of(rows)
-    return h
+# wide_history now lives in jepsen_tpu.testing (shared
+# with examples/bench); re-exported here for importers.
 
 
 class TestWideShapes:
