@@ -31,7 +31,7 @@ import time
 REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
 
-N_OPS = 10_000
+N_OPS = int(os.environ.get("JEPSEN_BENCH_N_OPS", "10000"))
 N_PROCS = 5
 TARGET_S = 60.0
 METRIC = "cas-register-10k-op-linearize"

@@ -1,0 +1,45 @@
+"""The driver contract: bench.py prints exactly one JSON line with the
+required keys, and the multichip dryrun entry runs on the virtual mesh.
+A broken bench records nothing for the round, so it gets its own test."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestBenchContract:
+    def test_cpu_child_emits_one_json_line(self):
+        env = dict(os.environ)
+        env.update({
+            "JEPSEN_BENCH_CHILD": "cpu",
+            "JEPSEN_BENCH_N_OPS": "300",      # tiny: contract, not perf
+            "JEPSEN_BENCH_SKIP_SECONDARY": "1",
+            "JAX_PLATFORMS": "cpu",
+        })
+        pr = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            env=env, capture_output=True, text=True, timeout=300)
+        lines = [ln for ln in pr.stdout.splitlines() if ln.strip()]
+        assert len(lines) == 1, pr.stdout + pr.stderr[-500:]
+        rec = json.loads(lines[0])
+        assert rec["metric"] == "cas-register-10k-op-linearize"
+        assert rec["unit"] == "s"
+        assert isinstance(rec["value"], (int, float))
+        assert rec["vs_baseline"] > 0
+        assert "cold_s" in rec
+        assert pr.returncode == 0
+
+    def test_graft_entry_compiles_single_device(self):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        pr = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.config.update('jax_platforms', 'cpu'); "
+             "import __graft_entry__ as g; fn, args = g.entry(); "
+             "print(jax.jit(fn)(*args))"],
+            env=env, cwd=REPO, capture_output=True, text=True,
+            timeout=300)
+        assert pr.returncode == 0, pr.stderr[-800:]
