@@ -40,6 +40,70 @@ from jepsen_tpu.testing import noop_test
 # ---------------------------------------------------------------------------
 
 
+LOGCABIN_CONF = "/root/logcabin.conf"
+LOGCABIN_BIN = "/root/LogCabin"
+LOGCABIN_LOG = "/root/logcabin.log"
+LOGCABIN_PID = "/root/logcabin.pid"
+
+
+class LogCabinDB(db_ns.DB, db_ns.Primary, db_ns.LogFiles):
+    """LogCabin node lifecycle (logcabin.clj:23-160): built FROM SOURCE
+    on the node (git clone + scons — the raft KV ships no packages),
+    per-node serverId/listenAddresses config, daemon start; the primary
+    bootstraps the first membership and then reconfigures the cluster
+    to all nodes with the Reconfigure example binary."""
+
+    def setup(self, test, node):
+        from jepsen_tpu.os import debian
+        debian.install(test, node, ["git-core", "protobuf-compiler",
+                                    "libprotobuf-dev", "libcrypto++-dev",
+                                    "g++", "scons"])
+        with control.sudo():
+            control.execute(
+                test, node,
+                "[ -d /logcabin ] || (cd / && git clone --depth 1 "
+                "https://github.com/logcabin/logcabin.git && "
+                "cd /logcabin && git submodule update --init)")
+            control.execute(test, node, "cd /logcabin && scons")
+            for b in ("LogCabin", "Examples/Reconfigure",
+                      "Examples/TreeOps"):
+                control.execute(test, node,
+                                f"cp -f /logcabin/build/{b} /root")
+            sid = str(node).lstrip("n") or "1"
+            control.execute(
+                test, node,
+                f"printf 'serverId = {sid}\\nlistenAddresses = "
+                f"{node}:5254\\n' > {LOGCABIN_CONF}")
+            if node == test["nodes"][0]:
+                # first node bootstraps the initial one-member cluster
+                control.execute(
+                    test, node,
+                    f"cd /root && {LOGCABIN_BIN} -c {LOGCABIN_CONF} "
+                    f"-l {LOGCABIN_LOG} --bootstrap")
+            control.execute(
+                test, node,
+                f"cd /root && {LOGCABIN_BIN} -c {LOGCABIN_CONF} -d "
+                f"-l {LOGCABIN_LOG} -p {LOGCABIN_PID}")
+
+    def setup_primary(self, test, node):
+        """Grow the membership from the bootstrap node to every node
+        (logcabin.clj:102-115 reconfigure!)."""
+        addrs = " ".join(f"{n}:5254" for n in test["nodes"])
+        cluster = ",".join(f"{n}:5254" for n in test["nodes"])
+        with control.sudo():
+            control.execute(
+                test, node,
+                f"cd /root && ./Reconfigure -c {cluster} set {addrs}")
+
+    def teardown(self, test, node):
+        cu.grepkill(test, node, "LogCabin")
+        control.execute(test, node,
+                        f"rm -rf {LOGCABIN_PID} /root/storage || true")
+
+    def log_files(self, test, node):
+        return [LOGCABIN_LOG]
+
+
 class LogCabinClient(client_ns.Client):
     """CAS register via the logcabin CLI's conditional write
     (logcabin.clj client)."""
@@ -97,6 +161,7 @@ def logcabin_test(opts: dict) -> dict:
     test = noop_test()
     test.update({
         "name": "logcabin",
+        "db": LogCabinDB(),
         "client": LogCabinClient(),
         "nemesis": nemesis.partition_random_halves(),
         "model": CASRegister(),
@@ -118,6 +183,96 @@ def logcabin_test(opts: dict) -> dict:
 # ---------------------------------------------------------------------------
 # RobustIRC
 # ---------------------------------------------------------------------------
+
+
+class RobustIRCDB(db_ns.DB):
+    """robustirc.clj:23-84: go-get build on the node, shared TLS cert,
+    primary starts -singlenode, the rest join it. The reference
+    serializes the two waves with core barriers; here the primary's
+    daemon starts in setup (first node in node order is the primary)
+    and joiners point at it."""
+
+    def setup(self, test, node):
+        from jepsen_tpu.os import debian
+        primary = test["nodes"][0]
+        with control.sudo():
+            control.execute(test, node, "killall robustirc || true")
+            debian.install(test, node, ["golang-go", "mercurial"])
+            control.execute(
+                test, node,
+                "env GOPATH=~/gocode go get -u "
+                "github.com/robustirc/robustirc")
+            control.execute(test, node,
+                            "rm -rf /var/lib/robustirc && "
+                            "mkdir -p /var/lib/robustirc")
+            role = ("-singlenode" if node == primary
+                    else f"-join={primary}:13001")
+            control.execute(
+                test, node,
+                "/sbin/start-stop-daemon --start --background "
+                "--exec ~/gocode/bin/robustirc -- "
+                f"-listen={node}:13001 -network_password=secret "
+                f"-network_name=jepsen -tls_cert_path=/tmp/cert.pem "
+                f"-tls_ca_file=/tmp/cert.pem "
+                f"-tls_key_path=/tmp/key.pem {role}")
+
+    def teardown(self, test, node):
+        with control.sudo():
+            control.execute(test, node, "killall robustirc || true")
+
+
+RAVEN_DIR = "/opt/ravendb"
+
+
+class RavenDB(db_ns.DB, db_ns.Primary, db_ns.LogFiles):
+    """ravendb.clj:30-130: tarball install, daemon start, license
+    activation over the admin HTTP API, and the leader linking every
+    follower into the cluster."""
+
+    def __init__(self, version: str = "4.0.0"):
+        self.version = version
+
+    def _url(self, node):
+        return f"http://{node}:8080"
+
+    def setup(self, test, node):
+        from jepsen_tpu.os import debian
+        with control.sudo():
+            control.execute(test, node, "killall Raven.Server || true")
+            debian.install(test, node, ["libunwind8", "ca-certificates",
+                                        "curl", "libicu-dev"])
+            cu.install_archive(
+                test, node,
+                test.get("tarball",
+                         f"https://daily-builds.s3.amazonaws.com/"
+                         f"RavenDB-{self.version}-linux-x64.tar.bz2"),
+                RAVEN_DIR)
+            cu.start_daemon(
+                test, node, f"{RAVEN_DIR}/Server/Raven.Server",
+                "--ServerUrl", f"http://0.0.0.0:8080",
+                "--PublicServerUrl", self._url(node),
+                "--License.Eula.Accepted", "true",
+                logfile=f"{RAVEN_DIR}/raven.log",
+                pidfile=f"{RAVEN_DIR}/raven.pid", chdir=RAVEN_DIR)
+
+    def setup_primary(self, test, node):
+        """Leader links each follower (ravendb.clj:81-90 link-to!)."""
+        for other in test["nodes"]:
+            if other == node:
+                continue
+            control.execute(
+                test, node,
+                f"curl -L -X PUT -d '' "
+                f"'{self._url(node)}/admin/cluster/node?"
+                f"url={self._url(other)}&assignedCores=1'")
+
+    def teardown(self, test, node):
+        cu.stop_daemon(test, node, f"{RAVEN_DIR}/raven.pid",
+                       cmd="Raven.Server")
+        control.execute(test, node, f"rm -rf {RAVEN_DIR} || true")
+
+    def log_files(self, test, node):
+        return [f"{RAVEN_DIR}/raven.log"]
 
 
 class IRCClient(client_ns.Client):
@@ -209,6 +364,7 @@ def robustirc_test(opts: dict) -> dict:
     test = noop_test()
     test.update({
         "name": "robustirc",
+        "db": RobustIRCDB(),
         "client": IRCClient(),
         "nemesis": nemesis.partition_random_halves(),
         "checker": compose({"set": set_checker()}),
@@ -522,6 +678,7 @@ def ravendb_test(opts: dict) -> dict:
     test = noop_test()
     test.update({
         "name": "ravendb",
+        "db": RavenDB(),
         "client": RavenClient(),
         "nemesis": nemesis.partition_random_halves(),
         "model": CASRegister(),

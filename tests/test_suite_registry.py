@@ -283,3 +283,60 @@ class TestRethinkFaketime:
         with control.session_pool(t):
             RethinkDB().setup(t, "n1")
             assert not any("faketime" in c for c in logs(t)["n1"])
+
+
+class TestLogCabinDB:
+    """LogCabin source-build lifecycle (logcabin.clj:23-160)."""
+
+    def test_setup_builds_and_configures(self):
+        from jepsen_tpu.suites.small import LogCabinDB
+        t = dummy_test(**{"nodes": ["n1", "n2", "n3"],
+                          "ssh": {"mode": "dummy", "dummy-responses": {}}})
+        with control.session_pool(t):
+            db = LogCabinDB()
+            db.setup(t, "n1")
+            cmds = logs(t)["n1"]
+            assert any("git clone" in c and "scons" not in c
+                       for c in cmds)
+            assert any("scons" in c for c in cmds)
+            assert any("serverId = 1" in c for c in cmds)
+            assert any("--bootstrap" in c for c in cmds)   # first node
+            db.setup(t, "n2")
+            assert not any("--bootstrap" in c for c in logs(t)["n2"])
+            db.setup_primary(t, "n1")
+            assert any("Reconfigure" in c and "n3:5254" in c
+                       for c in logs(t)["n1"])
+            db.teardown(t, "n1")
+            assert any("LogCabin" in c and "kill" in c
+                       for c in logs(t)["n1"])
+
+
+class TestRobustIRCAndRavenDBs:
+    def test_robustirc_primary_singlenode_joiners_join(self):
+        from jepsen_tpu.suites.small import RobustIRCDB
+        t = dummy_test(**{"nodes": ["n1", "n2"],
+                          "ssh": {"mode": "dummy", "dummy-responses": {}}})
+        with control.session_pool(t):
+            db = RobustIRCDB()
+            db.setup(t, "n1")
+            assert any("-singlenode" in c for c in logs(t)["n1"])
+            db.setup(t, "n2")
+            assert any("-join=n1:13001" in c for c in logs(t)["n2"])
+
+    def test_ravendb_leader_links_followers(self):
+        from jepsen_tpu.suites.small import RavenDB
+        t = dummy_test(**{"nodes": ["n1", "n2", "n3"],
+                          "ssh": {"mode": "dummy", "dummy-responses": {
+                              "stat ": (1, "", "nope"),
+                              "ls -A": "RavenDB-4.0.0",
+                              "dirname": "/opt"}}})
+        with control.session_pool(t):
+            db = RavenDB()
+            db.setup(t, "n1")
+            cmds = logs(t)["n1"]
+            assert any("Raven.Server" in c and "start-stop-daemon" in c
+                       for c in cmds)
+            db.setup_primary(t, "n1")
+            linked = [c for c in logs(t)["n1"]
+                      if "admin/cluster/node" in c]
+            assert len(linked) == 2  # n2 and n3
