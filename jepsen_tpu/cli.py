@@ -209,6 +209,53 @@ def suite_run_cmd() -> dict:
                     "run": run_}}
 
 
+def analyze_cmd() -> dict:
+    """The 'analyze' subcommand: offline re-check of a saved run — load
+    a store directory's history and re-run the linearizable checker on
+    any backend (the checkpoint/resume seam, repl.clj:6-13 + store
+    reload; how a TPU host analyzes histories recorded elsewhere)."""
+
+    def build_parser():
+        p = Parser(prog="analyze",
+                   description="Re-check a stored run offline.")
+        p.add_argument("--store", default=None,
+                       help="store directory (default: latest under "
+                            "./store)")
+        p.add_argument("--model", default="cas-register",
+                       choices=["cas-register", "mutex", "set",
+                                "unordered-queue", "fifo-queue", "noop"])
+        p.add_argument("--backend", default="cpu",
+                       choices=["cpu", "tpu"])
+        p.add_argument("--algorithm", default="auto",
+                       choices=["auto", "wgl", "linear", "native",
+                                "competition"])
+        return p
+
+    def run_(opts) -> int:
+        import json as _json
+
+        from jepsen_tpu import repl, store
+        from jepsen_tpu.checker.wgl import linearizable
+        from jepsen_tpu.models import (
+            CASRegister, FIFOQueue, Mutex, NoOp, SetModel, UnorderedQueue)
+        models = {"cas-register": CASRegister, "mutex": Mutex,
+                  "set": SetModel, "unordered-queue": UnorderedQueue,
+                  "fifo-queue": FIFOQueue, "noop": NoOp}
+        test = (store.load(opts["store"]) if opts.get("store")
+                else repl.last_test())
+        if test is None:
+            print("no stored test found", file=sys.stderr)
+            return INVALID_ARGS
+        checker = linearizable(models[opts["model"]](),
+                               backend=opts["backend"],
+                               algorithm=opts["algorithm"])
+        out = repl.recheck(test, checker)
+        print(_json.dumps(out, indent=2, default=repr))
+        return OK if out.get("valid") is True else TEST_FAILED
+
+    return {"analyze": {"parser": build_parser, "run": run_}}
+
+
 def merge_commands(*cmds: dict) -> dict:
     out: Dict[str, dict] = {}
     for c in cmds:
@@ -255,5 +302,5 @@ def main(subcommands: Dict[str, dict],
     sys.exit(run(subcommands, argv if argv is not None else sys.argv[1:]))
 
 
-if __name__ == "__main__":  # default main: suite runner + results server
-    main(merge_commands(suite_run_cmd(), serve_cmd()))
+if __name__ == "__main__":  # default main: runner + analyzer + server
+    main(merge_commands(suite_run_cmd(), analyze_cmd(), serve_cmd()))

@@ -200,3 +200,27 @@ class TestSuiteRunCmd:
         assert rc == cli.INVALID_ARGS
         out = capsys.readouterr().out
         assert "run" in out and "serve" in out
+
+
+class TestAnalyzeCmd:
+    """Offline re-check of a stored run ('analyze')."""
+
+    def test_recheck_committed_examples(self, capsys):
+        import os
+        from jepsen_tpu import cli
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        good = os.path.join(repo, "examples", "store", "atom-cas")
+        rc = cli.run(cli.analyze_cmd(), ["analyze", "--store", good])
+        assert rc == cli.OK
+        out = capsys.readouterr().out
+        assert '"valid": true' in out
+        bad = os.path.join(repo, "examples", "store",
+                           "atom-cas-lost-update")
+        rc = cli.run(cli.analyze_cmd(), ["analyze", "--store", bad])
+        assert rc == cli.TEST_FAILED
+
+    def test_missing_store_is_invalid_args(self, tmp_path, monkeypatch):
+        from jepsen_tpu import cli
+        monkeypatch.chdir(tmp_path)  # no ./store here
+        rc = cli.run(cli.analyze_cmd(), ["analyze"])
+        assert rc == cli.INVALID_ARGS
