@@ -52,9 +52,16 @@ while time.time() < DEADLINE:
     else:
         h = random_fifo_history(r2, n_procs=min(n_procs, 3), n_ops=n_ops)
         model = FIFOQueue()
-    want = check_model(h, model)["valid"]
+    # Exact linearizability is NP-hard: one-in-hundreds-of-thousands
+    # histories hit an exponential region (a 16-op queue history once ran
+    # ~20 min / 11 GB in the Python engine before agreeing). A config
+    # budget turns those rounds into skips instead of stalls.
+    cap = 2_000_000
+    want = check_model(h, model, max_configs=cap)["valid"]
+    if want is UNKNOWN:
+        continue
     got_n = check_history_native(h, model)["valid"]
-    got_j = check_jit_model(h, model)["valid"]
+    got_j = check_jit_model(h, model, cap)["valid"]
     verdicts = {"python": want, "native": got_n, "jit": got_j}
     if rounds % 7 == 0:  # device path is slow; sample it
         dres = check_history_tpu(h, model)
