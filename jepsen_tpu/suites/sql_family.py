@@ -314,25 +314,75 @@ def percona_dirty_reads_test(opts: dict) -> dict:
 # ---------------------------------------------------------------------------
 
 
-class MySQLClusterDB(db_ns.DB):
-    """mysql_cluster.clj: ndb_mgmd on the first node, ndbd + mysqld
-    elsewhere."""
+#: Node-id offsets per role (mysql_cluster.clj:14-20): one cluster-wide
+#: id space, partitioned so every (role, node) pair gets a stable id.
+NDB_MGMD_ID_OFFSET = 1
+NDBD_ID_OFFSET = 11
+MYSQLD_ID_OFFSET = 21
+NDB_MGMD_DIR = "/var/lib/mysql-cluster"
+NDBD_DIR = "/var/lib/mysql-cluster-ndbd"
+
+
+def mysql_cluster_nodes_conf(test: dict) -> str:
+    """config.ini role sections for every node (mysql_cluster.clj:75-112):
+    every node runs a management and a mysqld section; the first four
+    are storage (ndbd) nodes."""
+    nodes = test["nodes"]
+    parts = []
+    for i, n in enumerate(nodes):
+        parts.append(f"[ndb_mgmd]\nNodeId={NDB_MGMD_ID_OFFSET + i}\n"
+                     f"hostname={n}\ndatadir={NDB_MGMD_DIR}\n")
+    for i, n in enumerate(sorted(nodes)[:4]):
+        parts.append(f"[ndbd]\nNodeId={NDBD_ID_OFFSET + i}\n"
+                     f"hostname={n}\ndatadir={NDBD_DIR}\n")
+    for i, n in enumerate(nodes):
+        parts.append(f"[mysqld]\nNodeId={MYSQLD_ID_OFFSET + i}\n"
+                     f"hostname={n}\n")
+    return "\n".join(parts)
+
+
+class MySQLClusterDB(db_ns.DB, db_ns.LogFiles):
+    """mysql_cluster.clj:41-200: NDB management + storage + SQL daemons
+    with the role-partitioned node-id scheme, generated config.ini /
+    my.cnf, and the connect string spanning every management node."""
 
     def setup(self, test, node):
         debian.install(test, node, ["mysql-cluster-community-server"])
-        first = test["nodes"][0]
+        i = test["nodes"].index(node)
+        connect = ",".join(str(n) for n in test["nodes"])
+        my_cnf = (f"[mysqld]\nndbcluster\n"
+                  f"ndb-connectstring={connect}\n"
+                  f"server-id={MYSQLD_ID_OFFSET + i}\n")
         with control.sudo():
-            if node == first:
-                control.exec(test, node, "ndb_mgmd", "-f",
-                             "/var/lib/mysql-cluster/config.ini")
-            control.exec(test, node, "ndbd",
-                         f"--ndb-connectstring={first}")
+            control.execute(
+                test, node,
+                f"echo {control.escape(my_cnf)} > /etc/my.cnf")
+            control.execute(test, node, f"mkdir -p {NDB_MGMD_DIR} "
+                                        f"{NDBD_DIR}")
+            control.execute(
+                test, node,
+                f"echo {control.escape(mysql_cluster_nodes_conf(test))} "
+                f"> /etc/my.config.ini")
+            control.exec(test, node, "ndb_mgmd",
+                         f"--ndb-nodeid={NDB_MGMD_ID_OFFSET + i}",
+                         "-f", "/etc/my.config.ini")
+            if node in sorted(test["nodes"])[:4]:
+                control.exec(
+                    test, node, "ndbd",
+                    f"--ndb-connectstring={connect}")
             control.execute(test, node, "service mysql start || true")
 
     def teardown(self, test, node):
         with control.sudo():
             control.execute(test, node, "service mysql stop || true")
             control.execute(test, node, "pkill -9 ndbd || true")
+            control.execute(test, node, "pkill -9 ndb_mgmd || true")
+            control.execute(test, node,
+                            f"rm -rf {NDBD_DIR}/* || true")
+
+    def log_files(self, test, node):
+        return [f"{NDB_MGMD_DIR}/ndb_{NDB_MGMD_ID_OFFSET}_cluster.log",
+                "/var/log/mysql/error.log"]
 
 
 def mysql_cluster_bank_test(opts: dict) -> dict:

@@ -613,3 +613,37 @@ class TestESPrimaries:
         got = es.primaries(["127.0.0.1:1"], timeout=0.3)
         assert got == {"127.0.0.1:1": None}
         assert es.self_primaries(["127.0.0.1:1"]) == []
+
+
+class TestMySQLClusterDB:
+    """NDB role/node-id topology (mysql_cluster.clj:60-140)."""
+
+    def test_nodes_conf_partitions_id_space(self):
+        from jepsen_tpu.suites.sql_family import mysql_cluster_nodes_conf
+        t = {"nodes": ["n1", "n2", "n3", "n4", "n5"]}
+        conf = mysql_cluster_nodes_conf(t)
+        assert conf.count("[ndb_mgmd]") == 5
+        assert conf.count("[ndbd]") == 4      # first four are storage
+        assert conf.count("[mysqld]") == 5
+        assert "NodeId=1" in conf and "NodeId=11" in conf \
+            and "NodeId=21" in conf
+
+    def test_setup_starts_roles(self):
+        from jepsen_tpu.suites.sql_family import MySQLClusterDB
+        t = dummy_test(**{"nodes": ["n1", "n2", "n3", "n4", "n5"],
+                          "ssh": {"mode": "dummy", "dummy-responses": {}}})
+        with control.session_pool(t):
+            db = MySQLClusterDB()
+            db.setup(t, "n1")
+            cmds = logs(t)["n1"]
+            assert any("ndb_mgmd" in c for c in cmds)
+            assert any("ndbd" in c and "connectstring" in c
+                       for c in cmds)
+            assert any("my.cnf" in c and "ndbcluster" in c
+                       for c in cmds)
+            db.setup(t, "n5")
+            # n5 is not among the first four sorted nodes: no ndbd
+            assert not any("ndbd --ndb-connectstring" in c
+                           for c in logs(t)["n5"])
+            assert any("ndbd --ndb-connectstring" in c
+                       for c in logs(t)["n1"])  # the probe is real
