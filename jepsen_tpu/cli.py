@@ -241,8 +241,18 @@ def analyze_cmd() -> dict:
         models = {"cas-register": CASRegister, "mutex": Mutex,
                   "set": SetModel, "unordered-queue": UnorderedQueue,
                   "fifo-queue": FIFOQueue, "noop": NoOp}
-        test = (store.load(opts["store"]) if opts.get("store")
-                else repl.last_test())
+        if opts.get("store"):
+            import os as _os
+            if not _os.path.isdir(opts["store"]):
+                # store.load tolerates missing files per-artifact; a
+                # missing DIRECTORY is a typo'd path, not an empty run —
+                # it must not re-check an empty history as valid
+                print(f"no such store directory: {opts['store']}",
+                      file=sys.stderr)
+                return INVALID_ARGS
+            test = store.load(opts["store"])
+        else:
+            test = repl.last_test()
         if test is None:
             print("no stored test found", file=sys.stderr)
             return INVALID_ARGS

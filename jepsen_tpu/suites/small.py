@@ -69,7 +69,9 @@ class LogCabinDB(db_ns.DB, db_ns.Primary, db_ns.LogFiles):
                       "Examples/TreeOps"):
                 control.execute(test, node,
                                 f"cp -f /logcabin/build/{b} /root")
-            sid = str(node).lstrip("n") or "1"
+            # index-based: unique and integer for ANY node naming
+            # (logcabin.clj:48-50 assumes n<digits>; IPs would break it)
+            sid = str(test["nodes"].index(node) + 1)
             control.execute(
                 test, node,
                 f"printf 'serverId = {sid}\\nlistenAddresses = "
@@ -197,11 +199,22 @@ class RobustIRCDB(db_ns.DB):
         primary = test["nodes"][0]
         with control.sudo():
             control.execute(test, node, "killall robustirc || true")
-            debian.install(test, node, ["golang-go", "mercurial"])
+            debian.install(test, node, ["golang-go", "mercurial",
+                                        "openssl"])
             control.execute(
                 test, node,
                 "env GOPATH=~/gocode go get -u "
                 "github.com/robustirc/robustirc")
+            # self-signed cert shared by listen + join verification (the
+            # reference ships a pre-generated resources/cert.pem; here
+            # each node generates one, SAN-covering every node name)
+            sans = ",".join(f"DNS:{n}" for n in test["nodes"])
+            control.execute(
+                test, node,
+                "[ -e /tmp/cert.pem ] || openssl req -x509 -newkey "
+                "rsa:2048 -nodes -keyout /tmp/key.pem -out /tmp/cert.pem "
+                f"-days 30 -subj /CN=jepsen -addext "
+                f"subjectAltName={sans}")
             control.execute(test, node,
                             "rm -rf /var/lib/robustirc && "
                             "mkdir -p /var/lib/robustirc")

@@ -381,7 +381,8 @@ class MySQLClusterDB(db_ns.DB, db_ns.LogFiles):
                             f"rm -rf {NDBD_DIR}/* || true")
 
     def log_files(self, test, node):
-        return [f"{NDB_MGMD_DIR}/ndb_{NDB_MGMD_ID_OFFSET}_cluster.log",
+        nid = NDB_MGMD_ID_OFFSET + test["nodes"].index(node)
+        return [f"{NDB_MGMD_DIR}/ndb_{nid}_cluster.log",
                 "/var/log/mysql/error.log"]
 
 

@@ -376,10 +376,6 @@ def simulate_register_history(n_ops: int, n_procs: int = 5, n_vals: int = 8,
     n_ops counts operations (invoke/complete pairs); the returned History has
     ~2*n_ops event rows.
     """
-    import random
-
-    from jepsen_tpu.history import History
-
     from jepsen_tpu.history import History
 
     rng = random.Random(seed)

@@ -340,3 +340,32 @@ class TestRobustIRCAndRavenDBs:
             linked = [c for c in logs(t)["n1"]
                       if "admin/cluster/node" in c]
             assert len(linked) == 2  # n2 and n3
+
+
+class TestReviewFixes:
+    def test_robustirc_generates_cert_before_start(self):
+        from jepsen_tpu.suites.small import RobustIRCDB
+        t = dummy_test(**{"nodes": ["n1", "n2"],
+                          "ssh": {"mode": "dummy", "dummy-responses": {}}})
+        with control.session_pool(t):
+            RobustIRCDB().setup(t, "n1")
+            cmds = logs(t)["n1"]
+            gen_i = next(i for i, c in enumerate(cmds)
+                         if "openssl req" in c)
+            start_i = next(i for i, c in enumerate(cmds)
+                           if "start-stop-daemon" in c)
+            assert gen_i < start_i
+            assert "DNS:n2" in cmds[gen_i]
+
+    def test_logcabin_server_id_is_index_based(self):
+        from jepsen_tpu.suites.small import LogCabinDB
+        t = dummy_test(**{"nodes": ["10.0.0.1", "10.0.0.2"],
+                          "ssh": {"mode": "dummy", "dummy-responses": {}}})
+        with control.session_pool(t):
+            LogCabinDB().setup(t, "10.0.0.2")
+            assert any("serverId = 2" in c for c in logs(t)["10.0.0.2"])
+
+    def test_mysql_cluster_log_per_node_id(self):
+        from jepsen_tpu.suites.sql_family import MySQLClusterDB
+        t = {"nodes": ["n1", "n2", "n3"]}
+        assert "ndb_3_cluster.log" in MySQLClusterDB().log_files(t, "n3")[0]

@@ -60,7 +60,7 @@ while time.time() < DEADLINE:
     want = check_model(h, model, max_configs=cap)["valid"]
     if want is UNKNOWN:
         continue
-    got_n = check_history_native(h, model)["valid"]
+    got_n = check_history_native(h, model, max_configs=cap)["valid"]
     got_j = check_jit_model(h, model, cap)["valid"]
     verdicts = {"python": want, "native": got_n, "jit": got_j}
     if rounds % 7 == 0:  # device path is slow; sample it
