@@ -98,7 +98,8 @@ def check_packed_native(p: PackedHistory, kernel: KernelSpec,
         # 0 is the C ABI's "unbounded" sentinel, never pass it through
         return {"valid": UNKNOWN, "engine": "native",
                 "error": f"config budget {max_configs} exhausted",
-                "configs-explored": 0, "max-linearized-prefix": 0}
+                "configs-explored": 0, "max-linearized-prefix": 0,
+                "tiers-escalated": False}
 
     cols = [np.ascontiguousarray(a, dtype=np.int32)
             for a in (p.f, p.v1, p.v2, p.inv, p.ret)]
@@ -128,7 +129,9 @@ def check_packed_native(p: PackedHistory, kernel: KernelSpec,
         # configs-explored is the across-tier total.
         mask_ladder = ((2,) if p.n - p.n_required > 128 else (2, 4, 8))
         spent = 0
-        for mw in mask_ladder:
+        escalated = False
+        for tier, mw in enumerate(mask_ladder):
+            escalated = tier > 0
             budget = (0 if max_configs is None
                       else max(1, int(max_configs) - spent))
             status = lib.jepsen_wgl_check(
@@ -138,7 +141,9 @@ def check_packed_native(p: PackedHistory, kernel: KernelSpec,
             if status != _WINDOW:
                 break
             if max_configs is not None and spent >= int(max_configs):
-                status = _BUDGET
+                # window overflow with nothing left for the wider tier:
+                # the full-budget unbounded search might still answer
+                status, escalated = _BUDGET, True
                 break
     finally:
         stop_watcher.set()
@@ -160,10 +165,16 @@ def check_packed_native(p: PackedHistory, kernel: KernelSpec,
                                        for i in range(n_states)),
                 "engine": "native"}
     if status == _BUDGET:
+        # tiers-escalated: part of the budget was burned at narrower mask
+        # tiers before this one overflowed, so the final tier ran with a
+        # REDUCED budget — an unbounded-window search given the caller's
+        # full budget might still answer. Callers must not treat an
+        # escalated budget verdict as final (see LinearizableChecker).
         return {"valid": UNKNOWN, "engine": "native",
                 "error": f"config budget {max_configs} exhausted",
                 "configs-explored": explored,
-                "max-linearized-prefix": best_k}
+                "max-linearized-prefix": best_k,
+                "tiers-escalated": escalated}
     if status == _WINDOW:
         return {"valid": UNKNOWN, "engine": "native",
                 "error": "candidate window exceeds the native engine's "

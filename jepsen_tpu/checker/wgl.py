@@ -388,9 +388,14 @@ class LinearizableChecker(Checker):
                 packed, kernel, self.max_configs)
             if res["valid"] is not UNKNOWN:
                 return res
-            if "budget" in res.get("error", ""):
-                # the budget verdict is final — Python would re-explore
-                # the same capped config count and answer the same
+            if "budget" in res.get("error", "") \
+                    and not res.get("tiers-escalated"):
+                # a first-tier budget verdict is final — Python would
+                # re-explore the same capped config count and answer the
+                # same. An ESCALATED budget verdict is not: earlier mask
+                # tiers burned part of the cap before overflowing, so the
+                # unbounded-window Python search below gets the full
+                # budget and may still settle the history.
                 return res
             # window overflow or engine unavailable: the unbounded
             # Python search always answers

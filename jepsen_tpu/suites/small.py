@@ -18,6 +18,9 @@ from __future__ import annotations
 import json
 import re
 import socket
+import subprocess
+import tempfile
+import threading
 import urllib.error
 import urllib.request
 from typing import Any, List, Optional
@@ -194,27 +197,56 @@ class RobustIRCDB(db_ns.DB):
     daemon starts in setup (first node in node order is the primary)
     and joiners point at it."""
 
+    def __init__(self):
+        self._cert_lock = threading.Lock()
+        self._cert_dir: Optional[str] = None
+
+    def _cert_pair(self, test):
+        """One shared self-signed cert/key pair per test, generated on the
+        control host and uploaded to every node. The reference ships a
+        single pre-generated resources/cert.pem to all nodes
+        (robustirc.clj:40-42); per-node certs would break joining — a
+        joiner's -tls_ca_file must verify the PRIMARY's TLS endpoint, so
+        every node has to trust the same certificate."""
+        with self._cert_lock:
+            if self._cert_dir is None:
+                import atexit
+                import shutil
+                d = tempfile.mkdtemp(prefix="jepsen-robustirc-")
+                sans = ",".join(f"DNS:{n}" for n in test["nodes"])
+                pr = subprocess.run(
+                    ["openssl", "req", "-x509", "-newkey", "rsa:2048",
+                     "-nodes", "-keyout", f"{d}/key.pem",
+                     "-out", f"{d}/cert.pem", "-days", "30",
+                     "-subj", "/CN=jepsen",
+                     "-addext", f"subjectAltName={sans}"],
+                    capture_output=True, text=True)
+                if pr.returncode != 0:
+                    shutil.rmtree(d, ignore_errors=True)
+                    raise RuntimeError(
+                        f"cert generation failed: {pr.stderr.strip()}")
+                # Key material is cleaned at process exit, NOT in per-node
+                # teardown: db.cycle runs teardown-then-setup concurrently
+                # per node on this shared instance, and freeing the pair in
+                # one node's teardown while another node's setup is mid-
+                # upload would hand the cluster two different certs.
+                atexit.register(shutil.rmtree, d, ignore_errors=True)
+                self._cert_dir = d
+            return f"{self._cert_dir}/cert.pem", f"{self._cert_dir}/key.pem"
+
     def setup(self, test, node):
         from jepsen_tpu.os import debian
         primary = test["nodes"][0]
+        cert, key = self._cert_pair(test)
+        control.upload(test, node, cert, "/tmp/cert.pem")
+        control.upload(test, node, key, "/tmp/key.pem")
         with control.sudo():
             control.execute(test, node, "killall robustirc || true")
-            debian.install(test, node, ["golang-go", "mercurial",
-                                        "openssl"])
+            debian.install(test, node, ["golang-go", "mercurial"])
             control.execute(
                 test, node,
                 "env GOPATH=~/gocode go get -u "
                 "github.com/robustirc/robustirc")
-            # self-signed cert shared by listen + join verification (the
-            # reference ships a pre-generated resources/cert.pem; here
-            # each node generates one, SAN-covering every node name)
-            sans = ",".join(f"DNS:{n}" for n in test["nodes"])
-            control.execute(
-                test, node,
-                "[ -e /tmp/cert.pem ] || openssl req -x509 -newkey "
-                "rsa:2048 -nodes -keyout /tmp/key.pem -out /tmp/cert.pem "
-                f"-days 30 -subj /CN=jepsen -addext "
-                f"subjectAltName={sans}")
             control.execute(test, node,
                             "rm -rf /var/lib/robustirc && "
                             "mkdir -p /var/lib/robustirc")

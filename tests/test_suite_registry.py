@@ -343,19 +343,43 @@ class TestRobustIRCAndRavenDBs:
 
 
 class TestReviewFixes:
-    def test_robustirc_generates_cert_before_start(self):
+    def test_robustirc_one_shared_cert_uploaded_to_all_nodes(self):
+        # robustirc.clj:40-42 ships ONE cert.pem/key.pem to every node; a
+        # per-node self-signed cert would make joiners' -tls_ca_file fail
+        # to verify the primary's TLS endpoint.
         from jepsen_tpu.suites.small import RobustIRCDB
         t = dummy_test(**{"nodes": ["n1", "n2"],
                           "ssh": {"mode": "dummy", "dummy-responses": {}}})
         with control.session_pool(t):
-            RobustIRCDB().setup(t, "n1")
-            cmds = logs(t)["n1"]
-            gen_i = next(i for i, c in enumerate(cmds)
-                         if "openssl req" in c)
+            db = RobustIRCDB()
+            db.setup(t, "n1")
+            db.setup(t, "n2")
+            all_logs = logs(t)
+
+            def cert_upload(cmds):
+                return next(c for c in cmds
+                            if c.startswith("UPLOAD")
+                            and c.endswith("/tmp/cert.pem"))
+
+            up1, up2 = (cert_upload(all_logs[n]) for n in ("n1", "n2"))
+            assert up1 == up2  # same local file -> every node
+            cmds = all_logs["n1"]
             start_i = next(i for i, c in enumerate(cmds)
                            if "start-stop-daemon" in c)
-            assert gen_i < start_i
-            assert "DNS:n2" in cmds[gen_i]
+            up_i = cmds.index(up1)
+            assert up_i < start_i
+            # the generated cert SAN-covers every node name
+            import subprocess
+            cert_path = up1.split()[1]
+            sans = subprocess.run(
+                ["openssl", "x509", "-in", cert_path, "-noout", "-ext",
+                 "subjectAltName"], capture_output=True, text=True).stdout
+            assert "DNS:n1" in sans and "DNS:n2" in sans, sans
+            # per-node teardown must NOT free the shared pair (concurrent
+            # cycle: another node's setup may still be uploading it)
+            import os
+            db.teardown(t, "n1")
+            assert os.path.exists(cert_path)
 
     def test_logcabin_server_id_is_index_based(self):
         from jepsen_tpu.suites.small import LogCabinDB
