@@ -314,6 +314,10 @@ def _run_child(platform: str, timeout: float, skip_secondary: bool = False):
     """Run one measurement child. Returns (record | None, note)."""
     env = dict(os.environ)
     env["JEPSEN_BENCH_CHILD"] = platform
+    # The orchestrator already sandboxes children behind its own timeout;
+    # the library-level accelerator watchdog probing AGAIN inside the
+    # child would double a minutes-long healthy-but-cold TPU init.
+    env["JEPSEN_ACCEL_OK"] = "1"
     if skip_secondary:
         env["JEPSEN_BENCH_SKIP_SECONDARY"] = "1"
     if platform == "cpu":

@@ -1,0 +1,150 @@
+"""Accelerator-init watchdog: never let a sick TPU plugin hang the library.
+
+On some hosts the ambient accelerator plugin wedges during backend
+initialization — a bare ``jax.devices()`` blocks forever, far past any
+useful timeout. The reference never has this problem (its checker is pure
+JVM); a framework whose device backend is a first-class citizen must
+degrade, not deadlock: ``cli analyze --backend tpu``,
+``LinearizableChecker(backend="tpu")`` and ``check_keyed_tpu`` all reach
+:func:`ensure_usable` before their first device call, and fall back to
+the CPU backend with a visible warning when the plugin is wedged.
+
+Design: backend initialization cannot be guarded in-process — a hung
+init thread holds jax's global backend lock, so *any* later jax call in
+the process would block behind it, including the CPU fallback. The probe
+therefore runs in a disposable child interpreter with the ambient
+environment: if THAT hangs past the timeout, this process pins
+``jax_platforms=cpu`` *before* its own first backend init and proceeds
+on the host backend. The verdict is cached per process (and can be
+pre-seeded via ``JEPSEN_ACCEL_OK=1`` by orchestrators that sandbox their
+own children, e.g. bench.py).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import warnings
+from typing import Optional
+
+#: Seconds the ambient backend gets to initialize in the probe child.
+#: Generous by default: a healthy-but-cold TPU tunnel can take minutes
+#: (the round-2 bench saw multi-minute first init), and a false "wedged"
+#: silently costs the device path. Env-tunable for impatient callers.
+PROBE_TIMEOUT_S = float(os.environ.get("JEPSEN_ACCEL_PROBE_TIMEOUT", "300"))
+
+#: The probe child's program. Module-level so tests can substitute a
+#: genuinely-hanging child without touching a real plugin.
+_PROBE_CODE = ("import jax\n"
+               "d = jax.devices()\n"
+               "print('JEPSEN_ACCEL', d[0].platform)\n")
+
+_state: dict = {}
+_lock = threading.Lock()
+
+
+def _initialized_platform() -> Optional[str]:
+    """Platform of an already-initialized in-process backend, or None.
+
+    An initialized backend is proof the init didn't hang, so no probe is
+    needed. Reads jax's private backend table defensively — absence of
+    the attribute just means 'unknown, probe'."""
+    if "jax" not in sys.modules:
+        return None
+    try:
+        from jax._src import xla_bridge as xb
+        backends = getattr(xb, "_backends", None)
+        if backends:
+            return next(iter(backends.values())).platform
+    except Exception:  # noqa: BLE001 — private API moved: fall through
+        return None
+    return None
+
+
+def _configured_platforms() -> str:
+    """The authoritative platform selection. The ambient plugin's startup
+    hook pins ``jax.config.jax_platforms`` (observed: env says cpu, config
+    says axon, and init follows the CONFIG), so the env var is only the
+    fallback when the config is unset."""
+    try:
+        import jax
+        cfg = getattr(jax.config, "jax_platforms", None)
+        if cfg:
+            return str(cfg)
+    except Exception:  # noqa: BLE001 — no jax: env is all there is
+        pass
+    return os.environ.get("JAX_PLATFORMS", "") or ""
+
+
+def _spawn_probe(timeout: float) -> Optional[str]:
+    """Initialize the ambient default backend in a child interpreter.
+
+    Returns the platform name on success, None on hang/crash. The child
+    inherits the ambient env untouched, so it exercises exactly the init
+    this process would have performed."""
+    try:
+        pr = subprocess.run([sys.executable, "-c", _PROBE_CODE],
+                            capture_output=True, text=True,
+                            timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return None
+    except Exception:  # noqa: BLE001 — spawn failure == unusable
+        return None
+    if pr.returncode != 0:
+        return None
+    for line in reversed((pr.stdout or "").splitlines()):
+        if line.startswith("JEPSEN_ACCEL "):
+            return line.split(" ", 1)[1].strip()
+    return None
+
+
+def probe_default_backend(timeout: Optional[float] = None) -> Optional[str]:
+    """The cached probe verdict: platform name, or None when wedged."""
+    with _lock:
+        if "platform" in _state:
+            return _state["platform"]
+        if os.environ.get("JEPSEN_ACCEL_OK"):
+            _state["platform"] = "trusted"
+            return _state["platform"]
+        plat = _initialized_platform()
+        if plat is None and _configured_platforms().strip().lower() == "cpu":
+            plat = "cpu"  # host backend: init cannot wedge
+        if plat is None:
+            plat = _spawn_probe(PROBE_TIMEOUT_S if timeout is None
+                                else timeout)
+        _state["platform"] = plat
+        return plat
+
+
+def ensure_usable(caller: str = "checker",
+                  timeout: Optional[float] = None) -> str:
+    """Gate a device-backend call: probe the ambient backend, and when it
+    is wedged pin this process onto the CPU backend with a warning.
+
+    Returns the platform the caller will actually get. Idempotent and
+    cheap after the first call."""
+    plat = probe_default_backend(timeout)
+    if plat is not None:
+        return plat
+    with _lock:
+        if not _state.get("degraded"):
+            _state["degraded"] = True
+            warnings.warn(
+                f"accelerator backend initialization hung past "
+                f"{PROBE_TIMEOUT_S if timeout is None else timeout:.0f}s; "
+                f"{caller} degrading to the CPU backend "
+                f"(set JEPSEN_ACCEL_PROBE_TIMEOUT to wait longer)",
+                RuntimeWarning, stacklevel=3)
+    try:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 — backend already up: leave it
+        pass
+    return "cpu"
+
+
+def _reset_for_tests() -> None:
+    with _lock:
+        _state.clear()

@@ -716,6 +716,8 @@ def check_packed_tpu(p: PackedHistory, kernel: KernelSpec,
         _check_window(window)
     if p.n_required == 0:
         return {"valid": True, "levels": 0, "backend": "tpu"}
+    from jepsen_tpu import accel
+    accel.ensure_usable("check_packed_tpu")
     cr = _crash_width(p.n - p.n_required)
     cols = (None if cr is None
             else _split_packed(p, _bucket(p.n_required), cr, kernel))
@@ -746,6 +748,8 @@ def warm_ladder(p: PackedHistory, kernel: KernelSpec,
     """Compile (and once-execute) every escalation rung for this history's
     padded shape, so a later timed check pays no compile cost regardless
     of how far it escalates."""
+    from jepsen_tpu import accel
+    accel.ensure_usable("warm_ladder")
     cr = _crash_width(p.n - p.n_required)
     cols = (None if cr is None
             else _split_packed(p, _bucket(p.n_required), cr, kernel))
@@ -789,7 +793,8 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
                     window: Optional[int] = WINDOW,
                     mesh: Optional["jax.sharding.Mesh"] = None,
                     axis: str = "keys",
-                    expand: Optional[int] = None) -> Dict[str, Any]:
+                    expand: Optional[int] = None,
+                    ladder: Optional[tuple] = None) -> Dict[str, Any]:
     """Check a {key: history} map batched on device — the independent-key
     data-parallel axis (reference independent.clj:65-219 lifts generators,
     independent.clj:246-296 fans the checker out per key; here the fan-out
@@ -808,6 +813,8 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
     keys = list(keyed.keys())
     if not keys:
         return {"valid": True, "results": {}, "backend": "tpu"}
+    from jepsen_tpu import accel
+    accel.ensure_usable("check_keyed_tpu")
     results: Dict[Any, Dict[str, Any]] = {}
     packed: Dict[Any, PackedHistory] = {}
     for k in keys:
@@ -841,7 +848,12 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
             continue
         rows.append((key, cols, _window_needed(p)))
 
-    if capacity is not None:
+    if ladder is not None:
+        # caller-supplied escalation rungs (tests, dryruns: small rungs
+        # keep compile cost bounded while still exercising escalation)
+        for _, win, _ in ladder:
+            _check_window(win)
+    elif capacity is not None:
         _check_window(window or WINDOW)
         ladder = ((capacity, window or WINDOW, expand),)
     else:
@@ -854,7 +866,7 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
         if not rows:
             break
         last_rung = step == len(ladder) - 1
-        if capacity is None and not last_rung:
+        if len(ladder) > 1 and not last_rung:
             # Route keys whose needed window provably exceeds this rung's
             # straight to the next rung — running them here would only
             # report window overflow. (Narrow keys still finish on the
@@ -870,6 +882,7 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
         rows = runnable
         arrays = [np.stack([cols[c] for _, cols, _ in rows])
                   for c in _COLS]
+        multiproc = False
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
             # Pad the key batch up to the mesh axis size so it divides.
@@ -886,10 +899,28 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
                     return np.concatenate([a, fill])
                 arrays = [_pad_col(a, c) for a, c in zip(arrays, _COLS)]
             sh_row = NamedSharding(mesh, P(axis))
-            arrays = [jax.device_put(a, sh_row) for a in arrays]
+            multiproc = jax.process_count() > 1
+            if multiproc:
+                # Multi-host (DCN) mesh: device_put cannot address other
+                # hosts' devices. Every process holds the SAME global
+                # batch (the keyed dict is control-plane data), so each
+                # builds the global array from its addressable slices.
+                arrays = [jax.make_array_from_callback(
+                              a.shape, sh_row,
+                              lambda idx, a=a: a[idx])
+                          for a in arrays]
+            else:
+                arrays = [jax.device_put(a, sh_row) for a in arrays]
         fn = _jit_batch(_kernel_key(kernel), cap, win, exp)
-        done, lossy, wovf, best, levels = (np.asarray(x)
-                                           for x in fn(*arrays))
+        outs = fn(*arrays)
+        if multiproc:
+            # Per-key verdict rows live on their owning host; gather the
+            # global vectors so every process takes identical host-side
+            # decisions (escalation retries stay SPMD-deterministic).
+            from jax.experimental import multihost_utils
+            outs = tuple(multihost_utils.process_allgather(x, tiled=True)
+                         for x in outs)
+        done, lossy, wovf, best, levels = (np.asarray(x) for x in outs)
         retry = deferred
         for r, (key, cols, wneed) in enumerate(rows):
             res = _result(bool(done[r]), bool(lossy[r]), bool(wovf[r]),

@@ -360,6 +360,16 @@ class KernelSpec:
     #: Human rendering of a packed state word for counterexample reports:
     #: (state, value_table) -> str. None falls back to the raw integer.
     describe_state: Optional[Callable] = None
+    #: Host predicate (f_code, inv_value) -> bool: True iff a CRASHED op
+    #: of this shape can never be linearized under the reference
+    #: semantics and so constrains nothing — pack_history drops it
+    #: (like crashed reads) instead of failing to encode it. Reference
+    #: parity: knossos steps a crashed op with its *invocation* value
+    #: (model.clj:87-100 FIFOQueue compares `value` against the head,
+    #: model.clj:73-80 UnorderedQueue tests membership), so a nil-value
+    #: crashed dequeue — disque/rabbitmq drains, disque.clj:305-310 —
+    #: always steps to inconsistent and is never taken by any engine.
+    drop_crashed: Optional[Callable] = None
 
 
 def _cas_register_step(state, f, v1, v2):
@@ -987,6 +997,8 @@ UNORDERED_QUEUE_KERNEL = KernelSpec(
     # nothing at any state — safely absorbed by the pure-op closure
     readonly=lambda f, v1, v2: f == F_ENQUEUE and v2 == 0,
     describe_state=_uqueue_describe,
+    drop_crashed=lambda fc, inv_value: (fc == F_DEQUEUE
+                                        and inv_value is None),
 )
 
 
@@ -999,6 +1011,8 @@ FIFO_QUEUE_KERNEL = KernelSpec(
     encode_op=_fifo_encode,
     remap=_fifo_remap,
     describe_state=_fifo_describe,
+    drop_crashed=lambda fc, inv_value: (fc == F_DEQUEUE
+                                        and inv_value is None),
 )
 
 

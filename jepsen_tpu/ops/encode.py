@@ -174,8 +174,13 @@ def pack_history(history: Sequence[Op], kernel: KernelSpec,
                     f"op f={inv_op.f!r} not supported by model "
                     f"{kernel.name!r} (codes: {sorted(kernel.f_codes)})")
             if o.is_info:
-                if fc == F_READ:
-                    continue  # crashed read constrains nothing
+                if fc == F_READ or (
+                        kernel.drop_crashed is not None
+                        and kernel.drop_crashed(fc, inv_op.value)):
+                    # crashed read — or a crashed op the reference
+                    # semantics can never linearize (e.g. a nil-value
+                    # dequeue) — constrains nothing
+                    continue
                 v1, v2 = encode(fc, inv_op.f, inv_op.value, None)
                 rows.append((inv_ev, int(RET_INF), fc, v1, v2,
                              inv_op.process, inv_op, o))
@@ -186,7 +191,9 @@ def pack_history(history: Sequence[Op], kernel: KernelSpec,
     # invocations with no completion at all == crashed (same as info)
     for inv_ev, inv_op in pending.values():
         fc = kernel.f_codes.get(inv_op.f)
-        if fc is None or fc == F_READ:
+        if fc is None or fc == F_READ or (
+                kernel.drop_crashed is not None
+                and kernel.drop_crashed(fc, inv_op.value)):
             continue
         v1, v2 = encode(fc, inv_op.f, inv_op.value, None)
         rows.append((inv_ev, int(RET_INF), fc, v1, v2, inv_op.process,

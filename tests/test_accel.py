@@ -1,0 +1,94 @@
+"""Accelerator-init watchdog: a wedged plugin must degrade to CPU with a
+warning, never hang the library (cli analyze --backend tpu,
+LinearizableChecker(backend='tpu'), check_keyed_tpu all gate on it)."""
+
+import warnings
+
+import pytest
+
+from jepsen_tpu import accel
+from jepsen_tpu.models import CASRegister
+from jepsen_tpu.testing import simulate_register_history
+
+#: A probe child whose jax.devices hangs — the real wedge, in miniature.
+HANGING_PROBE = ("import time\n"
+                 "import jax\n"
+                 "jax.devices = lambda *a: time.sleep(300)\n"
+                 "jax.devices()\n"
+                 "print('JEPSEN_ACCEL never')\n")
+
+QUICK_PROBE = "print('JEPSEN_ACCEL faketpu')\n"
+
+
+@pytest.fixture(autouse=True)
+def fresh_state(monkeypatch):
+    accel._reset_for_tests()
+    # the test process runs with JAX_PLATFORMS=cpu and an initialized
+    # backend (conftest); simulate a pristine process with an ambient
+    # accelerator plugin
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    monkeypatch.delenv("JEPSEN_ACCEL_OK", raising=False)
+    monkeypatch.setattr(accel, "_initialized_platform", lambda: None)
+    monkeypatch.setattr(accel, "_configured_platforms", lambda: "axon")
+    yield
+    accel._reset_for_tests()
+
+
+def test_hanging_probe_degrades_to_cpu(monkeypatch):
+    monkeypatch.setattr(accel, "_PROBE_CODE", HANGING_PROBE)
+    with pytest.warns(RuntimeWarning, match="degrading to the CPU"):
+        plat = accel.ensure_usable("test", timeout=1.5)
+    assert plat == "cpu"
+    # verdict cached: second call is instant and silent
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert accel.ensure_usable("test", timeout=1.5) == "cpu"
+
+
+def test_checker_still_returns_verdict_on_wedge(monkeypatch):
+    monkeypatch.setattr(accel, "_PROBE_CODE", HANGING_PROBE)
+    monkeypatch.setattr(accel, "PROBE_TIMEOUT_S", 1.5)
+    from jepsen_tpu.checker.wgl import LinearizableChecker
+    h = simulate_register_history(120, n_procs=3, n_vals=4, seed=2)
+    with pytest.warns(RuntimeWarning, match="degrading to the CPU"):
+        r = LinearizableChecker(CASRegister(), backend="tpu").check({}, h)
+    assert r["valid"] is True
+
+
+def test_healthy_probe_passes_through(monkeypatch):
+    monkeypatch.setattr(accel, "_PROBE_CODE", QUICK_PROBE)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert accel.ensure_usable("test", timeout=30) == "faketpu"
+
+
+def test_initialized_backend_skips_probe(monkeypatch):
+    monkeypatch.setattr(accel, "_initialized_platform", lambda: "cpu")
+
+    def boom(timeout):
+        raise AssertionError("probe must not spawn")
+
+    monkeypatch.setattr(accel, "_spawn_probe", boom)
+    assert accel.probe_default_backend() == "cpu"
+
+
+def test_cpu_config_skips_probe(monkeypatch):
+    # config, not env, is authoritative: the ambient plugin's startup hook
+    # pins jax.config.jax_platforms, and init follows the config
+    monkeypatch.setattr(accel, "_configured_platforms", lambda: "cpu")
+
+    def boom(timeout):
+        raise AssertionError("probe must not spawn")
+
+    monkeypatch.setattr(accel, "_spawn_probe", boom)
+    assert accel.probe_default_backend() == "cpu"
+
+
+def test_trusted_env_skips_probe(monkeypatch):
+    monkeypatch.setenv("JEPSEN_ACCEL_OK", "1")
+
+    def boom(timeout):
+        raise AssertionError("probe must not spawn")
+
+    monkeypatch.setattr(accel, "_spawn_probe", boom)
+    assert accel.probe_default_backend() == "trusted"

@@ -449,14 +449,95 @@ class TestUnorderedQueueKernel:
         assert linearizable(UnorderedQueue(), backend="tpu").check(
             {}, h)["valid"] is True
 
-    def test_crashed_dequeue_falls_back(self):
-        # a crashed dequeue's removed element is unknowable: no word
-        # encoding; the facade answers via the object search
+    def test_crashed_dequeue_stays_on_device_path(self):
+        # a nil-value crashed dequeue can never be linearized under the
+        # reference semantics (knossos steps it with the invocation's nil
+        # value — model.clj:73-80), so pack_history drops it and the
+        # drain history stays on the device path instead of silently
+        # routing to the object search
         h = H((0, "invoke", "enqueue", 1), (0, "ok", "enqueue", 1),
               (1, "invoke", "dequeue", None), (1, "info", "dequeue", None))
-        assert check_history_tpu(h, UnorderedQueue()) is None
+        r = check_history_tpu(h, UnorderedQueue())
+        assert r is not None and r["valid"] is True
+        assert r["backend"] == "tpu"
         assert linearizable(UnorderedQueue(), backend="tpu").check(
             {}, h)["valid"] is True
+
+    def test_crashed_dequeue_drop_matches_object_search(self):
+        # differential: dropping nil crashed dequeues must not change any
+        # verdict vs the object search that keeps (and never takes) them
+        import random as _random
+        from jepsen_tpu.checker.wgl import check_model
+        n = 0
+        for i in range(60):
+            rng = _random.Random(900 + i)
+            h = random_queue_history(rng, n_procs=3, n_ops=10, n_vals=3,
+                                     crash_p=0.3)
+            want = check_model(h, UnorderedQueue())["valid"]
+            got = check_history_tpu(h, UnorderedQueue())
+            if got is None or got["valid"] is UNKNOWN:
+                continue
+            n += 1
+            assert got["valid"] is want, (i, got, want)
+        assert n > 30
+
+    def test_fifo_crashed_dequeue_stays_on_device_path(self):
+        h = H((0, "invoke", "enqueue", 1), (0, "ok", "enqueue", 1),
+              (1, "invoke", "dequeue", None), (1, "info", "dequeue", None),
+              (2, "invoke", "dequeue", None), (2, "ok", "dequeue", 1))
+        from jepsen_tpu.models import FIFOQueue as _FQ
+        r = check_history_tpu(h, _FQ())
+        assert r is not None and r["valid"] is True
+
+    def test_fifo_crashed_dequeue_drop_matches_object_search(self):
+        # random_fifo_history never crashes dequeues, so inject crashed
+        # nil dequeues explicitly: the FIFO drop path must agree with the
+        # object search (which keeps and never takes them) on every seed
+        import random as _random
+        from jepsen_tpu.checker.wgl import check_model
+        from jepsen_tpu.history import History, Op
+        from jepsen_tpu.models import FIFOQueue as _FQ
+        n = 0
+        for i in range(40):
+            rng = _random.Random(1700 + i)
+            base = list(random_fifo_history(rng, n_procs=3, n_ops=8))
+            # a crashed nil dequeue at a random point mid-history (on a
+            # fresh process id so pairing stays intact), plus one at the
+            # end left forever-pending (no completion at all)
+            cut = rng.randrange(len(base) + 1)
+            t = base[cut - 1].time + 1 if cut else 0
+            rows = (base[:cut]
+                    + [Op(type="invoke", f="dequeue", value=None,
+                          process=7, time=t),
+                       Op(type="info", f="dequeue", value=None,
+                          process=7, time=t + 1)]
+                    + base[cut:])
+            rows.append(Op(type="invoke", f="dequeue", value=None,
+                           process=8, time=rows[-1].time + 1))
+            h = History.of(rows)
+            want = check_model(h, _FQ())["valid"]
+            got = check_history_tpu(h, _FQ())
+            if got is None or got["valid"] is UNKNOWN:
+                continue
+            n += 1
+            assert got["valid"] is want, (i, got, want)
+        assert n > 20
+
+    def test_host_fallback_is_labeled(self):
+        # count-field overflow routes to the object search; the result
+        # must SAY so instead of reading as a device verdict
+        rows = []
+        for _ in range(17):
+            rows += [(0, "invoke", "enqueue", 9), (0, "ok", "enqueue", 9)]
+        rows += [(1, "invoke", "dequeue", None), (1, "ok", "dequeue", 9)]
+        h = H(*rows)
+        assert check_history_tpu(h, UnorderedQueue()) is None
+        out = linearizable(UnorderedQueue(), backend="tpu").check({}, h)
+        assert out["valid"] is True
+        assert out["backend"] == "cpu"
+        assert out["fallback-from"] == "tpu"
+        assert "kernel" in out["fallback-reason"] \
+            or "encoding" in out["fallback-reason"]
 
     def test_never_dequeued_values_are_sinks(self):
         # 17 enqueues of one never-dequeued value used to overflow the

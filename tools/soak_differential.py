@@ -13,6 +13,12 @@ import os
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.join(REPO, "tests"))
+# 8 virtual CPU devices BEFORE jax init: the keyed rounds fuzz the
+# mesh-sharded batching/padding/escalation plumbing, not just 1-device.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
 import jax
 jax.config.update("jax_platforms", "cpu")
 
@@ -30,6 +36,57 @@ DEADLINE = time.time() + float(sys.argv[1]) if len(sys.argv) > 1 else time.time(
 rng = random.Random(int(time.time()))
 rounds = 0
 mism = 0
+
+
+def gen_history(fam, r2, n_ops, n_procs):
+    if fam == "reg":
+        return (random_register_history(r2, n_procs=n_procs, n_ops=n_ops,
+                                        n_vals=3, crash_p=0.2),
+                CASRegister())
+    if fam == "set":
+        return (random_set_history(r2, n_procs=min(n_procs, 4),
+                                   n_ops=n_ops, n_vals=4), SetModel())
+    if fam == "queue":
+        return (random_queue_history(r2, n_procs=min(n_procs, 4),
+                                     n_ops=n_ops, n_vals=4),
+                UnorderedQueue())
+    return (random_fifo_history(r2, n_procs=min(n_procs, 3),
+                                n_ops=n_ops), FIFOQueue())
+
+
+from jepsen_tpu import parallel
+from jepsen_tpu.checker.tpu import check_keyed_tpu
+MESH = parallel.make_mesh()
+MODELS = {"reg": CASRegister, "set": SetModel, "queue": UnorderedQueue,
+          "fifo": FIFOQueue}
+kround = 0
+
+
+def keyed_round(seed, cap):
+    """Fuzz the mesh-sharded keyed batch (random key count — uneven
+    batches exercise the n_required=0 padding — plus the two-rung
+    escalation) against the per-key Python oracle."""
+    global mism
+    r2 = random.Random(seed)
+    fam = r2.choice(["reg", "set", "queue", "fifo"])
+    keyed = {k: gen_history(fam, random.Random(seed + 31 * k),
+                            r2.randint(6, 16), r2.randint(2, 5))[0]
+             for k in range(r2.randint(3, 12))}
+    model = MODELS[fam]()
+    out = check_keyed_tpu(keyed, model, mesh=MESH,
+                          ladder=((16, 16, 8), (256, 32, 64)))
+    for k, hk in keyed.items():
+        want_k = check_model(hk, model, max_configs=cap)["valid"]
+        got_k = out["results"][k]["valid"]
+        if UNKNOWN in (want_k, got_k) or got_k is want_k:
+            continue
+        mism += 1
+        print(f"KEYED MISMATCH fam={fam} seed={seed} key={k}: "
+              f"device={got_k} python={want_k}", flush=True)
+        if mism >= 5:
+            sys.exit(1)
+
+
 while time.time() < DEADLINE:
     rounds += 1
     seed = rng.getrandbits(32)
@@ -37,21 +94,7 @@ while time.time() < DEADLINE:
     fam = rng.choice(["reg", "set", "queue", "fifo"])
     n_ops = rng.randint(6, 16)
     n_procs = rng.randint(2, 5)
-    if fam == "reg":
-        h = random_register_history(r2, n_procs=n_procs, n_ops=n_ops,
-                                    n_vals=3, crash_p=0.2)
-        model = CASRegister()
-    elif fam == "set":
-        h = random_set_history(r2, n_procs=min(n_procs, 4), n_ops=n_ops,
-                               n_vals=4)
-        model = SetModel()
-    elif fam == "queue":
-        h = random_queue_history(r2, n_procs=min(n_procs, 4), n_ops=n_ops,
-                                 n_vals=4)
-        model = UnorderedQueue()
-    else:
-        h = random_fifo_history(r2, n_procs=min(n_procs, 3), n_ops=n_ops)
-        model = FIFOQueue()
+    h, model = gen_history(fam, r2, n_ops, n_procs)
     # Exact linearizability is NP-hard: one-in-hundreds-of-thousands
     # histories hit an exponential region (a 16-op queue history once ran
     # ~20 min / 11 GB in the Python engine before agreeing). A config
@@ -67,6 +110,9 @@ while time.time() < DEADLINE:
         dres = check_history_tpu(h, model)
         if dres is not None:
             verdicts["device"] = dres["valid"]
+    if rounds % 13 == 0:  # keyed mesh-sharded batch: padding/escalation
+        kround += 1
+        keyed_round(seed, cap)
     bad = {k: v for k, v in verdicts.items()
            if v is not UNKNOWN and v is not want}
     if bad:
@@ -76,6 +122,7 @@ while time.time() < DEADLINE:
         if mism >= 5:
             sys.exit(1)
     if rounds % 500 == 0:
-        print(f"# {rounds} rounds, {mism} mismatches", flush=True)
-print(f"DONE {rounds} rounds, {mism} mismatches")
+        print(f"# {rounds} rounds ({kround} keyed), {mism} mismatches",
+              flush=True)
+print(f"DONE {rounds} rounds ({kround} keyed), {mism} mismatches")
 sys.exit(1 if mism else 0)
