@@ -1,0 +1,176 @@
+#!/usr/bin/env python3
+"""A deliberately small replicated KV daemon — the framework's tier-3
+system under test (reference: jepsen's cluster-dependent tests run suites
+against real daemons, jepsen/test/jepsen/core_test.clj:30-84; this is the
+localhost stand-in for a 5-node cluster).
+
+Topology: N processes on localhost, one per "node", each listening on its
+own TCP port. The FIRST port in --peers is the primary. Every client
+operation received by any node is forwarded to the primary, which applies
+it to its in-memory map under a lock (a single serialization point, so
+the service is linearizable by construction) and asynchronously
+replicates applied writes to the backups.
+
+--read-local flips the one deliberate consistency bug: reads are then
+served from the local replica instead of being forwarded. Replication is
+asynchronous (--repl-delay-ms), so such reads can be stale — exactly the
+violation a linearizability checker exists to catch.
+
+Wire protocol: one JSON object per line, {"op": "read"|"write"|"cas",
+"key": k, ...} -> {"ok": bool, "value": ..., "pid": n}. Replication uses
+the same socket protocol with op "repl".
+
+Standalone on purpose: stdlib only, no imports from jepsen_tpu — the
+harness must treat it as a black box, like any real database.
+"""
+
+import argparse
+import json
+import os
+import queue
+import signal
+import socket
+import socketserver
+import sys
+import threading
+import time
+
+
+def log(msg):
+    print(f"{time.strftime('%H:%M:%S')} kvnode[{os.getpid()}] {msg}",
+          flush=True)
+
+
+class Node:
+    def __init__(self, port, peers, read_local, repl_delay_ms):
+        self.port = port
+        self.peers = peers
+        self.primary_port = peers[0]
+        self.is_primary = port == self.primary_port
+        self.read_local = read_local
+        self.repl_delay = repl_delay_ms / 1000.0
+        self.data = {}
+        self.lock = threading.Lock()
+        self.repl_q = queue.Queue()
+        if self.is_primary:
+            threading.Thread(target=self._replicator, daemon=True).start()
+
+    # -- primary-side ------------------------------------------------------
+
+    def apply(self, req):
+        """Apply one operation at the primary's serialization point."""
+        op, key = req["op"], req.get("key")
+        with self.lock:
+            if op == "read":
+                return {"ok": True, "value": self.data.get(key)}
+            if op == "write":
+                self.data[key] = req["value"]
+                self.repl_q.put(("write", key, req["value"]))
+                return {"ok": True}
+            if op == "cas":
+                if self.data.get(key) == req["old"]:
+                    self.data[key] = req["new"]
+                    self.repl_q.put(("write", key, req["new"]))
+                    return {"ok": True}
+                return {"ok": False, "error": "cas mismatch"}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+    def _replicator(self):
+        """Asynchronously ship applied writes to every backup — the lag
+        that makes --read-local observably unsafe."""
+        while True:
+            kind, key, value = self.repl_q.get()
+            time.sleep(self.repl_delay)
+            for p in self.peers:
+                if p == self.port:
+                    continue
+                try:
+                    _rpc(p, {"op": "repl", "key": key, "value": value},
+                         timeout=1.0)
+                except OSError:
+                    log(f"replication to :{p} failed (down?)")
+
+    # -- any-node request path --------------------------------------------
+
+    def handle(self, req):
+        op = req.get("op")
+        if op == "repl":
+            with self.lock:
+                self.data[req["key"]] = req["value"]
+            return {"ok": True}
+        if op == "read" and self.read_local:
+            with self.lock:  # the bug: backup replicas lag the primary
+                return {"ok": True, "value": self.data.get(req.get("key")),
+                        "stale-read-allowed": True}
+        if self.is_primary:
+            return self.apply(req)
+        try:
+            return _rpc(self.primary_port, req, timeout=5.0)
+        except OSError as e:
+            return {"ok": False, "error": f"primary unreachable: {e}"}
+
+
+def _rpc(port, req, timeout):
+    with socket.create_connection(("127.0.0.1", port),
+                                  timeout=timeout) as s:
+        s.sendall((json.dumps(req) + "\n").encode())
+        f = s.makefile("r")
+        line = f.readline()
+    if not line:
+        raise OSError("connection closed mid-request")
+    return json.loads(line)
+
+
+class Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        node = self.server.kv_node
+        for line in self.rfile:
+            line = line.strip()
+            if not line:
+                continue
+            req = {}
+            try:
+                req = json.loads(line)
+                if not isinstance(req, dict):
+                    raise ValueError(f"expected a JSON object, got "
+                                     f"{type(req).__name__}")
+                resp = node.handle(req)
+            except Exception as e:  # noqa: BLE001 — protocol errors
+                req = req if isinstance(req, dict) else {}
+                resp = {"ok": False, "error": repr(e)}
+            resp["pid"] = os.getpid()
+            if req.get("op") != "repl":
+                log(f"{req.get('op')} {req.get('key')} -> "
+                    f"{json.dumps(resp)}")
+            self.wfile.write((json.dumps(resp) + "\n").encode())
+            self.wfile.flush()
+
+
+class Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--peers", required=True,
+                    help="comma-separated ports; first is the primary")
+    ap.add_argument("--read-local", action="store_true",
+                    help="serve reads from the local (lagging) replica")
+    ap.add_argument("--repl-delay-ms", type=float, default=30.0)
+    args = ap.parse_args()
+    peers = [int(p) for p in args.peers.split(",")]
+
+    node = Node(args.port, peers, args.read_local, args.repl_delay_ms)
+    srv = Server(("127.0.0.1", args.port), Handler)
+    srv.kv_node = node
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
+    role = "primary" if node.is_primary else "backup"
+    log(f"listening on :{args.port} ({role}; peers {peers}; "
+        f"read_local={args.read_local})")
+    srv.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

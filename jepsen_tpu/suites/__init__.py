@@ -61,6 +61,8 @@ SUITES = {
     "crate": ("sql_family", "crate_version_divergence_test"),
     "crate-lost-updates": ("sql_family", "crate_lost_updates_test"),
     "crate-dirty-read": ("sql_family", "crate_dirty_read_test"),
+    "local-kv": ("localkv", "localkv_test"),
+    "local-kv-unsafe": ("localkv", "localkv_unsafe_test"),
     "logcabin": ("small", "logcabin_test"),
     "robustirc": ("small", "robustirc_test"),
     "rethinkdb": ("small", "rethinkdb_test"),

@@ -57,8 +57,6 @@ def gen_history(fam, r2, n_ops, n_procs):
 from jepsen_tpu import parallel
 from jepsen_tpu.checker.tpu import check_keyed_tpu
 MESH = parallel.make_mesh()
-MODELS = {"reg": CASRegister, "set": SetModel, "queue": UnorderedQueue,
-          "fifo": FIFOQueue}
 kround = 0
 
 
@@ -69,10 +67,11 @@ def keyed_round(seed, cap):
     global mism
     r2 = random.Random(seed)
     fam = r2.choice(["reg", "set", "queue", "fifo"])
-    keyed = {k: gen_history(fam, random.Random(seed + 31 * k),
-                            r2.randint(6, 16), r2.randint(2, 5))[0]
-             for k in range(r2.randint(3, 12))}
-    model = MODELS[fam]()
+    pairs = [gen_history(fam, random.Random(seed + 31 * k),
+                         r2.randint(6, 16), r2.randint(2, 5))
+             for k in range(r2.randint(3, 12))]
+    keyed = {k: h for k, (h, _) in enumerate(pairs)}
+    model = pairs[0][1]
     out = check_keyed_tpu(keyed, model, mesh=MESH,
                           ladder=((16, 16, 8), (256, 32, 64)))
     for k, hk in keyed.items():
