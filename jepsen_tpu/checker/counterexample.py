@@ -78,7 +78,7 @@ def witness_prefix(p: PackedHistory, kernel: KernelSpec,
     Re-runs a bounded WGL with parent pointers; returns a list of op
     indices (into p.ops) in linearization order, or None when the
     bounded search can't reach the refutation frontier."""
-    from jepsen_tpu.checker.wgl import check_packed
+    import numpy as _np
     n = p.n
     n_req = p.n_required
     if n_req == 0:
@@ -86,6 +86,14 @@ def witness_prefix(p: PackedHistory, kernel: KernelSpec,
     f, v1, v2 = p.f.tolist(), p.v1.tolist(), p.v2.tolist()
     inv, ret = p.inv.tolist(), p.ret.tolist()
     step = kernel.step
+    # Candidate upper bound per frontier k, via the non-decreasing
+    # suffix-min of inv: every j with inv[j] < ret[k] lies below
+    # searchsorted(sufmin, ret[k]). Bounds the inner scan by the
+    # candidate window instead of n — at 100k+ ops an O(n)-per-config
+    # scan would dwarf the device search this renders for.
+    sufmin = _np.minimum.accumulate(_np.asarray(inv, _np.int64)[::-1])[::-1]
+    jmax = _np.searchsorted(sufmin, _np.asarray(ret, _np.int64),
+                            side="left")
 
     init = (0, 0, int(p.init_state))
     parent: Dict[tuple, tuple] = {init: None}
@@ -98,7 +106,7 @@ def witness_prefix(p: PackedHistory, kernel: KernelSpec,
         k, mask, state = cfg
         explored += 1
         rk = ret[k] if k < n else None
-        for j in range(k, n):
+        for j in range(k, int(jmax[k]) if k < n else k):
             if rk is None or inv[j] >= rk:
                 continue
             if (mask >> (j - k)) & 1:
@@ -139,9 +147,10 @@ def analysis(p: PackedHistory, kernel: KernelSpec,
     best_k = int(result.get("max-linearized-prefix", 0))
     states = result.get("final-states")
     if states is None:
-        # e.g. the device backend decided: harvest frontier states with a
-        # bounded CPU re-run (failures are typically local, so this is
-        # cheap relative to the refutation itself)
+        # Every engine (Python WGL, native, and the device search — which
+        # ships its last living pool's configs off-device) now reports
+        # final-states itself; this bounded CPU re-run remains only as a
+        # safety net for hand-built result dicts.
         from jepsen_tpu.checker.wgl import check_packed
         res2 = check_packed(p, kernel, max_configs=200_000)
         states = res2.get("final-states", [int(p.init_state)])

@@ -140,7 +140,7 @@ def _shr_by_mw(m, t, MW: int):
 
 
 def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
-               expand: Optional[int] = None):
+               expand: Optional[int] = None, unroll: int = 1):
     """Build the single-key search. ``n`` is the (static, padded) length of
     the *required* section — ops with finite return, sorted by return index.
     ``n_cr`` is the (static, padded) width of the *crashed* section — 'info'
@@ -150,8 +150,11 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
 
     Returns a function
       (f, v1, v2, ro, inv, ret, sufmin, cf, cv1, cv2, cinv, cps,
-       n_required, init_state) -> (done, lossy, wovf, best_k, levels)
-    of jnp scalars. Pure jnp — safe under jit, vmap, and shard_map.
+       n_required, init_state) -> (done, lossy, wovf, best_k, levels,
+       pool_k, pool_state, pool_alive)
+    — five jnp scalars plus the last living pool's [capacity] columns
+    (the frontier configs counterexample extraction reads on
+    valid:false). Pure jnp — safe under jit, vmap, and shard_map.
     ``ro[j]`` is 1 iff op j is *read-only* — its step can never change the
     state at any state where it succeeds (kernel.readonly) — which drives
     the greedy pure-op closure below.
@@ -227,17 +230,22 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
         cmask0 = jnp.zeros((C, max(MC, 1)), jnp.uint32)
         state0 = jnp.full(C, 0, jnp.int32) + init_state
         alive0 = jnp.arange(C) == 0
-        # (k, mask, cmask, state, alive, done, lossy, wovf, level, best_k)
+        # (k, mask, cmask, state, alive, done, lossy, wovf, level, best_k,
+        #  pk, ps, pa): the p* slots snapshot the incoming pool each
+        # iteration, so when the pool dies (an exhaustive refutation) the
+        # LAST LIVING frontier — its (k, state) configs — survives for
+        # counterexample extraction without any CPU re-search.
         carry0 = (k0, mask0, cmask0, state0, alive0,
                   n_required == 0, jnp.bool_(False), jnp.bool_(False),
-                  jnp.int32(0), jnp.int32(0))
+                  jnp.int32(0), jnp.int32(0),
+                  k0, state0, alive0)
 
         def active(c):
-            k, mask, cmask, state, alive, done, lossy, wovf, level, best = c
-            return (~done) & jnp.any(alive) & (level <= LMAX)
+            return (~c[5]) & jnp.any(c[4]) & (c[8] <= LMAX)
 
         def body(c):
-            k, mask, cmask, state, alive, done, lossy, wovf, level, best = c
+            (k, mask, cmask, state, alive, done, lossy, wovf, level,
+             best, _pk, _ps, _pa) = c
 
             # -- select the top-E pool rows for expansion (the pool is
             # sorted deepest-first; invalid rows sank in the merge sort) --
@@ -443,21 +451,22 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
             a3 = uniq[:C]
 
             new = (k3, m3, cm3, s3, a3, done2, lossy2, wovf2,
-                   level + 1, best2)
+                   level + 1, best2, k, state, alive)
             # Masked update: lanes finished under vmap must not mutate.
             act = active(c)
             return tuple(jnp.where(act, nw, old) for nw, old in zip(new, c))
 
         # Unrolled loop body: each while_loop iteration costs fixed
-        # dispatch/condition overhead that dwarfs the math on these small
-        # tensors, so running UNROLL search steps per iteration cuts wall
-        # time near-linearly (body is a masked update — extra applications
-        # after completion are no-ops, so correctness is unaffected).
-        import os as _os
-        unroll = int(_os.environ.get("JTPU_UNROLL", "0")) or _UNROLL
+        # per-iteration overhead (condition evaluation + kernel-launch
+        # sequencing) that can rival the math on these small tensors, so
+        # running `unroll` search steps per iteration amortizes it (body
+        # is a masked update — extra applications after completion are
+        # no-ops, so correctness is unaffected). The factor is part of
+        # the jit cache key (see _jit_single/_jit_batch) so sweeps
+        # actually recompile.
 
         def body_n(c):
-            for _ in range(unroll):
+            for _ in range(max(1, unroll)):
                 c = body(c)
             return c
 
@@ -465,10 +474,11 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
         alive_out, done = out[4], out[5]
         lossy, wovf = out[6], out[7]
         level, best = out[8], out[9]
+        pk, ps, pa = out[10], out[11], out[12]
         # Stopped at the iteration budget with work left: incomplete, so a
         # non-done outcome must not read as a refutation.
         lossy = lossy | (~done & jnp.any(alive_out))
-        return done, lossy, wovf, best, level
+        return done, lossy, wovf, best, level, pk, ps, pa
 
     return search
 
@@ -484,30 +494,38 @@ def _kernel_key(kernel: KernelSpec) -> int:
     return id(kernel)
 
 
-@functools.lru_cache(maxsize=32)
+def _unroll_factor() -> int:
+    """Search steps per while_loop iteration. JTPU_UNROLL overrides; the
+    default is 1 (measured best on the CPU backend, where the math
+    dominates) — on TPU, sweep via bench.py and set the env var."""
+    import os as _os
+    return int(_os.environ.get("JTPU_UNROLL", "0")) or _UNROLL
+
+
+@functools.lru_cache(maxsize=64)
 def _jit_single(kernel_id: int, capacity: int, window: int,
-                expand: Optional[int] = None):
+                expand: Optional[int] = None, unroll: int = 1):
     kernel = _KERNELS_BY_ID[kernel_id]
 
     def single(f, v1, v2, ro, inv, ret, sm, cf, cv1, cv2, cinv, cps,
                nr, ini):
         search = _search_fn(kernel.step, f.shape[0], cf.shape[0],
-                            capacity, window, expand)
+                            capacity, window, expand, unroll)
         return search(f, v1, v2, ro, inv, ret, sm, cf, cv1, cv2, cinv,
                       cps, nr, ini)
 
     return jax.jit(single)
 
 
-@functools.lru_cache(maxsize=32)
+@functools.lru_cache(maxsize=64)
 def _jit_batch(kernel_id: int, capacity: int, window: int,
-               expand: Optional[int] = None):
+               expand: Optional[int] = None, unroll: int = 1):
     kernel = _KERNELS_BY_ID[kernel_id]
 
     def batched(f, v1, v2, ro, inv, ret, sm, cf, cv1, cv2, cinv, cps,
                 nr, ini):
         search = _search_fn(kernel.step, f.shape[1], cf.shape[1],
-                            capacity, window, expand)
+                            capacity, window, expand, unroll)
         return jax.vmap(search)(
             f, v1, v2, ro, inv, ret, sm, cf, cv1, cv2, cinv, cps, nr,
             ini)
@@ -619,7 +637,8 @@ def _check_window(window: int) -> None:
 
 
 def _result(done: bool, lossy: bool, wovf: bool, best_k: int, levels: int,
-            p: Optional[PackedHistory] = None) -> Dict[str, Any]:
+            p: Optional[PackedHistory] = None,
+            pool: Optional[tuple] = None) -> Dict[str, Any]:
     if done:
         return {"valid": True, "levels": levels, "backend": "tpu"}
     if not (lossy or wovf):
@@ -628,6 +647,16 @@ def _result(done: bool, lossy: bool, wovf: bool, best_k: int, levels: int,
         if p is not None and p.ops and best_k < len(p.ops):
             inv_op = p.ops[best_k][0]
             out["frontier-op"] = inv_op.to_dict() if inv_op else None
+        if pool is not None:
+            # frontier states straight off the device: the last living
+            # pool's deepest configs (counterexample.analysis consumes
+            # these directly — no CPU re-search at 100k+ ops; reference
+            # checker.clj:96-107 renders from the analysis configs)
+            pk, ps, pa = (np.asarray(x) for x in pool)
+            live = pa & (pk == (pk * pa).max())
+            if live.any():
+                out["final-states"] = sorted(
+                    {int(s) for s in ps[live]})[:16]
         return out
     return {"valid": UNKNOWN, "levels": levels,
             "error": ("beam truncated the frontier" if lossy
@@ -666,7 +695,20 @@ CPU_FIRST_RUNG = (32, 4)
 
 
 def _capacity_ladder():
-    """The capacity/expand ladder for the active JAX backend."""
+    """The capacity/expand ladder for the active JAX backend.
+
+    JTPU_FIRST_RUNG="capacity,expand" pins the first rung explicitly —
+    the knob bench.py's first-rung sweep measures, so the winning shape
+    on a given accelerator can be deployed via env without a code
+    change."""
+    import os as _os
+    env = _os.environ.get("JTPU_FIRST_RUNG")
+    if env:
+        try:
+            cap, exp = (int(x) for x in env.split(","))
+            return ((cap, exp),) + CAPACITY_LADDER[1:]
+        except ValueError:
+            pass  # malformed override: fall through to the default
     try:
         backend = jax.default_backend()
     except Exception:  # noqa: BLE001 — uninitializable backend: be slim
@@ -732,10 +774,12 @@ def check_packed_tpu(p: PackedHistory, kernel: KernelSpec,
         ladder = _ladder_for(_window_needed(p))
     out: Dict[str, Any] = {}
     for cap, win, exp in ladder:
-        fn = _jit_single(_kernel_key(kernel), cap, win, exp)
-        done, lossy, wovf, best, levels = fn(*(cols[c] for c in _COLS))
+        fn = _jit_single(_kernel_key(kernel), cap, win, exp,
+                         _unroll_factor())
+        done, lossy, wovf, best, levels, pk, ps, pa = fn(
+            *(cols[c] for c in _COLS))
         out = _result(bool(done), bool(lossy), bool(wovf), int(best),
-                      int(levels), p)
+                      int(levels), p, pool=(pk, ps, pa))
         if out["valid"] is not UNKNOWN:
             return out
         if bool(wovf) and win >= MAX_WINDOW and not bool(lossy):
@@ -762,7 +806,8 @@ def warm_ladder(p: PackedHistory, kernel: KernelSpec,
     full = _ladder_for(_window_needed(p))
     ladder = full[:rungs] if rungs else full
     for cap, win, exp in ladder:
-        fn = _jit_single(_kernel_key(kernel), cap, win, exp)
+        fn = _jit_single(_kernel_key(kernel), cap, win, exp,
+                         _unroll_factor())
         jax.block_until_ready(fn(*(cols[c] for c in _COLS)))
 
 
@@ -911,7 +956,8 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
                           for a in arrays]
             else:
                 arrays = [jax.device_put(a, sh_row) for a in arrays]
-        fn = _jit_batch(_kernel_key(kernel), cap, win, exp)
+        fn = _jit_batch(_kernel_key(kernel), cap, win, exp,
+                        _unroll_factor())
         outs = fn(*arrays)
         if multiproc:
             # Per-key verdict rows live on their owning host; gather the
@@ -920,11 +966,13 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
             from jax.experimental import multihost_utils
             outs = tuple(multihost_utils.process_allgather(x, tiled=True)
                          for x in outs)
-        done, lossy, wovf, best, levels = (np.asarray(x) for x in outs)
+        (done, lossy, wovf, best, levels, pk, ps, pa) = (
+            np.asarray(x) for x in outs)
         retry = deferred
         for r, (key, cols, wneed) in enumerate(rows):
             res = _result(bool(done[r]), bool(lossy[r]), bool(wovf[r]),
-                          int(best[r]), int(levels[r]), packed[key])
+                          int(best[r]), int(levels[r]), packed[key],
+                          pool=(pk[r], ps[r], pa[r]))
             escalatable = (bool(lossy[r])
                            or (bool(wovf[r]) and win < MAX_WINDOW))
             if res["valid"] is UNKNOWN and escalatable and not last_rung:

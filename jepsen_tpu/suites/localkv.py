@@ -185,6 +185,10 @@ class LocalKVClient(client_ns.Client):
         try:
             if op.f == "read":
                 r = self._rpc({"op": "read", "key": KEY})
+                if not r.get("ok"):
+                    # e.g. forward-to-primary failed: no observation was
+                    # made — recording ok/None would be a fabricated read
+                    return op.replace(type="fail", error=r.get("error"))
                 return op.replace(type="ok", value=r.get("value"))
             if op.f == "write":
                 r = self._rpc({"op": "write", "key": KEY,
@@ -195,8 +199,15 @@ class LocalKVClient(client_ns.Client):
                 old, new = op.value
                 r = self._rpc({"op": "cas", "key": KEY, "old": old,
                                "new": new})
-                return op.replace(type="ok" if r.get("ok") else "fail",
-                                  error=r.get("error"))
+                if r.get("ok"):
+                    return op.replace(type="ok")
+                # a definite mismatch is a clean :fail; any OTHER error
+                # (forward lost after the primary may have applied it) is
+                # indeterminate and must crash to :info
+                return op.replace(
+                    type="fail" if r.get("error") == "cas mismatch"
+                    else "info",
+                    error=r.get("error"))
             raise ValueError(f"unknown op {op.f!r}")
         except (TimeoutError, OSError, json.JSONDecodeError) as e:
             self.close(test)

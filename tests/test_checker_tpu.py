@@ -617,6 +617,19 @@ class TestWideShapes:
         # still be found; refutation was impossible anyway)
         assert all(w == MAX_WINDOW for _, w, _ in _ladder_for(4000))
 
+    def test_first_rung_env_override(self, monkeypatch):
+        # JTPU_FIRST_RUNG pins the measured winner per accelerator
+        from jepsen_tpu.checker.tpu import _capacity_ladder
+        monkeypatch.setenv("JTPU_FIRST_RUNG", "512,48")
+        assert _capacity_ladder()[0] == (512, 48)
+        monkeypatch.setenv("JTPU_FIRST_RUNG", "garbage")
+        assert _capacity_ladder()[0][0] in (32, 128)  # default per backend
+        # the override also drives real checks end-to-end
+        monkeypatch.setenv("JTPU_FIRST_RUNG", "64,16")
+        from jepsen_tpu.testing import simulate_register_history
+        h = simulate_register_history(200, n_procs=4, n_vals=8, seed=1)
+        assert check_history_tpu(h, CASRegister())["valid"] is True
+
 
 class TestMaskHelpers:
     """The multi-word mask primitives vs arbitrary-precision Python ints."""
@@ -943,6 +956,24 @@ class TestScale:
                                       crash_p=0.002)
         r = check_history_tpu(h, CASRegister())
         assert r["valid"] is True
+
+    @pytest.mark.slow
+    def test_width_100_device_decides_where_native_cannot_budget(self):
+        # the width crossover (doc/native.md): at window ~100 the host
+        # DFS explodes (native: 343s/83M configs unbounded on the build
+        # host) while the pool search decides in ~47s on the CPU
+        # backend — the device verdict must be definitive and correct,
+        # and native within a 3M-config budget must still be searching
+        from jepsen_tpu.checker.native import (available,
+                                               check_history_native)
+        from jepsen_tpu.testing import wide_history
+        h = wide_history(100, 4, write_frac=0.2, seed=3)
+        r = check_history_tpu(h, CASRegister())
+        assert r["valid"] is True, r
+        if available():
+            rn = check_history_native(h, CASRegister(),
+                                      max_configs=3_000_000)
+            assert rn["valid"] is UNKNOWN, rn
 
 
 class TestCrashWidth128:

@@ -62,14 +62,73 @@ class TestLinearSvg:
         assert "counterexample" not in out
 
     def test_device_backend_renders_too(self, tmp_path):
-        # the device result carries no frontier states; the renderer
-        # harvests them with a bounded CPU re-run
         test = {"store-dir": str(tmp_path)}
         out = linearizable(CASRegister(), backend="tpu").check(
             test, _failing_history())
         assert out["valid"] is False
         if out.get("valid") is not UNKNOWN:
             assert (tmp_path / "linear.svg").exists()
+
+    def test_device_refutation_renders_without_cpu_research(
+            self, tmp_path, monkeypatch):
+        # The device search ships its last living pool's (k, state)
+        # configs off-device as final-states, so rendering a device
+        # refutation never re-runs the CPU engine — check_packed is
+        # monkeypatched to raise to prove it (at 100k+ ops a CPU
+        # re-check could dwarf the device search; see the slow tier)
+        import jepsen_tpu.checker.wgl as wgl_mod
+        from jepsen_tpu.checker.tpu import check_history_tpu
+        from jepsen_tpu.testing import simulate_register_history
+        h = simulate_register_history(600, n_procs=4, n_vals=8, seed=9)
+        rows = list(h)
+        t = rows[120].time
+        rows = (rows[:120]
+                + [Op(type="invoke", f="read", value=None, process=9,
+                      time=t),
+                   Op(type="ok", f="read", value="NEVER", process=9,
+                      time=t + 1)]
+                + rows[120:])
+        bad = History.of(rows)
+        direct = check_history_tpu(bad, CASRegister())
+        assert direct["valid"] is False
+        assert direct.get("final-states"), direct
+
+        def boom(*a, **k):
+            raise AssertionError("render re-ran the CPU engine")
+
+        monkeypatch.setattr(wgl_mod, "check_packed", boom)
+        test = {"store-dir": str(tmp_path)}
+        out = linearizable(CASRegister(), backend="tpu").check(test, bad)
+        assert out["valid"] is False
+        assert out.get("counterexample-error") is None
+        assert (tmp_path / "linear.svg").exists()
+        assert out.get("configs")  # frontier states, device-sourced
+
+    @pytest.mark.slow
+    def test_100k_device_refutation_renders_in_one_pass(
+            self, tmp_path, monkeypatch):
+        import jepsen_tpu.checker.wgl as wgl_mod
+        from jepsen_tpu.testing import simulate_register_history
+        h = simulate_register_history(100_000, n_procs=5, n_vals=16,
+                                      seed=4, crash_p=0.0002)
+        rows = list(h)
+        t = rows[400].time
+        rows = (rows[:400]
+                + [Op(type="invoke", f="read", value=None, process=9,
+                      time=t),
+                   Op(type="ok", f="read", value="NEVER", process=9,
+                      time=t + 1)]
+                + rows[400:])
+        bad = History.of(rows)
+
+        def boom(*a, **k):
+            raise AssertionError("render re-ran the CPU engine")
+
+        monkeypatch.setattr(wgl_mod, "check_packed", boom)
+        test = {"store-dir": str(tmp_path)}
+        out = linearizable(CASRegister(), backend="tpu").check(test, bad)
+        assert out["valid"] is False
+        assert (tmp_path / "linear.svg").exists()
 
 
 class TestAnalysis:
