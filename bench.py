@@ -158,9 +158,9 @@ def _wide_history_comparison():
     history with 100 fully-overlapping processes per round (the
     aerospike 100-thread CAS shape, reference aerospike/core.clj:566-575)
     makes the host DFS explode combinatorially: the C++ engine needs
-    ~343 s / 83M configs on this host, while the pool search's parallel
-    frontier + greedy read closure decides the same history in ~47 s on
-    the CPU *backend* alone — device wall-clock beats native wall-clock
+    ~343 s / 83M configs on this host, while the pool search's
+    expansion-heavy wide rungs decide the same history in ~6 s on the
+    CPU *backend* alone (59x) — device wall-clock beats native wall-clock
     before an accelerator is even attached. Native is capped here to
     keep the bench bounded; the cap counts as a loss at the cap."""
     import time as _t

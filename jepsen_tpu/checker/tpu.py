@@ -648,15 +648,30 @@ def _result(done: bool, lossy: bool, wovf: bool, best_k: int, levels: int,
             inv_op = p.ops[best_k][0]
             out["frontier-op"] = inv_op.to_dict() if inv_op else None
         if pool is not None:
-            # frontier states straight off the device: the last living
+            # Frontier evidence straight off the device: the last living
             # pool's deepest configs (counterexample.analysis consumes
             # these directly — no CPU re-search at 100k+ ops; reference
-            # checker.clj:96-107 renders from the analysis configs)
+            # checker.clj:96-107 renders from the analysis configs).
+            # The prefix is re-anchored to the POOL's deepest k so the
+            # reported states belong to the reported frontier: best_k
+            # (the all-time expansion max) can exceed it when the
+            # deepest config died childless in an earlier iteration;
+            # mixing that k with shallower states would caption the
+            # rendering with step outcomes computed from the wrong
+            # frontier. The all-time max stays as deepest-expanded.
             pk, ps, pa = (np.asarray(x) for x in pool)
             live = pa & (pk == (pk * pa).max())
             if live.any():
+                pool_k = int((pk * pa).max())
                 out["final-states"] = sorted(
                     {int(s) for s in ps[live]})[:16]
+                if pool_k != best_k:
+                    out["deepest-expanded"] = best_k
+                    out["max-linearized-prefix"] = pool_k
+                    if p is not None and p.ops and pool_k < len(p.ops):
+                        inv_op = p.ops[pool_k][0]
+                        out["frontier-op"] = (inv_op.to_dict()
+                                              if inv_op else None)
         return out
     return {"valid": UNKNOWN, "levels": levels,
             "error": ("beam truncated the frontier" if lossy
@@ -666,23 +681,19 @@ def _result(done: bool, lossy: bool, wovf: bool, best_k: int, levels: int,
             "backend": "tpu"}
 
 
-#: Auto-escalation ladder for capacity=None: (capacity, window, expand)
-#: rungs. Best-first rungs (expand < capacity) find witnesses cheaply —
-#: for most *valid* histories the first rung completes regardless of
-#: reachable-space size, since unexpanded pool rows double as the
-#: backtrack stack; the readonly closure absorbs whole read runs per
-#: step, so a slim first rung decides most histories an order of
-#: magnitude faster than a wide one (10k-op flagship on the CPU
-#: backend: 9.9s at 1024/64, 1.38s at 128/8, 0.62s at the 32/4 rung
-#: _capacity_ladder() picks there — near-identical level counts).
-#: Bigger rungs refute exhaustively (pool death with no
-#: truncation) or recover witnesses a slim pool greedily dropped; wider
-#: rungs exist for high-concurrency histories (host-side rung selection
-#: skips the narrow ones when the needed window is provably larger).
-ESCALATION = ((128, 32, 8), (1024, 32, 64), (4096, 64, 256),
-              (16384, 128, 1024))
-
-#: Capacity/expand escalation, window chosen separately per history.
+#: Capacity/expand escalation for NARROW histories (window <= 32),
+#: window chosen separately per history (_ladder_for). Best-first rungs
+#: (expand < capacity) find witnesses cheaply — for most *valid*
+#: histories the first rung completes regardless of reachable-space
+#: size, since unexpanded pool rows double as the backtrack stack; the
+#: readonly closure absorbs whole read runs per step, so a slim first
+#: rung decides most histories an order of magnitude faster than a wide
+#: one (10k-op flagship on the CPU backend: 9.9s at 1024/64, 1.38s at
+#: 128/8, 0.62s at the 32/4 rung _capacity_ladder() picks there —
+#: near-identical level counts). Bigger rungs refute exhaustively (pool
+#: death with no truncation) or recover witnesses a slim pool greedily
+#: dropped. Wide histories use WIDE_LADDER instead (expansion must
+#: track frontier width).
 CAPACITY_LADDER = ((128, 8), (1024, 64), (4096, 256), (16384, 1024))
 
 #: CPU-backend first rung. Measured on the 10k/100k flagship shapes:
@@ -728,12 +739,32 @@ def _window_bucket(wneed: int) -> int:
     return MAX_WINDOW
 
 
+#: Expansion-heavy rungs for WIDE histories (needed window > 32). A
+#: wide frontier grows ~window new configs per depth, so a slim
+#: best-first expansion falls behind and goes lossy long before any
+#: witness: on wide_history(100,4) every slim rung (128/8 .. 4096/256)
+#: burns its full level budget lossy, while 512/512 decides in 144
+#: levels / ~6 s warm on the CPU backend (vs 343 s for the native DFS).
+#: Expansion comparable to the frontier width is the knob, not pool
+#: capacity.
+WIDE_LADDER = ((512, 512), (4096, 1024), (16384, 4096))
+
+
 def _ladder_for(wneed: int):
     """Capacity escalates at exactly the window this history needs —
     decoupled from width, so a narrow crash-heavy history never pays
-    for multi-word masks and a wide history starts slim too (a slim
-    pool with a wide window is still cheap: E x W stays small)."""
+    for multi-word masks. Wide histories (multi-word windows) get the
+    expansion-heavy rungs instead of the slim best-first ones."""
     w = _window_bucket(wneed)
+    if wneed > MAX_WINDOW:
+        # Refutation is impossible at any supported window (overflow is
+        # inevitable), so rungs exist only to hunt a witness — and past
+        # 4096/1024 the hunt has diminishing returns. Cap the ladder
+        # instead of burning minutes on the widest pool; >128-offset
+        # exact checking is the native engine's regime (doc/native.md).
+        return tuple((c, w, e) for c, e in WIDE_LADDER[:2])
+    if wneed > 32:
+        return tuple((c, w, e) for c, e in WIDE_LADDER)
     return tuple((c, w, e) for c, e in _capacity_ladder())
 
 
@@ -749,9 +780,10 @@ def check_packed_tpu(p: PackedHistory, kernel: KernelSpec,
                      expand: Optional[int] = None) -> Dict[str, Any]:
     """Check one packed single-key history on the default JAX backend.
 
-    capacity=None auto-escalates through ESCALATION (skipping rungs whose
-    window is provably too narrow for this history), retrying on capacity
-    overflow (and on window overflow while the window can still grow).
+    capacity=None auto-escalates through _ladder_for's rungs
+    (CAPACITY_LADDER at the history's needed window, or WIDE_LADDER for
+    multi-word windows), retrying on capacity overflow (and on window
+    overflow while the window can still grow).
     With an explicit capacity, ``expand`` < capacity selects best-first
     search (None = exhaustive level-synchronous BFS)."""
     if window is not None:
@@ -847,8 +879,10 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
 
     With a mesh, key-batch arrays are sharded over ``axis`` and XLA's SPMD
     partitioner runs each shard's searches on its own device over ICI.
-    capacity=None escalates the whole batch through ESCALATION, re-running
-    only keys whose searches overflowed.
+    capacity=None escalates the whole batch through the narrow capacity
+    ladder plus WIDE_LADDER tail rungs, re-running only keys whose
+    searches overflowed (and only on rungs that actually grow their
+    capacity or window).
     """
     if window is not None:
         _check_window(window)
@@ -879,7 +913,12 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
     crash_counts = [p.n - p.n_required for p in packed.values()]
     cr = _crash_width(min(max(crash_counts, default=0), CRASH_MAX))
 
-    rows = []      # (key, cols, window_needed) for device-bound keys
+    # rows: (key, cols, window_needed, max_cap_tried, max_win_tried) —
+    # the tried maxima keep escalation monotone: a key that overflowed a
+    # 16384 pool must not re-run on a later rung whose capacity AND
+    # window are both no larger (e.g. the wide tail's 512 rung, which
+    # exists for deferred wide keys, not lossy narrow ones).
+    rows = []
     for key, p in packed.items():
         if p.n_required == 0:
             results[key] = {"valid": True, "levels": 0, "backend": "tpu"}
@@ -891,7 +930,7 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
                 "error": f"{p.n - p.n_required} crashed ops exceed the "
                          f"crashed-set width {CRASH_MAX}"}
             continue
-        rows.append((key, cols, _window_needed(p)))
+        rows.append((key, cols, _window_needed(p), 0, 0))
 
     if ladder is not None:
         # caller-supplied escalation rungs (tests, dryruns: small rungs
@@ -903,9 +942,11 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
         ladder = ((capacity, window or WINDOW, expand),)
     else:
         # capacity ladder at the narrow window first (most keys), then
-        # the wide rungs the per-row deferral routes wide keys to
+        # the expansion-heavy wide rungs the per-row deferral routes
+        # wide keys to (see WIDE_LADDER)
         ladder = (tuple((c, 32, e) for c, e in _capacity_ladder())
-                  + ((4096, 64, 256), (16384, 128, 1024)))
+                  + ((512, 64, 512), (4096, 128, 1024),
+                     (16384, 128, 4096)))
 
     for step, (cap, win, exp) in enumerate(ladder):
         if not rows:
@@ -916,17 +957,23 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
             # straight to the next rung — running them here would only
             # report window overflow. (Narrow keys still finish on the
             # cheap early rungs; one wide key must not drag the whole
-            # batch onto the widest pool.)
-            runnable = [r for r in rows if r[2] <= win]
-            deferred = [r for r in rows if r[2] > win]
+            # batch onto the widest pool.) A retried key additionally
+            # skips rungs that grow NEITHER its capacity nor its window —
+            # re-running a smaller pool on the same window is guaranteed
+            # lossy again.
+            runnable, deferred = [], []
+            for r in rows:
+                if r[2] <= win and (cap > r[3] or win > r[4]):
+                    runnable.append(r)
+                else:
+                    deferred.append(r)
         else:
             runnable, deferred = rows, []
         if not runnable:
             rows = deferred
             continue
         rows = runnable
-        arrays = [np.stack([cols[c] for _, cols, _ in rows])
-                  for c in _COLS]
+        arrays = [np.stack([r[1][c] for r in rows]) for c in _COLS]
         multiproc = False
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -961,22 +1008,43 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
         outs = fn(*arrays)
         if multiproc:
             # Per-key verdict rows live on their owning host; gather the
-            # global vectors so every process takes identical host-side
-            # decisions (escalation retries stay SPMD-deterministic).
+            # scalar verdict vectors so every process takes identical
+            # host-side decisions (escalation retries stay
+            # SPMD-deterministic).
             from jax.experimental import multihost_utils
-            outs = tuple(multihost_utils.process_allgather(x, tiled=True)
-                         for x in outs)
-        (done, lossy, wovf, best, levels, pk, ps, pa) = (
-            np.asarray(x) for x in outs)
+            scalars = tuple(
+                multihost_utils.process_allgather(x, tiled=True)
+                for x in outs[:5])
+        else:
+            scalars = outs[:5]
+        done, lossy, wovf, best, levels = (np.asarray(x)
+                                           for x in scalars)
+        # Pool columns ([capacity] rows per key) are only read for clean
+        # refutations — don't ship up to 16384 ints/key off-device (and
+        # over DCN) for the common all-valid rung. "Any refutation?" is
+        # derived from the gathered scalars, so multi-host processes
+        # agree on whether to gather the pools.
+        refuted = ~done & ~lossy & ~wovf
+        pk = ps = pa = None
+        if refuted.any():
+            pools = outs[5:]
+            if multiproc:
+                from jax.experimental import multihost_utils
+                pools = tuple(
+                    multihost_utils.process_allgather(x, tiled=True)
+                    for x in pools)
+            pk, ps, pa = (np.asarray(x) for x in pools)
         retry = deferred
-        for r, (key, cols, wneed) in enumerate(rows):
+        for r, (key, cols, wneed, mcap, mwin) in enumerate(rows):
             res = _result(bool(done[r]), bool(lossy[r]), bool(wovf[r]),
                           int(best[r]), int(levels[r]), packed[key],
-                          pool=(pk[r], ps[r], pa[r]))
+                          pool=(None if pk is None
+                                else (pk[r], ps[r], pa[r])))
             escalatable = (bool(lossy[r])
                            or (bool(wovf[r]) and win < MAX_WINDOW))
             if res["valid"] is UNKNOWN and escalatable and not last_rung:
-                retry.append((key, cols, wneed))
+                retry.append((key, cols, wneed,
+                              max(mcap, cap), max(mwin, win)))
             else:
                 results[key] = res
         rows = retry

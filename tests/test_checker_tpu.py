@@ -603,19 +603,25 @@ class TestWideShapes:
 
     def test_rung_selection_matches_needed_window(self):
         from jepsen_tpu.checker.tpu import (
-            CAPACITY_LADDER, MAX_WINDOW, _ladder_for, _window_needed)
+            MAX_WINDOW, WIDE_LADDER, _ladder_for, _window_needed)
         h = wide_history(100, 2, seed=5)
         p = pack_history(h, CAS_REGISTER_KERNEL)
         rungs = _ladder_for(_window_needed(p))
-        # capacity escalates at exactly the window this history needs
+        # capacity escalates at exactly the window this history needs,
+        # with the expansion-heavy wide rungs (slim best-first expansion
+        # goes lossy long before a witness on wide frontiers)
         assert all(w >= _window_needed(p) for _, w, _ in rungs)
-        assert len(rungs) == len(CAPACITY_LADDER)
+        assert rungs == tuple((c, 128, e) for c, e in WIDE_LADDER)
         # narrow histories escalate capacity at the narrow window only —
         # no multi-word-mask rungs for a history that can't use them
         assert all(w == 32 for _, w, _ in _ladder_for(5))
-        # impossibly wide: every rung runs at MAX_WINDOW (witness may
-        # still be found; refutation was impossible anyway)
-        assert all(w == MAX_WINDOW for _, w, _ in _ladder_for(4000))
+        # impossibly wide: refutation is impossible (window overflow is
+        # inevitable), so the ladder is capped to the witness-hunting
+        # rungs at MAX_WINDOW
+        over = _ladder_for(4000)
+        assert all(w == MAX_WINDOW for _, w, _ in over)
+        assert over == tuple((c, MAX_WINDOW, e)
+                             for c, e in WIDE_LADDER[:2])
 
     def test_first_rung_env_override(self, monkeypatch):
         # JTPU_FIRST_RUNG pins the measured winner per accelerator
@@ -961,7 +967,7 @@ class TestScale:
     def test_width_100_device_decides_where_native_cannot_budget(self):
         # the width crossover (doc/native.md): at window ~100 the host
         # DFS explodes (native: 343s/83M configs unbounded on the build
-        # host) while the pool search decides in ~47s on the CPU
+        # host) while the pool search decides in ~6s on the CPU
         # backend — the device verdict must be definitive and correct,
         # and native within a 3M-config budget must still be searching
         from jepsen_tpu.checker.native import (available,
