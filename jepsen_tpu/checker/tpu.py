@@ -140,7 +140,8 @@ def _shr_by_mw(m, t, MW: int):
 
 
 def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
-               expand: Optional[int] = None, unroll: int = 1):
+               expand: Optional[int] = None, unroll: int = 1,
+               shard_axis: Optional[str] = None):
     """Build the single-key search. ``n`` is the (static, padded) length of
     the *required* section — ops with finite return, sorted by return index.
     ``n_cr`` is the (static, padded) width of the *crashed* section — 'info'
@@ -195,6 +196,22 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
     E = min(expand or C, C)
     MW = (W + 31) // 32           # mask words (window bits)
     MC = (CR + 31) // 32          # crashed-mask words
+
+    if shard_axis is not None:
+        # Pool-sharded mode (single-history scale-out): the pool, the
+        # candidate grids derived from it, and the merge sort's operand
+        # rows are partitioned over the mesh axis; XLA's SPMD partitioner
+        # parallelizes the expansion/step math per shard and inserts the
+        # collectives the global sort/dedup needs. Callers guarantee
+        # capacity and expand divide the mesh axis.
+        from jax.sharding import PartitionSpec as _P
+
+        def _sc(x):
+            return jax.lax.with_sharding_constraint(
+                x, _P(*((shard_axis,) + (None,) * (x.ndim - 1))))
+    else:
+        def _sc(x):
+            return x
     LEADERS = 8  # group-prefix rows tested as dominators
     MAXK = jnp.int32(1 << 30)
     #: iteration budget: the witness path alone needs ~n+CR expansions, and
@@ -225,11 +242,11 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
                n_required, init_state):
         offs = jnp.arange(W, dtype=jnp.int32)          # [W]
 
-        k0 = jnp.zeros(C, jnp.int32)
-        mask0 = jnp.zeros((C, MW), jnp.uint32)
-        cmask0 = jnp.zeros((C, max(MC, 1)), jnp.uint32)
-        state0 = jnp.full(C, 0, jnp.int32) + init_state
-        alive0 = jnp.arange(C) == 0
+        k0 = _sc(jnp.zeros(C, jnp.int32))
+        mask0 = _sc(jnp.zeros((C, MW), jnp.uint32))
+        cmask0 = _sc(jnp.zeros((C, max(MC, 1)), jnp.uint32))
+        state0 = _sc(jnp.full(C, 0, jnp.int32) + init_state)
+        alive0 = _sc(jnp.arange(C) == 0)
         # (k, mask, cmask, state, alive, done, lossy, wovf, level, best_k,
         #  pk, ps, pa): the p* slots snapshot the incoming pool each
         # iteration, so when the pool dies (an exhaustive refutation) the
@@ -397,6 +414,7 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
                          + [fs, pc.astype(jnp.int32)] + fcmw)
             else:
                 terms = [key1, fk] + fmw + [fs]
+            terms = [_sc(t) for t in terms]
             sorted_terms = lax.sort(tuple(terms), num_keys=len(terms))
             key1 = sorted_terms[0]
             fk = sorted_terms[1]
@@ -450,6 +468,11 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
             s3 = fs[:C]
             a3 = uniq[:C]
 
+            if shard_axis is not None:
+                k3, s3, a3 = _sc(k3), _sc(s3), _sc(a3)
+                m3 = _sc(m3)
+                if MC:
+                    cm3 = _sc(cm3)
             new = (k3, m3, cm3, s3, a3, done2, lossy2, wovf2,
                    level + 1, best2, k, state, alive)
             # Masked update: lanes finished under vmap must not mutate.
@@ -504,13 +527,14 @@ def _unroll_factor() -> int:
 
 @functools.lru_cache(maxsize=64)
 def _jit_single(kernel_id: int, capacity: int, window: int,
-                expand: Optional[int] = None, unroll: int = 1):
+                expand: Optional[int] = None, unroll: int = 1,
+                shard_axis: Optional[str] = None):
     kernel = _KERNELS_BY_ID[kernel_id]
 
     def single(f, v1, v2, ro, inv, ret, sm, cf, cv1, cv2, cinv, cps,
                nr, ini):
         search = _search_fn(kernel.step, f.shape[0], cf.shape[0],
-                            capacity, window, expand, unroll)
+                            capacity, window, expand, unroll, shard_axis)
         return search(f, v1, v2, ro, inv, ret, sm, cf, cv1, cv2, cinv,
                       cps, nr, ini)
 
@@ -774,6 +798,24 @@ def _select_rungs(wneed: int):
     return _ladder_for(wneed)
 
 
+def _prep_single(p: PackedHistory,
+                 kernel: KernelSpec) -> tuple:
+    """Shared single-history preamble for check_packed_tpu and
+    check_packed_sharded: (cols, None) on success, (None, result) for
+    the trivially-complete and crashed-set-overflow early outs."""
+    if p.n_required == 0:
+        return None, {"valid": True, "levels": 0, "backend": "tpu"}
+    cr = _crash_width(p.n - p.n_required)
+    cols = (None if cr is None
+            else _split_packed(p, _bucket(p.n_required), cr, kernel))
+    if cols is None:
+        return None, {
+            "valid": UNKNOWN, "backend": "tpu",
+            "error": f"{p.n - p.n_required} crashed ops exceed the "
+                     f"crashed-set width {CRASH_MAX}"}
+    return cols, None
+
+
 def check_packed_tpu(p: PackedHistory, kernel: KernelSpec,
                      capacity: Optional[int] = None,
                      window: Optional[int] = WINDOW,
@@ -788,17 +830,11 @@ def check_packed_tpu(p: PackedHistory, kernel: KernelSpec,
     search (None = exhaustive level-synchronous BFS)."""
     if window is not None:
         _check_window(window)
-    if p.n_required == 0:
-        return {"valid": True, "levels": 0, "backend": "tpu"}
+    cols, early = _prep_single(p, kernel)
+    if early is not None:
+        return early
     from jepsen_tpu import accel
     accel.ensure_usable("check_packed_tpu")
-    cr = _crash_width(p.n - p.n_required)
-    cols = (None if cr is None
-            else _split_packed(p, _bucket(p.n_required), cr, kernel))
-    if cols is None:
-        return {"valid": UNKNOWN, "backend": "tpu",
-                "error": f"{p.n - p.n_required} crashed ops exceed the "
-                         f"crashed-set width {CRASH_MAX}"}
     if capacity is not None:
         _check_window(window or WINDOW)
         ladder = ((capacity, window or WINDOW, expand),)
@@ -817,6 +853,74 @@ def check_packed_tpu(p: PackedHistory, kernel: KernelSpec,
         if bool(wovf) and win >= MAX_WINDOW and not bool(lossy):
             return out  # a bigger frontier won't fix a window overflow
     return out
+
+
+#: Mesh axis name for pool-sharded single-history searches.
+POOL_AXIS = "pool"
+
+
+def check_packed_sharded(p: PackedHistory, kernel: KernelSpec,
+                         mesh: "jax.sharding.Mesh",
+                         capacity: int = 4096,
+                         window: Optional[int] = None,
+                         expand: Optional[int] = None) -> Dict[str, Any]:
+    """Check ONE packed history with its search pool sharded over a
+    device mesh — single-history scale-out, the frontier-parallel WGL of
+    SURVEY §2.5: while keyed batches data-parallelize across keys
+    (check_keyed_tpu), here the devices cooperate on a single search.
+    The pool, the E×W candidate expansion and the model-step math are
+    partitioned over the mesh axis; XLA's SPMD partitioner inserts the
+    collectives the global merge sort/dedup needs, and validity is a
+    scalar all-reduce. The win regime is ultra-wide histories whose
+    per-level expansion dwarfs one chip's lanes.
+
+    The mesh axis must divide ``capacity`` and ``expand``; window=None
+    picks the history's needed bucket. Returns the same result dict as
+    check_packed_tpu."""
+    from jepsen_tpu import accel
+    accel.ensure_usable("check_packed_sharded")
+    cols, early = _prep_single(p, kernel)
+    if early is not None:
+        return early
+    naxis = mesh.shape[POOL_AXIS]
+    if expand is None:
+        # best-first default at ~capacity/8, rounded up to a multiple of
+        # the mesh axis (note this differs from check_packed_tpu, where
+        # expand=None means exhaustive level-synchronous BFS — a sharded
+        # search exists to go big, so best-first is the sane default)
+        per = max(1, capacity // 8)
+        expand = max(naxis, -(-per // naxis) * naxis)
+    if capacity % naxis or expand % naxis:
+        raise ValueError(
+            f"the mesh axis ({naxis}) must divide capacity "
+            f"({capacity}) and expand ({expand})")
+    if window is None:
+        window = _window_bucket(_window_needed(p))
+    _check_window(window)
+    fn = _jit_single(_kernel_key(kernel), capacity, window, expand,
+                     _unroll_factor(), POOL_AXIS)
+    with jax.set_mesh(mesh):
+        done, lossy, wovf, best, levels, pk, ps, pa = fn(
+            *(cols[c] for c in _COLS))
+        out = _result(bool(done), bool(lossy), bool(wovf), int(best),
+                      int(levels), p, pool=(pk, ps, pa))
+    out["pool-sharding"] = f"{POOL_AXIS}={naxis}"
+    return out
+
+
+def check_history_sharded(history: History, model: Model,
+                          mesh: "jax.sharding.Mesh",
+                          **kwargs) -> Optional[Dict[str, Any]]:
+    """Pack + pool-sharded check (see check_packed_sharded). None when
+    the model has no integer kernel."""
+    try:
+        pk = pack_with_init(history, model)
+    except ValueError:
+        return None
+    if pk is None:
+        return None
+    packed, kernel = pk
+    return check_packed_sharded(packed, kernel, mesh, **kwargs)
 
 
 def warm_ladder(p: PackedHistory, kernel: KernelSpec,

@@ -15,7 +15,14 @@ data-parallel, so no cross-device collectives are needed in the hot
 loop and ICI/DCN only carries the final validity reduction), and let
 the compiler do the rest. Multi-host: every process contributes its
 local devices via `jax.distributed.initialize`; the same jitted program
-runs SPMD on each host.
+runs SPMD on each host (certified by the two-process DCN dryrun,
+__graft_entry__.dryrun_dcn).
+
+A second, orthogonal axis exists for single searches: pool sharding
+(`checker.tpu.check_packed_sharded`) partitions ONE search's frontier
+pool over the mesh so the devices cooperate on one history — the
+sequence-parallel analog, for ultra-wide histories whose per-level
+expansion dwarfs one chip.
 
 Deliberately dependency-light: importing this module does not import
 jax; every function resolves it lazily so the pure-CPU paths (native

@@ -78,6 +78,63 @@ class TestDistributedCheck:
         assert out["results"]["k"]["capacity-overflow"] is True
 
 
+class TestPoolSharded:
+    """Single-history scale-out: one search's pool partitioned over the
+    mesh (the frontier-parallel WGL of SURVEY §2.5), vs the per-key
+    data parallelism of check_keyed_tpu."""
+
+    def _mesh(self):
+        from jepsen_tpu.checker.tpu import POOL_AXIS
+        return parallel.make_mesh(axis=POOL_AXIS)
+
+    def test_matches_unsharded_verdicts(self):
+        from jepsen_tpu.checker import UNKNOWN
+        from jepsen_tpu.checker.tpu import (check_history_sharded,
+                                            check_history_tpu)
+        mesh = self._mesh()
+        rng = random.Random(23)
+        n = 0
+        for i in range(15):
+            h = random_register_history(rng, n_procs=4, n_ops=10,
+                                        n_vals=3, crash_p=0.1)
+            want = check_history_tpu(h, CASRegister())["valid"]
+            got = check_history_sharded(h, CASRegister(), mesh,
+                                        capacity=64, expand=16)["valid"]
+            if UNKNOWN in (want, got):
+                continue
+            n += 1
+            assert got is want, (i, want, got)
+        assert n > 8
+
+    def test_refutation_carries_final_states(self):
+        from jepsen_tpu.checker.tpu import check_history_sharded
+        from jepsen_tpu.history import History, Op
+        rows = [Op(type="invoke", f="write", value=1, process=0, time=0),
+                Op(type="ok", f="write", value=1, process=0, time=1),
+                Op(type="invoke", f="read", value=None, process=1,
+                   time=2),
+                Op(type="ok", f="read", value=9, process=1, time=3)]
+        mesh = self._mesh()
+        r = check_history_sharded(History.of(rows), CASRegister(),
+                                  mesh, capacity=64, expand=8)
+        assert r["valid"] is False
+        assert r.get("final-states")
+        from jepsen_tpu.checker.tpu import POOL_AXIS
+        assert r["pool-sharding"] == f"pool={mesh.shape[POOL_AXIS]}"
+
+    def test_divisibility_enforced(self):
+        import pytest as _pytest
+        from jepsen_tpu.checker.tpu import check_history_sharded
+        from jepsen_tpu.history import History, Op
+        h = History.of([Op(type="invoke", f="write", value=1, process=0,
+                           time=0),
+                        Op(type="ok", f="write", value=1, process=0,
+                           time=1)])
+        with _pytest.raises(ValueError, match="divide"):
+            check_history_sharded(h, CASRegister(), self._mesh(),
+                                  capacity=100)
+
+
 class TestDCN:
     def test_two_process_dcn_keyed_check(self):
         """Two OS processes join one JAX cluster over a localhost
