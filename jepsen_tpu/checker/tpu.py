@@ -879,10 +879,11 @@ def check_packed_sharded(p: PackedHistory, kernel: KernelSpec,
     check_packed_tpu."""
     from jepsen_tpu import accel
     accel.ensure_usable("check_packed_sharded")
+    naxis = mesh.shape[POOL_AXIS]
     cols, early = _prep_single(p, kernel)
     if early is not None:
+        early["pool-sharding"] = f"{POOL_AXIS}={naxis}"
         return early
-    naxis = mesh.shape[POOL_AXIS]
     if expand is None:
         # best-first default at ~capacity/8, rounded up to a multiple of
         # the mesh axis (note this differs from check_packed_tpu, where

@@ -39,6 +39,15 @@ mism = 0
 
 
 def gen_history(fam, r2, n_ops, n_procs):
+    if fam == "wide":
+        # high-concurrency bursts (the WIDE_LADDER regime, small enough
+        # for the Python oracle): every op of a round overlaps every
+        # other, with occasional corruption so refutations get fuzzed
+        from jepsen_tpu.testing import wide_history
+        return (wide_history(r2.randint(8, 18), r2.randint(1, 2),
+                             write_frac=0.4, seed=r2.getrandbits(30),
+                             corrupt=r2.random() < 0.3),
+                CASRegister())
     if fam == "reg":
         return (random_register_history(r2, n_procs=n_procs, n_ops=n_ops,
                                         n_vals=3, crash_p=0.2),
@@ -91,6 +100,10 @@ while time.time() < DEADLINE:
     seed = rng.getrandbits(32)
     r2 = random.Random(seed)
     fam = rng.choice(["reg", "set", "queue", "fifo"])
+    if rounds % 11 == 0:
+        # wide rounds are ~50x costlier (oracle + per-shape compiles):
+        # sample them instead of letting them throttle the soak
+        fam = "wide"
     n_ops = rng.randint(6, 16)
     n_procs = rng.randint(2, 5)
     h, model = gen_history(fam, r2, n_ops, n_procs)
