@@ -150,8 +150,8 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
     candidate set and so can't live in the offset window.
 
     Returns a function
-      (f, v1, v2, ro, inv, ret, sufmin, cf, cv1, cv2, cinv, cps,
-       n_required, init_state) -> (done, lossy, wovf, best_k, levels,
+      (f, v1, v2, ro, fr, inv, ret, sufmin, cf, cv1, cv2, cinv,
+       cps, n_required, init_state) -> (done, lossy, wovf, best_k, levels,
        pool_k, pool_state, pool_alive)
     — five jnp scalars plus the last living pool's [capacity] columns
     (the frontier configs counterexample extraction reads on
@@ -238,9 +238,48 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
     def _shr_by(m, t):
         return _shr_by_mw(m, t, MW)
 
-    def search(f, v1, v2, ro, inv, ret, sufmin, cf, cv1, cv2, cinv, cps,
-               n_required, init_state):
+    def search(f, v1, v2, ro, fr, inv, ret, sufmin, cf, cv1, cv2, cinv,
+               cps, n_required, init_state):
         offs = jnp.arange(W, dtype=jnp.int32)          # [W]
+
+        def fast_forward(kk, ss, go, cm_rows):
+            """Advance rows through runs of FORCED ops (fr[k]=1: op k is
+            the unique required candidate at frontier k, which also
+            implies the mask is empty there) without paying a sort-level
+            per op. Crashed candidates stop the run via the per-row
+            boundary: the first frontier whose return exceeds the
+            smallest UNTAKEN crashed invocation — up to there no crashed
+            op is linearizable, so the forced successor is truly unique
+            and skipping the intermediate configs loses nothing (each
+            had exactly one continuation). A failing forced step leaves
+            the row at the failing frontier to die (or be reported) in
+            the normal expansion. Realistic staggered workloads (etcd's
+            1/30-stagger tutorial shape) are mostly forced runs, which
+            this collapses from O(n) levels to O(#concurrent regions)."""
+            if CR:
+                ctk = jnp.any(
+                    (cm_rows[:, None, :] & cbitmat[None, :, :]) != 0,
+                    axis=-1)                             # [R, CR]
+                umin = jnp.min(jnp.where(ctk, RET_INF, cinv[None, :]),
+                               axis=-1)                  # [R]
+                bound = jnp.searchsorted(ret, umin, side="right")
+            else:
+                bound = jnp.full(kk.shape, n, jnp.int32)
+
+            def ff_cond(c):
+                return jnp.any(c[2])
+
+            def ff_body(c):
+                k_, s_, go_ = c
+                kc_ = jnp.clip(k_, 0, n - 1)
+                s2_, ok_ = step(s_, f[kc_], v1[kc_], v2[kc_])
+                adv = (go_ & (fr[kc_] > 0) & (k_ < bound)
+                       & (k_ < n_required) & ok_)
+                return (k_ + adv, jnp.where(adv, s2_.astype(jnp.int32),
+                                            s_), adv)
+
+            kk, ss, _ = lax.while_loop(ff_cond, ff_body, (kk, ss, go))
+            return kk, ss
 
         k0 = _sc(jnp.zeros(C, jnp.int32))
         mask0 = _sc(jnp.zeros((C, MW), jnp.uint32))
@@ -325,13 +364,21 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
             k_adv = k_e + 1 + t
             m_adv = _shr_by(m1, t)
 
+            s2 = s2.astype(jnp.int32)
+            # forced fast-forward on the frontier-advance successor: when
+            # it lands on a forced run, absorb the whole run this level.
+            # (fr[k] implies the mask there is empty: a masked op would
+            # have been concurrent with op k when it was linearized.)
+            k_adv, s2_0 = fast_forward(k_adv, s2[:, 0], valid[:, 0],
+                                       cm_e)
+            s2 = s2.at[:, 0].set(s2_0)
+
             is0 = offs[None, :] == 0                            # [1, W]
             k2 = jnp.where(is0, k_adv[:, None], k_e[:, None])
             m2 = jnp.where(is0[:, :, None], m_adv[:, None, :],
                            m_e[:, None, :] | bitmat[None, :, :])  # [E,W,MW]
             cm2 = jnp.broadcast_to(cm_e[:, None, :],
                                    (E, W, max(MC, 1)))
-            s2 = s2.astype(jnp.int32)
 
             # -- expand crashed ops: [E, CR] successor grid ---------------
             # A crashed op is a candidate once invoked before the frontier
@@ -374,10 +421,12 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
 
             # -- flatten both grids, append the unexpanded pool remainder,
             # and check completion ----------------------------------------
+            # the closure successor may also land on a forced run
+            kcl, scl = fast_forward(kcl, s_e, closure_ok, cm_e)
             segs = ([(k2.reshape(-1), m2.reshape(-1, MW),
                       cm2.reshape(-1, max(MC, 1)), s2.reshape(-1),
                       valid.reshape(-1)),
-                     (kcl, mcl, cm_e, s_e, closure_ok)]
+                     (kcl, mcl, cm_e, scl, closure_ok)]
                     + crash_rows
                     + [(k[E:], mask[E:], cmask[E:], state[E:], alive[E:])])
             fk = jnp.concatenate([s[0] for s in segs])
@@ -531,12 +580,12 @@ def _jit_single(kernel_id: int, capacity: int, window: int,
                 shard_axis: Optional[str] = None):
     kernel = _KERNELS_BY_ID[kernel_id]
 
-    def single(f, v1, v2, ro, inv, ret, sm, cf, cv1, cv2, cinv, cps,
-               nr, ini):
+    def single(f, v1, v2, ro, fr, inv, ret, sm, cf, cv1, cv2, cinv,
+               cps, nr, ini):
         search = _search_fn(kernel.step, f.shape[0], cf.shape[0],
                             capacity, window, expand, unroll, shard_axis)
-        return search(f, v1, v2, ro, inv, ret, sm, cf, cv1, cv2, cinv,
-                      cps, nr, ini)
+        return search(f, v1, v2, ro, fr, inv, ret, sm, cf, cv1, cv2,
+                      cinv, cps, nr, ini)
 
     return jax.jit(single)
 
@@ -546,13 +595,13 @@ def _jit_batch(kernel_id: int, capacity: int, window: int,
                expand: Optional[int] = None, unroll: int = 1):
     kernel = _KERNELS_BY_ID[kernel_id]
 
-    def batched(f, v1, v2, ro, inv, ret, sm, cf, cv1, cv2, cinv, cps,
-                nr, ini):
+    def batched(f, v1, v2, ro, fr, inv, ret, sm, cf, cv1, cv2, cinv,
+                cps, nr, ini):
         search = _search_fn(kernel.step, f.shape[1], cf.shape[1],
                             capacity, window, expand, unroll)
         return jax.vmap(search)(
-            f, v1, v2, ro, inv, ret, sm, cf, cv1, cv2, cinv, cps, nr,
-            ini)
+            f, v1, v2, ro, fr, inv, ret, sm, cf, cv1, cv2, cinv, cps,
+            nr, ini)
 
     return jax.jit(batched)
 
@@ -590,6 +639,19 @@ def _split_packed(p: PackedHistory, breq: int, cr: int,
         for j in range(nr):
             if kernel.readonly(int(p.f[j]), int(p.v1[j]), int(p.v2[j])):
                 ro[j] = 1
+    # sm: suffix-min of padded inv (padding is RET_INF, so entries <= nr
+    # equal the required-only suffix-min — computed once, reused by fr)
+    sm = _suffix_min_inv(inv_req, breq)
+    # fr[j] = 1 iff required op j is FORCED: no other required op is
+    # concurrent with it (sufmin[j+1] >= ret[j]), so at frontier j with
+    # an empty mask the op is the unique required candidate and the
+    # search can advance through it without paying a level (the device
+    # fast-forward; crashed candidates are excluded dynamically via the
+    # per-row boundary). Padding rows 0.
+    fr = np.zeros(breq, dtype=np.int32)
+    if nr:
+        idx = np.searchsorted(sm[:nr + 1], p.ret[:nr], side="left")
+        fr[:nr] = (idx <= np.arange(nr) + 1).astype(np.int32)
     # cps[j]: previous crashed op with identical (f, v1, v2), or -1 —
     # drives the canonical-order pruning (identical crashed ops are
     # interchangeable, so only the lowest available untaken one may be
@@ -606,9 +668,10 @@ def _split_packed(p: PackedHistory, breq: int, cr: int,
         "v1": pad(p.v1[:nr], breq, NIL_ID),
         "v2": pad(p.v2[:nr], breq, NIL_ID),
         "ro": ro,
+        "fr": fr,
         "inv": inv_req,
         "ret": pad(p.ret[:nr], breq, inf),
-        "sm": _suffix_min_inv(inv_req, breq),
+        "sm": sm,
         "cf": pad(p.f[nr:], cr, 0),
         "cv1": pad(p.v1[nr:], cr, NIL_ID),
         "cv2": pad(p.v2[nr:], cr, NIL_ID),
@@ -622,8 +685,8 @@ def _split_packed(p: PackedHistory, breq: int, cr: int,
     }
 
 
-_COLS = ("f", "v1", "v2", "ro", "inv", "ret", "sm", "cf", "cv1", "cv2",
-         "cinv", "cps", "nr", "ini")
+_COLS = ("f", "v1", "v2", "ro", "fr", "inv", "ret", "sm", "cf", "cv1",
+         "cv2", "cinv", "cps", "nr", "ini")
 
 
 def _window_needed(p: PackedHistory) -> int:

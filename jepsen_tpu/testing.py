@@ -366,7 +366,8 @@ class QueueClient(client_ns.Client):
 
 def simulate_register_history(n_ops: int, n_procs: int = 5, n_vals: int = 8,
                               seed: int = 0, cas_p: float = 0.2,
-                              crash_p: float = 0.0):
+                              crash_p: float = 0.0,
+                              overlap_p: float = 0.6):
     """Synthesize a concurrent CAS-register history that is linearizable by
     construction: ops take effect at a random *commit* instant between their
     invocation and completion events (the linearization point), against one
@@ -387,9 +388,12 @@ def simulate_register_history(n_ops: int, n_procs: int = 5, n_vals: int = 8,
     t = 0
     while invoked < n_ops or in_flight:
         can_invoke = free and invoked < n_ops
-        # Bias toward keeping several ops in flight so the history has real
-        # concurrency (overlapping intervals) for the checker to resolve.
-        if can_invoke and (not in_flight or rng.random() < 0.6):
+        # overlap_p biases toward keeping several ops in flight: the
+        # default 0.6 gives dense concurrency (the stress shape); low
+        # values give mostly-sequential STAGGERED histories — the
+        # reference's tutorial workloads (etcd.clj:172 staggers 1/30 s),
+        # where ops rarely overlap and forced runs dominate.
+        if can_invoke and (not in_flight or rng.random() < overlap_p):
             p = free.pop(rng.randrange(len(free)))
             r = rng.random()
             if r < cas_p:
@@ -435,6 +439,22 @@ def simulate_register_history(n_ops: int, n_procs: int = 5, n_vals: int = 8,
                 free.append(p)
         t += 1
     return h
+
+
+def corrupt_one_read(history, rng, bogus=99):
+    """Flip ONE random ok-read completion to a bogus value (a stale/phantom
+    read) — the standard mutation refutation fuzzers apply to
+    valid-by-construction histories. Returns a new History; identity when
+    the sampled row isn't a corruptible read."""
+    from jepsen_tpu.history import History
+
+    rows = list(history)
+    if rows:
+        i = rng.randrange(len(rows))
+        o = rows[i]
+        if o.type == "ok" and o.f == "read" and o.value is not None:
+            rows[i] = o.replace(value=bogus)
+    return History.of(rows)
 
 
 def atom_test(register: Optional[SharedRegister] = None, **overrides) -> dict:

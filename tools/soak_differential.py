@@ -39,6 +39,18 @@ mism = 0
 
 
 def gen_history(fam, r2, n_ops, n_procs):
+    if fam == "stag":
+        # staggered (rare-overlap) registers: the forced-fast-forward
+        # regime, with occasional read corruption to fuzz refutation
+        from jepsen_tpu.testing import (corrupt_one_read,
+                                        simulate_register_history)
+        h = simulate_register_history(
+            r2.randint(10, 40), n_procs=n_procs, n_vals=4,
+            seed=r2.getrandbits(30), crash_p=r2.choice([0.0, 0.15]),
+            overlap_p=r2.choice([0.02, 0.1]))
+        if r2.random() < 0.5:
+            h = corrupt_one_read(h, r2)
+        return h, CASRegister()
     if fam == "wide":
         # high-concurrency bursts (the WIDE_LADDER regime, small enough
         # for the Python oracle): every op of a round overlaps every
@@ -75,7 +87,7 @@ def keyed_round(seed, cap):
     escalation) against the per-key Python oracle."""
     global mism
     r2 = random.Random(seed)
-    fam = r2.choice(["reg", "set", "queue", "fifo"])
+    fam = r2.choice(["reg", "set", "queue", "fifo", "stag"])
     pairs = [gen_history(fam, random.Random(seed + 31 * k),
                          r2.randint(6, 16), r2.randint(2, 5))
              for k in range(r2.randint(3, 12))]
@@ -99,7 +111,7 @@ while time.time() < DEADLINE:
     rounds += 1
     seed = rng.getrandbits(32)
     r2 = random.Random(seed)
-    fam = rng.choice(["reg", "set", "queue", "fifo"])
+    fam = rng.choice(["reg", "set", "queue", "fifo", "stag"])
     if rounds % 11 == 0:
         # wide rounds are ~50x costlier (oracle + per-shape compiles):
         # sample them instead of letting them throttle the soak
