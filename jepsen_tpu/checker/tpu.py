@@ -242,7 +242,22 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
                cps, n_required, init_state):
         offs = jnp.arange(W, dtype=jnp.int32)          # [W]
 
-        def fast_forward(kk, ss, go, cm_rows):
+        def crash_bound(cm_rows):
+            """Per-row fast-forward boundary: the first frontier whose
+            return exceeds the smallest UNTAKEN crashed invocation — up
+            to there no crashed op is linearizable. Computed once per
+            level from the expanded rows' cmask and shared by both
+            fast_forward call sites."""
+            if CR:
+                ctk = jnp.any(
+                    (cm_rows[:, None, :] & cbitmat[None, :, :]) != 0,
+                    axis=-1)                             # [R, CR]
+                umin = jnp.min(jnp.where(ctk, RET_INF, cinv[None, :]),
+                               axis=-1)                  # [R]
+                return jnp.searchsorted(ret, umin, side="right")
+            return jnp.full(cm_rows.shape[:1], n, jnp.int32)
+
+        def fast_forward(kk, ss, go, bound):
             """Advance rows through runs of FORCED ops (fr[k]=1: op k is
             the unique required candidate at frontier k, which also
             implies the mask is empty there) without paying a sort-level
@@ -256,16 +271,6 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
             the normal expansion. Realistic staggered workloads (etcd's
             1/30-stagger tutorial shape) are mostly forced runs, which
             this collapses from O(n) levels to O(#concurrent regions)."""
-            if CR:
-                ctk = jnp.any(
-                    (cm_rows[:, None, :] & cbitmat[None, :, :]) != 0,
-                    axis=-1)                             # [R, CR]
-                umin = jnp.min(jnp.where(ctk, RET_INF, cinv[None, :]),
-                               axis=-1)                  # [R]
-                bound = jnp.searchsorted(ret, umin, side="right")
-            else:
-                bound = jnp.full(kk.shape, n, jnp.int32)
-
             def ff_cond(c):
                 return jnp.any(c[2])
 
@@ -369,8 +374,9 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
             # it lands on a forced run, absorb the whole run this level.
             # (fr[k] implies the mask there is empty: a masked op would
             # have been concurrent with op k when it was linearized.)
+            ff_bound = crash_bound(cm_e)                 # shared, [E]
             k_adv, s2_0 = fast_forward(k_adv, s2[:, 0], valid[:, 0],
-                                       cm_e)
+                                       ff_bound)
             s2 = s2.at[:, 0].set(s2_0)
 
             is0 = offs[None, :] == 0                            # [1, W]
@@ -422,7 +428,7 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
             # -- flatten both grids, append the unexpanded pool remainder,
             # and check completion ----------------------------------------
             # the closure successor may also land on a forced run
-            kcl, scl = fast_forward(kcl, s_e, closure_ok, cm_e)
+            kcl, scl = fast_forward(kcl, s_e, closure_ok, ff_bound)
             segs = ([(k2.reshape(-1), m2.reshape(-1, MW),
                       cm2.reshape(-1, max(MC, 1)), s2.reshape(-1),
                       valid.reshape(-1)),

@@ -45,7 +45,7 @@ def gen_history(fam, r2, n_ops, n_procs):
         from jepsen_tpu.testing import (corrupt_one_read,
                                         simulate_register_history)
         h = simulate_register_history(
-            r2.randint(10, 40), n_procs=n_procs, n_vals=4,
+            n_ops, n_procs=n_procs, n_vals=4,
             seed=r2.getrandbits(30), crash_p=r2.choice([0.0, 0.15]),
             overlap_p=r2.choice([0.02, 0.1]))
         if r2.random() < 0.5:
