@@ -141,7 +141,8 @@ def _shr_by_mw(m, t, MW: int):
 
 def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
                expand: Optional[int] = None, unroll: int = 1,
-               shard_axis: Optional[str] = None):
+               shard_axis: Optional[str] = None,
+               tiebreak: str = "lex"):
     """Build the single-key search. ``n`` is the (static, padded) length of
     the *required* section — ops with finite return, sorted by return index.
     ``n_cr`` is the (static, padded) width of the *crashed* section — 'info'
@@ -461,21 +462,65 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
             key1 = jnp.where(fv, MAXK - depth, MAXK + 1 + fk)
             fmw = [fm[:, w] for w in range(MW)]
             fcmw = [fcm[:, w] for w in range(MC)]
-            if MC:
-                pc = fcmw[0] * 0
-                for w in range(MC):
-                    pc = pc + lax.population_count(fcmw[w])
-                terms = ([key1, fk] + fmw
-                         + [fs, pc.astype(jnp.int32)] + fcmw)
+            if tiebreak == "hash":
+                # Diversified permutation sort: the comparator sees only
+                # (key1, h[, pc, cmask]) plus an index payload; the wide
+                # config columns are gathered by the resulting permutation
+                # instead of riding through the sort network. h is a
+                # 32-bit mix of (k, mask, state): equal configs hash
+                # equal, so dedup/dominance groups stay adjacent and the
+                # cmask-popcount key still orders within them; distinct
+                # configs collide with ~2^-32 probability, and a collision
+                # only costs a missed dedup/dominance prune (every
+                # equality test below is exact on the gathered columns),
+                # never soundness. The hash tie-break RANDOMIZES which
+                # equal-depth rows survive pool truncation — measured to
+                # diversify the slim-rung beam on dense keyed batches
+                # (64x500 dense: 2.4x fewer wall-seconds, max levels
+                # 672 -> 510) but to lose the 10k single-history flagship
+                # witness from the 32-row pool, so callers choose: keyed
+                # first rungs use it, single-history search keeps "lex"
+                # (a lossy hash rung escalates to a lex rung, so the only
+                # cost of a bad draw is the slim rung's wall time).
+                h = fk.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+                for w in range(MW):
+                    h = (h ^ fm[:, w]) * jnp.uint32(0x85EBCA6B)
+                    h = h ^ (h >> jnp.uint32(13))
+                h = (h ^ fs.astype(jnp.uint32)) * jnp.uint32(0xC2B2AE35)
+                h = h ^ (h >> jnp.uint32(16))
+                iota0 = jnp.arange(fk.shape[0], dtype=jnp.int32)
+                if MC:
+                    pc = fcmw[0] * 0
+                    for w in range(MC):
+                        pc = pc + lax.population_count(fcmw[w])
+                    keys = [key1, h, pc.astype(jnp.int32)] + fcmw
+                else:
+                    keys = [key1, h]
+                keys = [_sc(t) for t in keys] + [_sc(iota0)]
+                sorted_terms = lax.sort(tuple(keys),
+                                        num_keys=len(keys) - 1)
+                key1 = sorted_terms[0]
+                perm = sorted_terms[-1]
+                fk = fk[perm]
+                fmw = [w_[perm] for w_ in fmw]
+                fs = fs[perm]
+                fcmw = (list(sorted_terms[3:3 + MC]) if MC else [])
             else:
-                terms = [key1, fk] + fmw + [fs]
-            terms = [_sc(t) for t in terms]
-            sorted_terms = lax.sort(tuple(terms), num_keys=len(terms))
-            key1 = sorted_terms[0]
-            fk = sorted_terms[1]
-            fmw = list(sorted_terms[2:2 + MW])
-            fs = sorted_terms[2 + MW]
-            fcmw = list(sorted_terms[4 + MW:]) if MC else []
+                if MC:
+                    pc = fcmw[0] * 0
+                    for w in range(MC):
+                        pc = pc + lax.population_count(fcmw[w])
+                    terms = ([key1, fk] + fmw
+                             + [fs, pc.astype(jnp.int32)] + fcmw)
+                else:
+                    terms = [key1, fk] + fmw + [fs]
+                terms = [_sc(t) for t in terms]
+                sorted_terms = lax.sort(tuple(terms), num_keys=len(terms))
+                key1 = sorted_terms[0]
+                fk = sorted_terms[1]
+                fmw = list(sorted_terms[2:2 + MW])
+                fs = sorted_terms[2 + MW]
+                fcmw = list(sorted_terms[4 + MW:]) if MC else []
             fv = key1 <= MAXK
 
             def _eq_prev(a):
@@ -572,12 +617,16 @@ def _kernel_key(kernel: KernelSpec) -> int:
     return id(kernel)
 
 
+def _os_environ_get(name: str) -> Optional[str]:
+    import os as _os
+    return _os.environ.get(name)
+
+
 def _unroll_factor() -> int:
     """Search steps per while_loop iteration. JTPU_UNROLL overrides; the
     default is 1 (measured best on the CPU backend, where the math
     dominates) — on TPU, sweep via bench.py and set the env var."""
-    import os as _os
-    return int(_os.environ.get("JTPU_UNROLL", "0")) or _UNROLL
+    return int(_os_environ_get("JTPU_UNROLL") or "0") or _UNROLL
 
 
 @functools.lru_cache(maxsize=64)
@@ -598,13 +647,15 @@ def _jit_single(kernel_id: int, capacity: int, window: int,
 
 @functools.lru_cache(maxsize=64)
 def _jit_batch(kernel_id: int, capacity: int, window: int,
-               expand: Optional[int] = None, unroll: int = 1):
+               expand: Optional[int] = None, unroll: int = 1,
+               tiebreak: str = "lex"):
     kernel = _KERNELS_BY_ID[kernel_id]
 
     def batched(f, v1, v2, ro, fr, inv, ret, sm, cf, cv1, cv2, cinv,
                 cps, nr, ini):
         search = _search_fn(kernel.step, f.shape[1], cf.shape[1],
-                            capacity, window, expand, unroll)
+                            capacity, window, expand, unroll,
+                            tiebreak=tiebreak)
         return jax.vmap(search)(
             f, v1, v2, ro, fr, inv, ret, sm, cf, cv1, cv2, cinv, cps,
             nr, ini)
@@ -1177,8 +1228,14 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
                           for a in arrays]
             else:
                 arrays = [jax.device_put(a, sh_row) for a in arrays]
+        # First rung: hash tie-break (diversified beam — measured 2.4x
+        # on dense key batches; a bad draw just escalates). Later rungs:
+        # deterministic lex order. JTPU_TIEBREAK0=lex|hash overrides the
+        # first-rung choice for bench sweeps.
+        tb0 = _os_environ_get("JTPU_TIEBREAK0") or "hash"
         fn = _jit_batch(_kernel_key(kernel), cap, win, exp,
-                        _unroll_factor())
+                        _unroll_factor(),
+                        tiebreak=(tb0 if step == 0 else "lex"))
         outs = fn(*arrays)
         if multiproc:
             # Per-key verdict rows live on their owning host; gather the
