@@ -1173,6 +1173,17 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
                   + ((512, 64, 512), (4096, 128, 1024),
                      (16384, 128, 4096)))
 
+    # First rung: hash tie-break (diversified beam — measured 2.4x on
+    # dense key batches; a bad draw just escalates). Later rungs use the
+    # deterministic lex order, as do single-rung ladders (where a lossy
+    # draw would have NO lex escalation to fall back to) unless an
+    # explicit JTPU_TIEBREAK0=hash asked for the diversified beam anyway
+    # (bench sweeps need the override honored even on pinned rungs).
+    tb_env = _os_environ_get("JTPU_TIEBREAK0")
+    if tb_env not in (None, "lex", "hash"):
+        raise ValueError(
+            f"JTPU_TIEBREAK0 must be lex|hash, got {tb_env!r}")
+
     for step, (cap, win, exp) in enumerate(ladder):
         if not rows:
             break
@@ -1228,14 +1239,11 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
                           for a in arrays]
             else:
                 arrays = [jax.device_put(a, sh_row) for a in arrays]
-        # First rung: hash tie-break (diversified beam — measured 2.4x
-        # on dense key batches; a bad draw just escalates). Later rungs:
-        # deterministic lex order. JTPU_TIEBREAK0=lex|hash overrides the
-        # first-rung choice for bench sweeps.
-        tb0 = _os_environ_get("JTPU_TIEBREAK0") or "hash"
+        hash_ok = step == 0 and (not last_rung or tb_env is not None)
         fn = _jit_batch(_kernel_key(kernel), cap, win, exp,
                         _unroll_factor(),
-                        tiebreak=(tb0 if step == 0 else "lex"))
+                        tiebreak=((tb_env or "hash") if hash_ok
+                                  else "lex"))
         outs = fn(*arrays)
         if multiproc:
             # Per-key verdict rows live on their owning host; gather the

@@ -115,7 +115,9 @@ class LocalKVDB(db_ns.DB, db_ns.LogFiles):
         cu.start_daemon(test, node, sys.executable, *args,
                         logfile=f"{d}/kv.log", pidfile=f"{d}/kv.pid",
                         chdir=d, match_executable=False)
-        deadline = time.time() + 10
+        # 30 s: a loaded build host has been observed to take 12+ s just
+        # to fork+exec the five python nodes concurrently
+        deadline = time.time() + 30
         while time.time() < deadline:
             try:
                 with socket.create_connection(("127.0.0.1", port),
