@@ -1130,33 +1130,47 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
             results[k] = {"valid": UNKNOWN, "backend": "tpu",
                           "error": str(e)}
 
-    # Common padded widths across the batch, so one compilation serves all.
-    # A key with more crashed ops than the bitmask holds goes UNKNOWN alone
-    # (per-key split failure), not the whole batch.
+    # Common padded required width across the batch, so compilations are
+    # shared. The CRASHED width is per-key-cohort, not batch-wide: the
+    # crash grids and the subset-dominance passes are ~2x of per-level
+    # cost, and one crashy key must not levy that on a mostly crash-free
+    # batch (measured 64x500 dense with 8/64 crashy keys: 3.3 s
+    # batch-wide vs ~1.9 s cohorted on the CPU backend). A key with more
+    # crashed ops than the bitmask holds goes UNKNOWN alone (per-key
+    # split failure), not the whole batch.
     breq = _bucket(max((p.n_required for p in packed.values()),
                        default=1) or 1)
-    crash_counts = [p.n - p.n_required for p in packed.values()]
-    cr = _crash_width(min(max(crash_counts, default=0), CRASH_MAX))
 
-    # rows: (key, cols, window_needed, max_cap_tried, max_win_tried) —
-    # the tried maxima keep escalation monotone: a key that overflowed a
-    # 16384 pool must not re-run on a later rung whose capacity AND
-    # window are both no larger (e.g. the wide tail's 512 rung, which
-    # exists for deferred wide keys, not lossy narrow ones).
+    # rows: (key, cols, window_needed, max_cap_tried, max_win_tried,
+    # forced_frac, crash_width) — the tried maxima keep escalation
+    # monotone: a key that overflowed a 16384 pool must not re-run on a
+    # later rung whose capacity AND window are both no larger (e.g. the
+    # wide tail's 512 rung, which exists for deferred wide keys, not
+    # lossy narrow ones).
     rows = []
     for key, p in packed.items():
         if p.n_required == 0:
             results[key] = {"valid": True, "levels": 0, "backend": "tpu"}
             continue
-        cols = None if cr is None else _split_packed(p, breq, cr, kernel)
+        crw = _crash_width(p.n - p.n_required)
+        cols = (None if crw is None
+                else _split_packed(p, breq, crw, kernel))
         if cols is None:
             results[key] = {
                 "valid": UNKNOWN, "backend": "tpu",
                 "error": f"{p.n - p.n_required} crashed ops exceed the "
                          f"crashed-set width {CRASH_MAX}"}
             continue
-        rows.append((key, cols, _window_needed(p), 0, 0))
+        # forced fraction: how much of the key's required section is
+        # forced runs (fr=1). Staggered workloads (~0.9) ride the
+        # fast-forward and want the slim first rung; dense workloads
+        # (~0.05) want a fatter expansion — the auto ladder starts them
+        # one rung later (see the dense rung below).
+        nr_ = p.n_required
+        ffrac = float(cols["fr"][:nr_].sum()) / nr_
+        rows.append((key, cols, _window_needed(p), 0, 0, ffrac, crw))
 
+    adaptive = False
     if ladder is not None:
         # caller-supplied escalation rungs (tests, dryruns: small rungs
         # keep compile cost bounded while still exercising escalation)
@@ -1168,8 +1182,17 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
     else:
         # capacity ladder at the narrow window first (most keys), then
         # the expansion-heavy wide rungs the per-row deferral routes
-        # wide keys to (see WIDE_LADDER)
-        ladder = (tuple((c, 32, e) for c, e in _capacity_ladder())
+        # wide keys to (see WIDE_LADDER). Between the slim first rung
+        # and the escalations sits the DENSE rung (same capacity, double
+        # expansion): keys with a low forced fraction skip the slim rung
+        # and start there — measured on 64x500 CAS batches (CPU backend):
+        # dense 5.7 s -> 3.4 s at (32,8) while staggered stays on (32,4)
+        # at 0.20 s instead of doubling to 0.42 s.
+        lad0 = _capacity_ladder()
+        (cap0, exp0) = lad0[0]
+        adaptive = True
+        ladder = (((cap0, 32, exp0), (cap0, 32, max(8, exp0 * 2)))
+                  + tuple((c, 32, e) for c, e in lad0[1:])
                   + ((512, 64, 512), (4096, 128, 1024),
                      (16384, 128, 4096)))
 
@@ -1199,7 +1222,11 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
             # lossy again.
             runnable, deferred = [], []
             for r in rows:
-                if r[2] <= win and (cap > r[3] or win > r[4]):
+                if adaptive and step == 0 and r[5] < 0.5:
+                    # dense key (low forced fraction): start on the
+                    # double-expansion dense rung instead of the slim one
+                    deferred.append(r)
+                elif r[2] <= win and (cap > r[3] or win > r[4]):
                     runnable.append(r)
                 else:
                     deferred.append(r)
@@ -1208,84 +1235,112 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
         if not runnable:
             rows = deferred
             continue
-        rows = runnable
-        arrays = [np.stack([r[1][c] for r in rows]) for c in _COLS]
-        multiproc = False
-        if mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-            # Pad the key batch up to the mesh axis size so it divides.
-            per = mesh.shape[axis]
-            pad = (-len(rows)) % per
-            if pad:
-                # Pad with trivially-complete rows (n_required=0 finishes
-                # at level 0) — repeating a real key would re-run its
-                # search, possibly the batch's most expensive, pad times.
-                def _pad_col(a, c):
-                    fill = np.repeat(a[-1:], pad, axis=0)
-                    if c == "nr":
-                        fill = np.zeros_like(fill)
-                    return np.concatenate([a, fill])
-                arrays = [_pad_col(a, c) for a, c in zip(arrays, _COLS)]
-            sh_row = NamedSharding(mesh, P(axis))
-            multiproc = jax.process_count() > 1
-            if multiproc:
-                # Multi-host (DCN) mesh: device_put cannot address other
-                # hosts' devices. Every process holds the SAME global
-                # batch (the keyed dict is control-plane data), so each
-                # builds the global array from its addressable slices.
-                arrays = [jax.make_array_from_callback(
-                              a.shape, sh_row,
-                              lambda idx, a=a: a[idx])
-                          for a in arrays]
-            else:
-                arrays = [jax.device_put(a, sh_row) for a in arrays]
-        hash_ok = step == 0 and (not last_rung or tb_env is not None)
-        fn = _jit_batch(_kernel_key(kernel), cap, win, exp,
-                        _unroll_factor(),
-                        tiebreak=((tb_env or "hash") if hash_ok
-                                  else "lex"))
-        outs = fn(*arrays)
-        if multiproc:
-            # Per-key verdict rows live on their owning host; gather the
-            # scalar verdict vectors so every process takes identical
-            # host-side decisions (escalation retries stay
-            # SPMD-deterministic).
-            from jax.experimental import multihost_utils
-            scalars = tuple(
-                multihost_utils.process_allgather(x, tiled=True)
-                for x in outs[:5])
-        else:
-            scalars = outs[:5]
-        done, lossy, wovf, best, levels = (np.asarray(x)
-                                           for x in scalars)
-        # Pool columns ([capacity] rows per key) are only read for clean
-        # refutations — don't ship up to 16384 ints/key off-device (and
-        # over DCN) for the common all-valid rung. "Any refutation?" is
-        # derived from the gathered scalars, so multi-host processes
-        # agree on whether to gather the pools.
-        refuted = ~done & ~lossy & ~wovf
-        pk = ps = pa = None
-        if refuted.any():
-            pools = outs[5:]
-            if multiproc:
-                from jax.experimental import multihost_utils
-                pools = tuple(
-                    multihost_utils.process_allgather(x, tiled=True)
-                    for x in pools)
-            pk, ps, pa = (np.asarray(x) for x in pools)
+        # On the adaptive ladder both cohort entry rungs (slim rung 0 and
+        # the dense rung 1) are "first" rungs for their keys.
+        first = step <= (1 if adaptive else 0)
+        hash_ok = first and (not last_rung or tb_env is not None)
         retry = deferred
-        for r, (key, cols, wneed, mcap, mwin) in enumerate(rows):
-            res = _result(bool(done[r]), bool(lossy[r]), bool(wovf[r]),
-                          int(best[r]), int(levels[r]), packed[key],
-                          pool=(None if pk is None
-                                else (pk[r], ps[r], pa[r])))
-            escalatable = (bool(lossy[r])
-                           or (bool(wovf[r]) and win < MAX_WINDOW))
-            if res["valid"] is UNKNOWN and escalatable and not last_rung:
-                retry.append((key, cols, wneed,
-                              max(mcap, cap), max(mwin, win)))
+        # Sub-batch per crashed-section width: crash-free keys must not
+        # pay the crash grids + dominance passes sized for the batch's
+        # crashiest key (a distinct compilation per width regardless).
+        # On a mesh, cohorting would serialize one data-parallel launch
+        # into per-width launches each padded up to the axis — a net
+        # loss whenever key count is near device count — so the sharded
+        # path keeps the single widest-width batch.
+        by_cr: Dict[int, list] = {}
+        if mesh is None:
+            for r in runnable:
+                by_cr.setdefault(r[6], []).append(r)
+        else:
+            wmax = max(r[6] for r in runnable)
+            by_cr[wmax] = [
+                r if r[6] == wmax else
+                (r[0], _split_packed(packed[r[0]], breq, wmax, kernel),
+                 r[2], r[3], r[4], r[5], wmax)
+                for r in runnable]
+        for crw, grp in sorted(by_cr.items()):
+            arrays = [np.stack([r[1][c] for r in grp]) for c in _COLS]
+            multiproc = False
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                # Pad the key batch up to the mesh axis size so it
+                # divides.
+                per = mesh.shape[axis]
+                pad = (-len(grp)) % per
+                if pad:
+                    # Pad with trivially-complete rows (n_required=0
+                    # finishes at level 0) — repeating a real key would
+                    # re-run its search, possibly the batch's most
+                    # expensive, pad times.
+                    def _pad_col(a, c):
+                        fill = np.repeat(a[-1:], pad, axis=0)
+                        if c == "nr":
+                            fill = np.zeros_like(fill)
+                        return np.concatenate([a, fill])
+                    arrays = [_pad_col(a, c)
+                              for a, c in zip(arrays, _COLS)]
+                sh_row = NamedSharding(mesh, P(axis))
+                multiproc = jax.process_count() > 1
+                if multiproc:
+                    # Multi-host (DCN) mesh: device_put cannot address
+                    # other hosts' devices. Every process holds the SAME
+                    # global batch (the keyed dict is control-plane
+                    # data), so each builds the global array from its
+                    # addressable slices.
+                    arrays = [jax.make_array_from_callback(
+                                  a.shape, sh_row,
+                                  lambda idx, a=a: a[idx])
+                              for a in arrays]
+                else:
+                    arrays = [jax.device_put(a, sh_row) for a in arrays]
+            fn = _jit_batch(_kernel_key(kernel), cap, win, exp,
+                            _unroll_factor(),
+                            tiebreak=((tb_env or "hash") if hash_ok
+                                      else "lex"))
+            outs = fn(*arrays)
+            if multiproc:
+                # Per-key verdict rows live on their owning host; gather
+                # the scalar verdict vectors so every process takes
+                # identical host-side decisions (escalation retries stay
+                # SPMD-deterministic).
+                from jax.experimental import multihost_utils
+                scalars = tuple(
+                    multihost_utils.process_allgather(x, tiled=True)
+                    for x in outs[:5])
             else:
-                results[key] = res
+                scalars = outs[:5]
+            done, lossy, wovf, best, levels = (np.asarray(x)
+                                               for x in scalars)
+            # Pool columns ([capacity] rows per key) are only read for
+            # clean refutations — don't ship up to 16384 ints/key
+            # off-device (and over DCN) for the common all-valid rung.
+            # "Any refutation?" is derived from the gathered scalars, so
+            # multi-host processes agree on whether to gather the pools.
+            refuted = ~done & ~lossy & ~wovf
+            pk = ps = pa = None
+            if refuted.any():
+                pools = outs[5:]
+                if multiproc:
+                    from jax.experimental import multihost_utils
+                    pools = tuple(
+                        multihost_utils.process_allgather(x, tiled=True)
+                        for x in pools)
+                pk, ps, pa = (np.asarray(x) for x in pools)
+            for r, (key, cols, wneed, mcap, mwin, ffrac, _) in \
+                    enumerate(grp):
+                res = _result(bool(done[r]), bool(lossy[r]),
+                              bool(wovf[r]), int(best[r]),
+                              int(levels[r]), packed[key],
+                              pool=(None if pk is None
+                                    else (pk[r], ps[r], pa[r])))
+                escalatable = (bool(lossy[r])
+                               or (bool(wovf[r]) and win < MAX_WINDOW))
+                if (res["valid"] is UNKNOWN and escalatable
+                        and not last_rung):
+                    retry.append((key, cols, wneed, max(mcap, cap),
+                                  max(mwin, win), ffrac, crw))
+                else:
+                    results[key] = res
         rows = retry
     valid = True
     for r in results.values():
