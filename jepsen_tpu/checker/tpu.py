@@ -961,6 +961,7 @@ def check_packed_tpu(p: PackedHistory, kernel: KernelSpec,
     else:
         ladder = _ladder_for(_window_needed(p))
     out: Dict[str, Any] = {}
+    work: list = []
     for cap, win, exp in ladder:
         fn = _jit_single(_kernel_key(kernel), cap, win, exp,
                          _unroll_factor())
@@ -968,6 +969,16 @@ def check_packed_tpu(p: PackedHistory, kernel: KernelSpec,
             *(cols[c] for c in _COLS))
         out = _result(bool(done), bool(lossy), bool(wovf), int(best),
                       int(levels), p, pool=(pk, ps, pa))
+        # the rung that produced this verdict, for utilization
+        # accounting (bench.py derives per-level work from it); "work"
+        # additionally lists EVERY rung this search burned levels on, so
+        # escalated searches don't hide their early-rung spend
+        out["rung"] = (cap, win, exp)
+        out["crash-width"] = _crash_width(p.n - p.n_required) or 0
+        out["tiebreak"] = "lex"
+        work.append(((cap, win, exp), out["crash-width"], "lex",
+                     int(levels)))
+        out["work"] = list(work)
         if out["valid"] is not UNKNOWN:
             return out
         if bool(wovf) and win >= MAX_WINDOW and not bool(lossy):
@@ -1168,7 +1179,7 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
         # one rung later (see the dense rung below).
         nr_ = p.n_required
         ffrac = float(cols["fr"][:nr_].sum()) / nr_
-        rows.append((key, cols, _window_needed(p), 0, 0, ffrac, crw))
+        rows.append((key, cols, _window_needed(p), 0, 0, ffrac, crw, []))
 
     adaptive = False
     if ladder is not None:
@@ -1239,6 +1250,7 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
         # the dense rung 1) are "first" rungs for their keys.
         first = step <= (1 if adaptive else 0)
         hash_ok = first and (not last_rung or tb_env is not None)
+        tb = (tb_env or "hash") if hash_ok else "lex"
         retry = deferred
         # Sub-batch per crashed-section width: crash-free keys must not
         # pay the crash grids + dominance passes sized for the batch's
@@ -1256,7 +1268,7 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
             by_cr[wmax] = [
                 r if r[6] == wmax else
                 (r[0], _split_packed(packed[r[0]], breq, wmax, kernel),
-                 r[2], r[3], r[4], r[5], wmax)
+                 r[2], r[3], r[4], r[5], wmax, r[7])
                 for r in runnable]
         for crw, grp in sorted(by_cr.items()):
             arrays = [np.stack([r[1][c] for r in grp]) for c in _COLS]
@@ -1294,9 +1306,7 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
                 else:
                     arrays = [jax.device_put(a, sh_row) for a in arrays]
             fn = _jit_batch(_kernel_key(kernel), cap, win, exp,
-                            _unroll_factor(),
-                            tiebreak=((tb_env or "hash") if hash_ok
-                                      else "lex"))
+                            _unroll_factor(), tiebreak=tb)
             outs = fn(*arrays)
             if multiproc:
                 # Per-key verdict rows live on their owning host; gather
@@ -1326,19 +1336,25 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
                         multihost_utils.process_allgather(x, tiled=True)
                         for x in pools)
                 pk, ps, pa = (np.asarray(x) for x in pools)
-            for r, (key, cols, wneed, mcap, mwin, ffrac, _) in \
+            for r, (key, cols, wneed, mcap, mwin, ffrac, _, work) in \
                     enumerate(grp):
                 res = _result(bool(done[r]), bool(lossy[r]),
                               bool(wovf[r]), int(best[r]),
                               int(levels[r]), packed[key],
                               pool=(None if pk is None
                                     else (pk[r], ps[r], pa[r])))
+                res["rung"] = (cap, win, exp)
+                res["crash-width"] = crw
+                res["tiebreak"] = tb
+                work = work + [((cap, win, exp), crw, tb,
+                                int(levels[r]))]
+                res["work"] = work
                 escalatable = (bool(lossy[r])
                                or (bool(wovf[r]) and win < MAX_WINDOW))
                 if (res["valid"] is UNKNOWN and escalatable
                         and not last_rung):
                     retry.append((key, cols, wneed, max(mcap, cap),
-                                  max(mwin, win), ffrac, crw))
+                                  max(mwin, win), ffrac, crw, work))
                 else:
                     results[key] = res
         rows = retry
