@@ -106,7 +106,18 @@ def probe_default_backend(timeout: Optional[float] = None) -> Optional[str]:
         if "platform" in _state:
             return _state["platform"]
         if os.environ.get("JEPSEN_ACCEL_OK"):
-            _state["platform"] = "trusted"
+            # Trust the operator: skip the probe but still report a real
+            # platform name (never a sentinel a caller could mistake for
+            # a backend): the already-initialized backend if there is
+            # one, else the configured platform list's head. The "cpu"
+            # tail is only reachable with nothing initialized AND
+            # nothing configured — where jax itself defaults to cpu
+            # unless an ambient plugin beats us to init, a window the
+            # operator accepted by disabling the probe.
+            cfg = (_initialized_platform()
+                   or _configured_platforms().split(",")[0].strip()
+                   or "cpu")
+            _state["platform"] = cfg
             return _state["platform"]
         plat = _initialized_platform()
         if plat is None and _configured_platforms().strip().lower() == "cpu":

@@ -1034,8 +1034,23 @@ def check_packed_sharded(p: PackedHistory, kernel: KernelSpec,
     with jax.set_mesh(mesh):
         done, lossy, wovf, best, levels, pk, ps, pa = fn(
             *(cols[c] for c in _COLS))
-        out = _result(bool(done), bool(lossy), bool(wovf), int(best),
-                      int(levels), p, pool=(pk, ps, pa))
+        done, lossy, wovf = bool(done), bool(lossy), bool(wovf)
+        pool = (pk, ps, pa)
+        if jax.process_count() > 1:
+            # The scalar outputs are replicated (readable everywhere),
+            # but the pool columns are row-sharded over the mesh axis —
+            # on a multi-host mesh they are not fully addressable and
+            # np.asarray in _result would raise. They are only read for
+            # a clean refutation, so gather exactly then.
+            if not done and not lossy and not wovf:
+                from jax.experimental import multihost_utils
+                pool = tuple(
+                    multihost_utils.process_allgather(x, tiled=True)
+                    for x in pool)
+            else:
+                pool = None
+        out = _result(done, lossy, wovf, int(best),
+                      int(levels), p, pool=pool)
     out["pool-sharding"] = f"{POOL_AXIS}={naxis}"
     return out
 
@@ -1185,6 +1200,11 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
     if ladder is not None:
         # caller-supplied escalation rungs (tests, dryruns: small rungs
         # keep compile cost bounded while still exercising escalation)
+        if capacity is not None or expand is not None:
+            raise ValueError(
+                "pass either ladder= or capacity=/expand=, not both: "
+                "an explicit ladder replaces the whole escalation "
+                "schedule and would silently ignore them")
         for _, win, _ in ladder:
             _check_window(win)
     elif capacity is not None:

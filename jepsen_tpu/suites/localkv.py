@@ -318,4 +318,9 @@ def localkv_unsafe_test(opts: dict) -> dict:
     test.update({k: v for k, v in opts.items()
                  if k in ("concurrency", "time-limit", "store-dir",
                           "store-root")})
+    # The deterministic schedule needs worker thread 1 (the kv2 backup
+    # reader); with concurrency < 2 its phase barrier would never
+    # complete and the run degenerates to a timeout.
+    if int(test.get("concurrency") or 0) < 2:
+        test["concurrency"] = max(2, len(nodes))
     return test

@@ -91,4 +91,10 @@ def test_trusted_env_skips_probe(monkeypatch):
         raise AssertionError("probe must not spawn")
 
     monkeypatch.setattr(accel, "_spawn_probe", boom)
-    assert accel.probe_default_backend() == "trusted"
+    # the trusted path reports a real platform name callers can compare
+    # against (never a sentinel string): here the configured list's
+    # head, pinned independently of the production parsing
+    monkeypatch.setattr(accel, "_initialized_platform", lambda: None)
+    monkeypatch.setattr(accel, "_configured_platforms",
+                        lambda: "axon,cpu")
+    assert accel.probe_default_backend() == "axon"
