@@ -10,7 +10,7 @@ the reference enforces (web.clj:273-278 assert-file-in-scope!).
 from __future__ import annotations
 
 import html
-import io
+
 import json
 import os
 import threading
@@ -71,8 +71,53 @@ def _within(root: str, path: str) -> bool:
         os.path.realpath(path) == root
 
 
+class _ChunkedWriter:
+    """File-like adapter from zipfile writes to HTTP body pieces — the
+    archive streams to the client with O(chunk) memory. (Reference
+    jepsen/src/jepsen/web.clj:250-271 pipes the zip through a piped
+    output stream for the same reason.) ``chunked=True`` frames each
+    write as an HTTP/1.1 chunk; ``chunked=False`` writes raw bytes for
+    HTTP/1.0 peers (which cannot parse chunked framing — the caller
+    then closes the connection to delimit the body). Deliberately not
+    seekable: zipfile detects that and switches to streaming mode
+    (local headers with data descriptors), never needing to rewrite
+    earlier bytes."""
+
+    def __init__(self, wfile, chunked=True):
+        self.wfile = wfile
+        self.chunked = chunked
+        self._pos = 0
+
+    def write(self, b):
+        if b:
+            if self.chunked:
+                self.wfile.write(f"{len(b):X}\r\n".encode("ascii"))
+                self.wfile.write(b)
+                self.wfile.write(b"\r\n")
+            else:
+                self.wfile.write(b)
+            self._pos += len(b)
+        return len(b)
+
+    def flush(self):
+        self.wfile.flush()
+
+    def tell(self):
+        return self._pos
+
+    def close_chunks(self):
+        if self.chunked:
+            self.wfile.write(b"0\r\n\r\n")
+
+
 class Handler(BaseHTTPRequestHandler):
     root = "store"
+    # 1.1 (every fixed response carries Content-Length, see _send) so
+    # the zip download may use chunked transfer encoding
+    protocol_version = "HTTP/1.1"
+    # keep-alive must not pin a handler thread forever: idle persistent
+    # connections are dropped after this many seconds
+    timeout = 60
 
     def log_message(self, *args):  # quiet by default
         pass
@@ -163,19 +208,44 @@ class Handler(BaseHTTPRequestHandler):
                           {"Content-Disposition": "attachment"})
 
     def zip_dir(self, target: str, rel: str):
-        """Zip a run directory for download (web.clj:250-271)."""
-        buf = io.BytesIO()
-        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
-            for dirpath, _dirs, files in os.walk(target):
-                for fname in files:
-                    full = os.path.join(dirpath, fname)
-                    if os.path.islink(full):
-                        continue
-                    z.write(full, os.path.relpath(full, target))
+        """STREAM a run directory as a zip download (web.clj:250-271
+        pipes the archive for the same reason): the archive is chunked
+        straight onto the socket as it is built — a multi-GB store
+        directory downloads with constant control-node memory instead of
+        ballooning an in-memory BytesIO."""
         name = rel.strip("/").replace("/", "-") or "store"
-        self._send(200, buf.getvalue(), "application/zip",
-                   {"Content-Disposition":
-                    f'attachment; filename="{name}.zip"'})
+        # Chunked framing requires an HTTP/1.1 peer (RFC 7230 §3.3.1);
+        # a 1.0 client gets the raw stream delimited by connection close.
+        chunked = self.request_version == "HTTP/1.1"
+        self.send_response(200)
+        self.send_header("Content-Type", "application/zip")
+        self.send_header("Content-Disposition",
+                         f'attachment; filename="{name}.zip"')
+        if chunked:
+            self.send_header("Transfer-Encoding", "chunked")
+        else:
+            self.close_connection = True
+        self.end_headers()
+        w = _ChunkedWriter(self.wfile, chunked=chunked)
+        try:
+            with zipfile.ZipFile(w, "w", zipfile.ZIP_DEFLATED) as z:
+                for dirpath, _dirs, files in os.walk(target):
+                    for fname in sorted(files):
+                        full = os.path.join(dirpath, fname)
+                        if os.path.islink(full):
+                            continue
+                        # z.write streams the file in 8 KiB reads
+                        z.write(full, os.path.relpath(full, target))
+            w.close_chunks()
+        except BrokenPipeError:
+            self.close_connection = True
+        except Exception:
+            # Headers (and part of the body) are already on the wire:
+            # the only safe failure signal is an abruptly-terminated
+            # stream on a connection that must not be reused. Swallow —
+            # re-raising would let do_GET's generic 500 page inject
+            # status-line bytes into the middle of the body framing.
+            self.close_connection = True
 
 
 def serve(host: str = "127.0.0.1", port: int = 8080,

@@ -224,3 +224,73 @@ class TestAnalyzeCmd:
         monkeypatch.chdir(tmp_path)  # no ./store here
         rc = cli.run(cli.analyze_cmd(), ["analyze"])
         assert rc == cli.INVALID_ARGS
+
+
+class TestZipStreaming:
+    """The zip download must stream with bounded memory
+    (web.clj:250-271 pipes the archive; an in-memory zip of a large
+    store directory would balloon control-node RSS)."""
+
+    @staticmethod
+    def _rss_kb():
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1])
+        return 0
+
+    def test_zip_is_chunked_and_valid(self, tmp_path):
+        import io
+        import urllib.request
+        import zipfile as zf
+
+        run = tmp_path / "t" / "20260730T000000.000"
+        run.mkdir(parents=True)
+        (run / "history.txt").write_text("invoke read\n")
+        (run / "results.json").write_text('{"valid": true}')
+        server = web.serve_background(root=str(tmp_path))
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.server_port}"
+                    f"/files/t/20260730T000000.000?zip") as r:
+                assert r.headers.get("Transfer-Encoding") == "chunked"
+                assert r.headers.get("Content-Length") is None
+                body = r.read()
+            z = zf.ZipFile(io.BytesIO(body))
+            assert sorted(z.namelist()) == ["history.txt",
+                                            "results.json"]
+            assert z.read("history.txt") == b"invoke read\n"
+            assert z.testzip() is None
+        finally:
+            server.shutdown()
+
+    def test_zip_memory_stays_bounded(self, tmp_path):
+        """Download a ~96 MB incompressible run dir; server+client RSS
+        must not grow by anything near the archive size (the old
+        BytesIO implementation grew by ~96 MB)."""
+        import os as _os
+        import urllib.request
+
+        run = tmp_path / "big" / "20260730T000001.000"
+        run.mkdir(parents=True)
+        chunk = _os.urandom(1 << 20)
+        with open(run / "data.bin", "wb") as f:
+            for _ in range(96):
+                f.write(chunk)
+        server = web.serve_background(root=str(tmp_path))
+        try:
+            rss0 = self._rss_kb()
+            total = 0
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.server_port}"
+                    f"/files/big/20260730T000001.000?zip") as r:
+                while True:
+                    piece = r.read(1 << 20)
+                    if not piece:
+                        break
+                    total += len(piece)
+            grown_kb = self._rss_kb() - rss0
+        finally:
+            server.shutdown()
+        assert total > 90 * (1 << 20)   # archive really was ~96 MB
+        assert grown_kb < 32 * 1024, grown_kb  # << archive size
