@@ -197,15 +197,35 @@ class Handler(BaseHTTPRequestHandler):
         self._page(f"/{rel}", "<ul>" + "".join(items) + "</ul>")
 
     def file(self, target: str):
+        """Stream a single file (same bounded-memory contract as the
+        zip path: a multi-GB history log must not be slurped into one
+        bytes object per request). Content-Length is known up front, so
+        no chunking is needed."""
         ext = os.path.splitext(target)[1].lower()
-        with open(target, "rb") as f:
-            data = f.read()
         if ext in IMAGE_EXT:
-            return self._send(200, data, IMAGE_EXT[ext])
-        if ext in TEXT_EXT or not ext:
-            return self._send(200, data, "text/plain; charset=utf-8")
-        return self._send(200, data, "application/octet-stream",
-                          {"Content-Disposition": "attachment"})
+            ctype, extra = IMAGE_EXT[ext], {}
+        elif ext in TEXT_EXT or not ext:
+            ctype, extra = "text/plain; charset=utf-8", {}
+        else:
+            ctype = "application/octet-stream"
+            extra = {"Content-Disposition": "attachment"}
+        size = os.path.getsize(target)
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(size))
+        for k, v in extra.items():
+            self.send_header(k, v)
+        self.end_headers()
+        try:
+            with open(target, "rb") as f:
+                while True:
+                    piece = f.read(1 << 16)
+                    if not piece:
+                        break
+                    self.wfile.write(piece)
+        except Exception:
+            # mid-body failure: the connection's framing is broken
+            self.close_connection = True
 
     def zip_dir(self, target: str, rel: str):
         """STREAM a run directory as a zip download (web.clj:250-271
