@@ -63,6 +63,10 @@ SUITES = {
     "crate-dirty-read": ("sql_family", "crate_dirty_read_test"),
     "local-kv": ("localkv", "localkv_test"),
     "local-kv-unsafe": ("localkv", "localkv_unsafe_test"),
+    "sqlite-register": ("sqlitedb", "sqlite_register_test"),
+    "sqlite-bank": ("sqlitedb", "sqlite_bank_test"),
+    "sqlite-register-toctou": ("sqlitedb",
+                               "sqlite_register_toctou_test"),
     "logcabin": ("small", "logcabin_test"),
     "robustirc": ("small", "robustirc_test"),
     "rethinkdb": ("small", "rethinkdb_test"),
