@@ -214,6 +214,11 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
         def _sc(x):
             return x
     LEADERS = 8  # group-prefix rows tested as dominators
+    import os as _ffo
+    #: forced-advances per fast-forward loop iteration. 1 until a clean
+    #: measurement says otherwise (sweep via JTPU_FF_UNROLL; on the
+    #: loaded build host the sweep was inconclusive within noise).
+    FF_UNROLL = int(_ffo.environ.get("JTPU_FF_UNROLL") or "0") or 1
     MAXK = jnp.int32(1 << 30)
     #: iteration budget: the witness path alone needs ~n+CR expansions, and
     #: best-first backtracking re-expands some configs (no global visited
@@ -275,14 +280,24 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
             def ff_cond(c):
                 return jnp.any(c[2])
 
-            def ff_body(c):
-                k_, s_, go_ = c
+            def ff_step(k_, s_, go_):
                 kc_ = jnp.clip(k_, 0, n - 1)
                 s2_, ok_ = step(s_, f[kc_], v1[kc_], v2[kc_])
                 adv = (go_ & (fr[kc_] > 0) & (k_ < bound)
                        & (k_ < n_required) & ok_)
                 return (k_ + adv, jnp.where(adv, s2_.astype(jnp.int32),
                                             s_), adv)
+
+            def ff_body(c):
+                # several forced advances per while iteration: forced
+                # runs are tens of ops long on staggered workloads, and
+                # the loop's per-iteration overhead on these tiny [E]
+                # tensors otherwise dominates the level (the `adv` flag
+                # makes extra applications no-ops, so correctness is
+                # unaffected)
+                for _ in range(FF_UNROLL):
+                    c = ff_step(*c)
+                return c
 
             kk, ss, _ = lax.while_loop(ff_cond, ff_body, (kk, ss, go))
             return kk, ss
