@@ -338,7 +338,17 @@ def execute(test: dict, node, cmd: str, stdin: Optional[str] = None,
     (the engine under exec, with ssh retry semantics of
     control.clj:140-160)."""
     session = get_session(test, node)
-    cmd = wrap_cmd(cmd)
+    # Local mode already-as-root: sudo-to-root is a no-op, and minimal
+    # images (containers) often have no sudo binary at all — the cd
+    # wrapper still applies.
+    skip_sudo = (isinstance(session, LocalSession)
+                 and _get("sudo") == "root"
+                 and getattr(os, "geteuid", lambda: -1)() == 0)
+    if skip_sudo:
+        with _bound("sudo", None):
+            cmd = wrap_cmd(cmd)
+    else:
+        cmd = wrap_cmd(cmd)
     if _get("trace"):
         print(f"[control {node}] {cmd}")
     attempts = 2

@@ -1,0 +1,90 @@
+"""Real-tool tests for the net layer: the tc command lines net.py emits
+run through the REAL tc binary over the local control mode — the class
+of bug dummy transcripts cannot catch (a flag this iproute2 rejects, an
+error message the tolerance list misses).
+
+CI-kernel reality: containers usually lack the sch_netem module. tc
+parses the FULL command line before asking the kernel for the qdisc
+module, so "qdisc kind is unknown" still certifies our syntax, while a
+malformed command dies earlier with a usage/parse error (distinct
+messages, asserted below). Found-by-this-file: iproute2 5.x changed the
+delete-nothing error from "No such file or directory" to "Cannot delete
+qdisc with handle of zero", which net.fast()'s tolerance list missed.
+"""
+
+import os
+
+import pytest
+
+from jepsen_tpu import control
+from jepsen_tpu import net as net_mod
+from jepsen_tpu.net import IptablesNet
+
+# gate on the exact path the code under test invokes, not PATH
+pytestmark = pytest.mark.skipif(not os.path.exists(net_mod.TC),
+                                reason=f"no tc binary at {net_mod.TC}")
+
+
+@pytest.fixture
+def test_map():
+    t = {"nodes": ["localnode"], "ssh": {"mode": "local"}}
+    yield t
+    for s in t.get("_sessions", {}).values():
+        s.close()
+
+
+#: Messages that prove tc ACCEPTED our command line and only the kernel
+#: lacked the module / had nothing to delete.
+KERNEL_SIDE = ("qdisc kind is unknown", "No such file or directory",
+               "handle of zero", "Operation not permitted")
+
+
+def _syntax_ok(err: str) -> bool:
+    return any(m in err for m in KERNEL_SIDE)
+
+
+def _check_install(test_map, install):
+    """Run an install-shaping call; certify tc accepted the command
+    line, and ALWAYS restore the device if the qdisc actually landed
+    (a stray netem on lo would slow every later localhost test)."""
+    net = IptablesNet(device="lo")
+    installed = False
+    try:
+        try:
+            install(net)
+            installed = True
+        except control.RemoteError as e:
+            assert _syntax_ok(e.err or ""), (
+                f"tc rejected the command line: {e.err!r}")
+    finally:
+        if installed:
+            net.fast(test_map)
+
+
+class TestRealTc:
+    def test_slow_command_line_is_valid(self, test_map):
+        _check_install(test_map,
+                       lambda n: n.slow(test_map,
+                                        {"mean": 50, "variance": 10}))
+
+    def test_flaky_command_line_is_valid(self, test_map):
+        _check_install(test_map, lambda n: n.flaky(test_map))
+
+    def test_fast_on_clean_device_is_tolerated(self, test_map):
+        """Deleting when nothing is installed must not raise, whatever
+        this iproute2 calls the condition."""
+        if os.geteuid() != 0:
+            # non-root would exercise sudo(-S) password prompts, and
+            # fast()'s tolerance list has no escape hatch for that
+            pytest.skip("needs root (no sudo password path)")
+        IptablesNet(device="lo").fast(test_map)
+
+    def test_local_sudo_as_root_needs_no_sudo_binary(self, test_map):
+        """Minimal container images have no sudo; local mode as root
+        must treat sudo-to-root as a no-op (net.py wraps every tc call
+        in control.sudo())."""
+        if os.geteuid() != 0:
+            pytest.skip("not root")
+        with control.sudo():
+            out = control.exec(test_map, "localnode", "id", "-u")
+        assert out.strip() == "0"
