@@ -98,7 +98,16 @@ def install_archive(test: dict, node, url: str, dest: str,
             else:
                 control.exec(test, node, "mv", td, dest)
     except RemoteError as e:
-        if "Unexpected EOF" in str(e):
+        # truncation signatures across tool generations: the
+        # reference-era JVM stream said "Unexpected EOF"; GNU gzip says
+        # "unexpected end of file"; bsdtar says "Truncated input".
+        # (Found by tests/test_install_real.py against real tar+gzip —
+        # the old exact match never fired on modern hosts.)
+        # match the TOOL's stderr only — str(e) embeds the command line
+        # (archive paths could contain these words) and stdout
+        msg = (e.err or "").lower()
+        if ("unexpected eof" in msg or "unexpected end of file" in msg
+                or "truncated" in msg):
             if local_file:
                 raise RuntimeError(
                     f"local archive {local_file} on node {node} is "

@@ -20,9 +20,16 @@ from jepsen_tpu import control
 from jepsen_tpu import net as net_mod
 from jepsen_tpu.net import IptablesNet
 
-# gate on the exact path the code under test invokes, not PATH
-pytestmark = pytest.mark.skipif(not os.path.exists(net_mod.TC),
-                                reason=f"no tc binary at {net_mod.TC}")
+# gate on the exact path the code under test invokes (not PATH), and on
+# root: non-root runs would exercise sudo(-S) password prompts, whose
+# failure messages the syntax-certification below cannot distinguish
+# from real tc rejections
+pytestmark = [
+    pytest.mark.skipif(not os.path.exists(net_mod.TC),
+                       reason=f"no tc binary at {net_mod.TC}"),
+    pytest.mark.skipif(os.geteuid() != 0,
+                       reason="needs root (no sudo password path)"),
+]
 
 
 @pytest.fixture
@@ -73,18 +80,12 @@ class TestRealTc:
     def test_fast_on_clean_device_is_tolerated(self, test_map):
         """Deleting when nothing is installed must not raise, whatever
         this iproute2 calls the condition."""
-        if os.geteuid() != 0:
-            # non-root would exercise sudo(-S) password prompts, and
-            # fast()'s tolerance list has no escape hatch for that
-            pytest.skip("needs root (no sudo password path)")
         IptablesNet(device="lo").fast(test_map)
 
     def test_local_sudo_as_root_needs_no_sudo_binary(self, test_map):
         """Minimal container images have no sudo; local mode as root
         must treat sudo-to-root as a no-op (net.py wraps every tc call
         in control.sudo())."""
-        if os.geteuid() != 0:
-            pytest.skip("not root")
         with control.sudo():
             out = control.exec(test_map, "localnode", "id", "-u")
         assert out.strip() == "0"
