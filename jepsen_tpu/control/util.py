@@ -135,11 +135,17 @@ def ensure_user(test: dict, node, username: str) -> str:
 
 
 def grepkill(test: dict, node, pattern: str, signal: int = 9) -> None:
-    """Kill processes matching pattern (util.clj:159-174)."""
+    """Kill processes matching pattern (util.clj:159-174).
+
+    ``ps auxww``, not ``ps aux``: procps honors an inherited $COLUMNS
+    even when piped, truncating the command column — a pattern beyond
+    column ~80 then silently matches nothing (found by
+    tests/test_nemesis_real.py running under pytest, which exports
+    COLUMNS)."""
     try:
         control.execute(
             test, node,
-            f"ps aux | grep {control.escape(pattern)} | grep -v grep "
+            f"ps auxww | grep {control.escape(pattern)} | grep -v grep "
             f"| awk '{{print $2}}' | xargs kill -{signal}")
     except RemoteError as e:
         # empty kill list exits nonzero; that's fine

@@ -112,7 +112,7 @@ class TestDaemons:
         t = dummy_test()
         with control.session_pool(t):
             cu.grepkill(t, "n1", "cockroach")
-            assert any("ps aux | grep cockroach" in c
+            assert any("ps auxww | grep cockroach" in c
                        and "xargs kill -9" in c for c in log_of(t))
         t2 = dummy_test()
         with control.session_pool(t2):

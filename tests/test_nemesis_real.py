@@ -1,0 +1,73 @@
+"""Real-tool nemesis tests (local control mode): the command lines the
+fault injectors emit run through the REAL coreutils/procps on this
+host — the flag-drift class dummy transcripts cannot catch. Companion
+of tests/test_net_real.py (tc) and tests/test_install_real.py
+(wget/tar); the clock helpers' real-g++ compile lives in
+tests/test_nemesis_time.py.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from jepsen_tpu import nemesis
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.history import Op
+
+
+@pytest.fixture
+def test_map():
+    t = {"nodes": ["localnode"], "ssh": {"mode": "local"}}
+    yield t
+    for s in t.get("_sessions", {}).values():
+        s.close()
+
+
+class TestTruncateFileReal:
+    def test_drops_exactly_the_tail(self, test_map, tmp_path):
+        f = tmp_path / "wal.log"
+        f.write_bytes(b"A" * 1000)
+        op = Op(type="info", f="truncate", process="nemesis",
+                value={"localnode": {"file": str(f), "drop": 137}})
+        nemesis.truncate_file().invoke(test_map, op)
+        assert f.stat().st_size == 863
+        assert f.read_bytes() == b"A" * 863
+
+    def test_missing_file_is_tolerated(self, test_map, tmp_path):
+        """-c must keep truncate from creating the file (the reference
+        relies on this: truncating a log that rotated away is a no-op,
+        nemesis.clj:274-300)."""
+        ghost = tmp_path / "gone.log"
+        op = Op(type="info", f="truncate", process="nemesis",
+                value={"localnode": {"file": str(ghost), "drop": 10}})
+        nemesis.truncate_file().invoke(test_map, op)
+        assert not ghost.exists()
+
+
+class TestGrepkillReal:
+    def test_kills_only_matching_processes(self, test_map):
+        marker = f"jepsen-victim-{os.getpid()}"
+        victim = subprocess.Popen(
+            [sys.executable, "-c",
+             f"import time  # {marker}\ntime.sleep(300)"])
+        bystander = subprocess.Popen(
+            [sys.executable, "-c", "import time\ntime.sleep(10)"])
+        try:
+            cu.grepkill(test_map, "localnode", marker)
+            deadline = time.time() + 5
+            while victim.poll() is None and time.time() < deadline:
+                time.sleep(0.1)
+            assert victim.poll() is not None, "victim survived grepkill"
+            assert bystander.poll() is None, "bystander was killed"
+        finally:
+            for p in (victim, bystander):
+                if p.poll() is None:
+                    p.kill()
+                p.wait()
+
+    def test_no_match_is_quiet(self, test_map):
+        cu.grepkill(test_map, "localnode",
+                    "no-process-has-this-name-ever-xyzzy")
