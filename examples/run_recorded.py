@@ -2,8 +2,13 @@
 """Record end-to-end runs and commit their store artifacts.
 
 This environment has no docker/network, so a real 5-node daemon cluster
-(docker/up.sh) cannot run here. These are the two executable tiers the
-reference itself uses below the cluster tier (SURVEY §4):
+(docker/up.sh) cannot run here. Recorded instead: the executable tiers
+the reference itself uses below the cluster tier (SURVEY §4), plus the
+two real tiers this environment does support — local-kv(+unsafe), real
+multi-process daemons under the local control plane; the sqlite trio
+(register/bank/toctou), a real storage engine in the postgres-rds
+single-instance pattern; and wide-register-native, the C++ engine's
+recorded verdicts on the width-stress shape. The first two:
 
 1. **atom-cas** — the complete in-process lifecycle (reference
    core_test.clj basic-cas-test): real workers, generator, process
@@ -222,6 +227,33 @@ def run_localkv_unsafe():
     return result
 
 
+def run_sqlite():
+    """The real-engine tier (reference postgres-rds pattern): SQLite —
+    the stdlib module's production C library — under concurrent worker
+    connections with the lock-hammer nemesis, plus the bank invariant
+    and the check-then-act lost-update the checker must refute (see
+    suites/sqlitedb.py)."""
+    from jepsen_tpu.core import run
+    from jepsen_tpu.suites.sqlitedb import (
+        sqlite_bank_test, sqlite_register_test,
+        sqlite_register_toctou_test)
+
+    for name, ctor, expect, opts in (
+            ("sqlite-register", sqlite_register_test, True,
+             {"time-limit": 8}),
+            ("sqlite-bank", sqlite_bank_test, True, {"time-limit": 8}),
+            # the toctou schedule keeps its 20 s default: the 5 s think
+            # window needs headroom on loaded hosts (see sqlitedb.py)
+            ("sqlite-register-toctou", sqlite_register_toctou_test,
+             False, {})):
+        test = ctor(opts)
+        test["store-dir"] = os.path.join(OUT, name)
+        result = run(test)
+        got = result["results"]["valid"]
+        print(f"{name} valid: {got} (expected {expect})")
+        assert got is expect, (name, result["results"])
+
+
 if __name__ == "__main__":
     if os.path.isdir(OUT):
         shutil.rmtree(OUT)
@@ -232,4 +264,5 @@ if __name__ == "__main__":
     run_wide_native()
     run_localkv()
     run_localkv_unsafe()
+    run_sqlite()
     print("artifacts under", OUT)
