@@ -7,6 +7,7 @@ tests/test_nemesis_time.py.
 """
 
 import os
+import shutil
 import subprocess
 import sys
 import time
@@ -71,3 +72,31 @@ class TestGrepkillReal:
     def test_no_match_is_quiet(self, test_map):
         cu.grepkill(test_map, "localnode",
                     "no-process-has-this-name-ever-xyzzy")
+
+
+class TestEnsureUserReal:
+    """ensure_user against the real debian adduser (root container):
+    creation, idempotence ('already exists' tolerance), cleanup.
+    Lives here (not in test_install_real.py) so the wget/tar module
+    gate there cannot skip it — pytest marks accumulate across levels
+    and cannot be overridden per-class."""
+
+    USER = "jepsen-test-usr"
+
+    @pytest.fixture(autouse=True)
+    def _cleanup(self):
+        yield
+        import subprocess
+        subprocess.run(["deluser", "--quiet", "--remove-home",
+                        self.USER], capture_output=True)
+
+    @pytest.mark.skipif(os.geteuid() != 0 or not shutil.which("adduser"),
+                        reason="needs root + adduser")
+    def test_creates_then_tolerates_existing(self, test_map):
+        import pwd
+        assert cu.ensure_user(test_map, "localnode", self.USER) \
+            == self.USER
+        assert pwd.getpwnam(self.USER).pw_name == self.USER
+        # second call must hit the 'already exists' tolerance
+        assert cu.ensure_user(test_map, "localnode", self.USER) \
+            == self.USER
