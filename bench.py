@@ -134,29 +134,55 @@ def child_main(platform: str) -> int:
     _util_line("headline", warm, [result2])
 
     if not os.environ.get("JEPSEN_BENCH_SKIP_SECONDARY"):
-        # Ordered by evidentiary value: if the orchestrator's timeout
-        # lands mid-way, the earlier stderr lines survive in the tail.
-        try:
-            _wide_history_comparison()
-        except Exception as e:  # noqa: BLE001
-            print(f"# wide comparison failed: {e!r}", file=sys.stderr)
-        try:
-            _staggered_comparison()
-        except Exception as e:  # noqa: BLE001
-            print(f"# staggered comparison failed: {e!r}", file=sys.stderr)
-        try:
-            _keyed_batch_comparison(dev.platform)
-        except Exception as e:  # noqa: BLE001
-            print(f"# keyed comparison failed: {e!r}", file=sys.stderr)
+        # Soft deadline (orchestrator-set): a child SIGKILLed mid-TPU-use
+        # can leave the chip lease stuck for minutes, hanging the NEXT
+        # child's init — so the child checks the clock between secondaries
+        # and exits cleanly (releasing the device) before the hard kill.
+        deadline = float(os.environ.get("JEPSEN_BENCH_CHILD_DEADLINE")
+                         or "0") or None
+
+        # Each stage is (label, fn, headroom): headroom is the seconds of
+        # soft-deadline slack a stage needs to START — a rough upper bound
+        # on its own runtime, so it finishes before the orchestrator's
+        # hard kill (a SIGKILL mid-TPU-use wedges the chip lease for the
+        # next child). Short on slack, a stage is skipped (later, cheaper
+        # stages still get their chance); past the deadline itself the
+        # child exits cleanly to release the device. CPU keeps the
+        # historical order (wide first: no init cost, no lease to wedge)
+        # and zero headrooms (nothing to wedge on a SIGKILL).
+        wide = lambda: _wide_history_comparison(deadline)  # noqa: E731
         if dev.platform != "cpu":
+            stages = [
+                ("staggered", _staggered_comparison, 30.0),
+                ("keyed", lambda: _keyed_batch_comparison(dev.platform), 120.0),
+                ("tuning sweep", lambda: _tpu_tuning_sweep(history), 90.0),
+                ("secondary metrics", _secondary_metrics, 180.0),
+                ("wide", wide, 180.0),
+            ]
+        else:
+            stages = [
+                ("wide", wide, 0.0),
+                ("staggered", _staggered_comparison, 0.0),
+                ("keyed", lambda: _keyed_batch_comparison(dev.platform), 0.0),
+                ("secondary metrics", _secondary_metrics, 0.0),
+            ]
+        for label, fn, headroom in stages:
+            if deadline is not None:
+                now = time.time()
+                if now > deadline:
+                    print(f"# secondaries: soft deadline hit before {label};"
+                          f" exiting cleanly to release the device",
+                          file=sys.stderr)
+                    return 0
+                if now > deadline - headroom:
+                    print(f"# secondaries: skipping {label} (needs "
+                          f"~{headroom:.0f}s of soft-deadline slack)",
+                          file=sys.stderr)
+                    continue
             try:
-                _tpu_tuning_sweep(history)
-            except Exception as e:  # noqa: BLE001
-                print(f"# tuning sweep failed: {e!r}", file=sys.stderr)
-        try:
-            _secondary_metrics()
-        except Exception as e:  # noqa: BLE001 — must not eat the line
-            print(f"# secondary metrics failed: {e!r}", file=sys.stderr)
+                fn()
+            except Exception as e:  # noqa: BLE001 — must not eat the line
+                print(f"# {label} failed: {e!r}", file=sys.stderr)
     return 0
 
 
@@ -301,7 +327,7 @@ def _util_line_inner(label, seconds, results):
     print(line, file=sys.stderr)
 
 
-def _wide_history_comparison():
+def _wide_history_comparison(child_deadline=None):
     """The WIDTH regime — the device path's structural win. A register
     history with 100 fully-overlapping processes per round (the
     aerospike 100-thread CAS shape, reference aerospike/core.clj:566-575)
@@ -331,6 +357,12 @@ def _wide_history_comparison():
     _util_line("wide-100x4", warm, [r])
     if available():
         cap_s = 120.0
+        # Clamp the native side's budget to the child's soft deadline
+        # (when set): wide is the stage most likely to be in flight when
+        # the orchestrator's hard kill lands, and a SIGKILL mid-TPU-use
+        # wedges the chip lease for the next child.
+        if child_deadline is not None:
+            cap_s = max(5.0, min(cap_s, child_deadline - _t.time()))
         deadline = _t.time() + cap_s
         t0 = _t.time()
         rn = check_history_native(
@@ -672,6 +704,17 @@ def _run_child(platform: str, timeout: float, skip_secondary: bool = False):
     # the library-level accelerator watchdog probing AGAIN inside the
     # child would double a minutes-long healthy-but-cold TPU init.
     env["JEPSEN_ACCEL_OK"] = "1"
+    if platform != "cpu":
+        # Soft deadline 45 s ahead of the hard kill: lets the child finish
+        # the secondary in flight and exit cleanly, releasing the device
+        # lease (a SIGKILL mid-TPU-use can wedge the chip for the next
+        # child's init — observed: 10+ min of hung init). CPU children get
+        # no deadline: nothing to wedge, and the kill-and-salvage path
+        # preserves their stderr tail, so they measure right up to the
+        # hard kill. Floored so a near-exhausted budget still yields a
+        # moment for the headline before the clean exit.
+        env["JEPSEN_BENCH_CHILD_DEADLINE"] = str(
+            time.time() + max(10.0, timeout - 45.0))
     if skip_secondary:
         env["JEPSEN_BENCH_SKIP_SECONDARY"] = "1"
     if platform == "cpu":
