@@ -773,9 +773,31 @@ def main() -> int:
     signal.signal(signal.SIGTERM, on_term)
     signal.signal(signal.SIGINT, on_term)
 
+    # Cheap init probe before committing a full child budget to the TPU:
+    # a wedged chip lease hangs backend init for 10+ minutes, so a full
+    # attempt would burn its whole timeout in init. Reuses the library
+    # watchdog (jepsen_tpu.accel): disposable child, returncode check,
+    # output sentinel; importing it does NOT initialize a backend in this
+    # process. Timeout is generous (accel.py: a healthy-but-cold tunnel
+    # can take minutes) but clamped to the budget, leaving the CPU
+    # fallback's minimum. On a cpu-pinned host accel answers "cpu"
+    # without spawning anything, which routes straight to the fallback.
+    tpu_ok = True
+    if not os.environ.get("JEPSEN_BENCH_SKIP_PROBE"):
+        probe_t = min(240.0, deadline - time.time() - 90.0)
+        if probe_t >= 30:
+            t0 = time.time()
+            from jepsen_tpu.accel import probe_default_backend
+            plat = probe_default_backend(timeout=probe_t)
+            tpu_ok = plat not in (None, "cpu")
+            note = (f"probe: {plat or f'init hung {probe_t:.0f}s'}"
+                    f" in {time.time() - t0:.0f}s")
+            print(f"# bench: {note}", file=sys.stderr)
+            notes.append(note)
+
     # TPU attempts (sandboxed: a hung plugin init gets killed, not us),
     # with one backoff retry — transient UNAVAILABLE at init is common.
-    for attempt in range(2):
+    for attempt in range(2 if tpu_ok else 0):
         remaining = deadline - time.time()
         if remaining < 120:
             notes.append("tpu: out of budget")
