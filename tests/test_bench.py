@@ -32,6 +32,35 @@ class TestBenchContract:
         assert "cold_s" in rec
         assert pr.returncode == 0
 
+    def test_orchestrator_routes_failed_probe_to_cpu_fallback(self):
+        """When the init probe does not certify an accelerator (instant
+        'cpu' on a plain host; a hang within the operator-capped timeout
+        on a host whose ambient plugin overrides JAX_PLATFORMS — observed
+        with the axon plugin, which pins its own platform at import), the
+        orchestrator must skip both TPU attempts and emit the contract
+        line from the CPU fallback."""
+        env = dict(os.environ)
+        env.pop("JEPSEN_ACCEL_OK", None)         # force the probe path
+        env.pop("JEPSEN_BENCH_SKIP_PROBE", None)
+        env.update({
+            "JEPSEN_BENCH_N_OPS": "300",
+            "JEPSEN_BENCH_SKIP_SECONDARY": "1",
+            "JEPSEN_BENCH_BUDGET_S": "280",
+            "JEPSEN_ACCEL_PROBE_TIMEOUT": "5",
+            "JAX_PLATFORMS": "cpu",
+        })
+        pr = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            env=env, capture_output=True, text=True, timeout=300)
+        lines = [ln for ln in pr.stdout.splitlines() if ln.strip()]
+        assert len(lines) == 1, pr.stdout + pr.stderr[-500:]
+        rec = json.loads(lines[0])
+        assert rec["platform"] == "cpu"
+        assert isinstance(rec["value"], (int, float))
+        assert "# bench: probe:" in pr.stderr
+        assert "trying platform=tpu" not in pr.stderr
+        assert pr.returncode == 0
+
     def test_graft_entry_compiles_single_device(self):
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
