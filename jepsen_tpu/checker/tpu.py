@@ -637,11 +637,13 @@ def _os_environ_get(name: str) -> Optional[str]:
     return _os.environ.get(name)
 
 
-def _unroll_factor() -> int:
-    """Search steps per while_loop iteration. JTPU_UNROLL overrides; the
-    default is 1 (measured best on the CPU backend, where the math
-    dominates) — on TPU, sweep via bench.py and set the env var."""
-    return int(_os_environ_get("JTPU_UNROLL") or "0") or _UNROLL
+def _unroll_factor(default: int = _UNROLL) -> int:
+    """Search steps per while_loop iteration. JTPU_UNROLL overrides
+    (unset or 0 mean "use the default"); the module default is 1
+    (measured best on the CPU backend for the dense single-history
+    shapes, where the sort math dominates) — call sites whose workload
+    is loop-overhead-bound pass a different default."""
+    return int(_os_environ_get("JTPU_UNROLL") or "0") or default
 
 
 @functools.lru_cache(maxsize=64)
@@ -1340,8 +1342,18 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
                               for a in arrays]
                 else:
                     arrays = [jax.device_put(a, sh_row) for a in arrays]
+            # The slim entry rung runs the high-forced-fraction cohort
+            # (staggered keys), whose levels are fast-forward loops, not
+            # sorts — unrolling 2 search steps per while_loop iteration
+            # amortizes the outer-loop overhead those levels are made of
+            # (measured on a quiet host, 64x500 staggered keys: 0.25 s ->
+            # 0.19 s warm, ~parity with the native thread pool; dense
+            # cohorts and later rungs measured flat-to-worse, so they
+            # keep 1). JTPU_UNROLL still overrides globally.
+            unroll = _unroll_factor(2 if adaptive and step == 0
+                                    else _UNROLL)
             fn = _jit_batch(_kernel_key(kernel), cap, win, exp,
-                            _unroll_factor(), tiebreak=tb)
+                            unroll, tiebreak=tb)
             outs = fn(*arrays)
             if multiproc:
                 # Per-key verdict rows live on their owning host; gather
