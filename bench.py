@@ -156,7 +156,8 @@ def child_main(platform: str) -> int:
                 ("staggered", _staggered_comparison, 30.0),
                 ("keyed", lambda: _keyed_batch_comparison(dev.platform), 120.0),
                 ("tuning sweep", lambda: _tpu_tuning_sweep(history), 90.0),
-                ("secondary metrics", _secondary_metrics, 180.0),
+                ("secondary metrics",
+                 lambda: _secondary_metrics(deadline), 300.0),
                 ("wide", wide, 180.0),
             ]
         else:
@@ -164,7 +165,8 @@ def child_main(platform: str) -> int:
                 ("wide", wide, 0.0),
                 ("staggered", _staggered_comparison, 0.0),
                 ("keyed", lambda: _keyed_batch_comparison(dev.platform), 0.0),
-                ("secondary metrics", _secondary_metrics, 0.0),
+                ("secondary metrics",
+                 lambda: _secondary_metrics(deadline), 0.0),
             ]
         for label, fn, headroom in stages:
             if deadline is not None:
@@ -515,9 +517,11 @@ def _keyed_batch_comparison(platform: str):
         print(line, file=sys.stderr)
 
 
-def _secondary_metrics():
+def _secondary_metrics(deadline=None):
     """BASELINE.md's secondary configs, reported on stderr (the driver
-    contract is one JSON line for the headline metric)."""
+    contract is one JSON line for the headline metric). ``deadline``
+    (the child's soft deadline) gates the long-running 1M-op device
+    stretch check."""
     import time as _t
 
     from jepsen_tpu.checker.tpu import check_history_tpu, check_keyed_tpu
@@ -592,6 +596,25 @@ def _secondary_metrics():
     print(f"# secondary: 100k-op history: {r['valid']} "
           f"levels={r.get('levels')} in {_t.time()-t0:.2f}s "
           f"(incl. compile)", file=sys.stderr)
+
+    # config 7b (stretch): 100x — a 1M-op staggered history through the
+    # DEVICE search (the native engine's 1M line is below; forced
+    # fast-forward collapses ~1M levels to ~60k). Device warm measured
+    # 16.5 s on the quiet CPU backend. Gated on the soft deadline: synth
+    # + compile + search is the longest sub-check in this stage, and on
+    # TPU an overrun means a SIGKILL mid-device-use (the lease wedge).
+    if deadline is None or _t.time() < deadline - 120:
+        h1m_dev = simulate_register_history(
+            1_000_000, n_procs=N_PROCS, n_vals=16, seed=4,
+            crash_p=0.0, overlap_p=0.05)
+        t0 = _t.time()
+        r = check_history_tpu(h1m_dev, CASRegister())
+        print(f"# secondary: 1M-op staggered history (device): "
+              f"{r['valid']} levels={r.get('levels')} in "
+              f"{_t.time()-t0:.2f}s (incl. compile)", file=sys.stderr)
+    else:
+        print("# secondary: 1M-op device check skipped (soft deadline)",
+              file=sys.stderr)
 
     # configs 1/3/4: the CPU-tier baselines — 200-op linearizable via
     # the host facade, and the counter/set/total-queue folds at 10k ops
