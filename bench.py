@@ -482,12 +482,20 @@ def _keyed_batch_comparison(platform: str):
     from jepsen_tpu.testing import simulate_register_history
 
     n_keys, n_ops = (256, 2000) if platform != "cpu" else (64, 500)
-    shapes = (("dense", dict(crash_p=0.001)),
-              # the realistic independent-key shape: staggered per-key
-              # histories (etcd.clj:167-173 staggers 1/30 s) ride the
-              # forced fast-forward — the configuration where the device
-              # batch approaches/overtakes the native thread pool
-              ("staggered", dict(crash_p=0.0, overlap_p=0.05)))
+    # Staggered measured FIRST: it is the near-parity claim and small
+    # enough (~0.2 s standalone) that running it after the dense batch
+    # inflates it ~0.15 s of in-process residue (allocator/thread-pool
+    # state) — dense at ~2 s is insensitive to the same residue. Note
+    # the cold= attribution moves with the order: whichever shape runs
+    # first absorbs the shared keyed-path compile in its cold number
+    # (warm=, the recorded claim, is unaffected).
+    shapes = (
+        # the realistic independent-key shape: staggered per-key
+        # histories (etcd.clj:167-173 staggers 1/30 s) ride the
+        # forced fast-forward — the configuration where the device
+        # batch approaches/overtakes the native thread pool
+        ("staggered", dict(crash_p=0.0, overlap_p=0.05)),
+        ("dense", dict(crash_p=0.001)))
     for label, kw in shapes:
         keyed = {k: simulate_register_history(n_ops, n_procs=5, n_vals=8,
                                               seed=7000 + k, **kw)
