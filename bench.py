@@ -451,9 +451,15 @@ def _staggered_comparison():
     t0 = _t.time()
     r = check_history_tpu(h, CASRegister())
     cold = _t.time() - t0
-    t0 = _t.time()
-    r = check_history_tpu(h, CASRegister())
-    warm = _t.time() - t0
+    # Best of two warm runs: at ~50-100 ms this measurement occasionally
+    # catches a 6x in-process hiccup (observed 0.39 s once against a
+    # 0.047-0.116 s typical range across bench runs); the min is the
+    # steady-state claim.
+    warm = float("inf")
+    for _ in range(2):
+        t0 = _t.time()
+        r = check_history_tpu(h, CASRegister())
+        warm = min(warm, _t.time() - t0)
     line = (f"# staggered {N_OPS}-op (etcd-tutorial shape): device "
             f"{r['valid']} warm={warm:.3f}s cold={cold:.2f}s "
             f"levels={r.get('levels')}")
