@@ -154,6 +154,7 @@ def child_main(platform: str) -> int:
         if dev.platform != "cpu":
             stages = [
                 ("staggered", _staggered_comparison, 30.0),
+                ("recovery", lambda: _recovery_overhead(history), 60.0),
                 ("keyed", lambda: _keyed_batch_comparison(dev.platform), 120.0),
                 ("tuning sweep", lambda: _tpu_tuning_sweep(history), 90.0),
                 ("secondary metrics",
@@ -164,6 +165,7 @@ def child_main(platform: str) -> int:
             stages = [
                 ("wide", wide, 0.0),
                 ("staggered", _staggered_comparison, 0.0),
+                ("recovery", lambda: _recovery_overhead(history), 0.0),
                 ("keyed", lambda: _keyed_batch_comparison(dev.platform), 0.0),
                 ("secondary metrics",
                  lambda: _secondary_metrics(deadline), 0.0),
@@ -430,6 +432,74 @@ def _tpu_tuning_sweep(history):
         print(f"# sweep: first-rung={label} ({cap}/{exp}) "
               f"warm={warm:.2f}s cold={cold:.2f}s valid={r['valid']} "
               f"levels={r.get('levels')}", file=sys.stderr)
+
+
+def _recovery_overhead(history):
+    """The resilient execution layer's price tag, on the headline
+    history: (a) the monolithic single-while_loop search vs the default
+    checkpointed segmented search — the steady-state overhead every run
+    now pays for crash-survivability; (b) a search killed after two
+    segments and resumed from its checkpoint — what a mid-run
+    preemption actually costs vs re-running from scratch."""
+    import time as _t
+
+    from jepsen_tpu import resilience
+    from jepsen_tpu.checker.tpu import check_history_tpu
+    from jepsen_tpu.models import CASRegister
+    from jepsen_tpu.ops.encode import pack_with_init
+
+    def best_of(fn, n=2):
+        best = float("inf")
+        for _ in range(n):
+            t0 = _t.time()
+            fn()
+            best = min(best, _t.time() - t0)
+        return best
+
+    prior = os.environ.get("JTPU_SEGMENT_ITERS")
+    try:
+        os.environ["JTPU_SEGMENT_ITERS"] = "0"
+        check_history_tpu(history, CASRegister())   # absorb compile
+        mono = best_of(lambda: check_history_tpu(history, CASRegister()))
+        os.environ["JTPU_SEGMENT_ITERS"] = "1024"
+        check_history_tpu(history, CASRegister())
+        segd = best_of(lambda: check_history_tpu(history, CASRegister()))
+    finally:
+        if prior is None:
+            os.environ.pop("JTPU_SEGMENT_ITERS", None)
+        else:
+            os.environ["JTPU_SEGMENT_ITERS"] = prior
+
+    # kill-after-2-segments + checkpoint resume (small segments so the
+    # search is guaranteed to span several): wall time of dying and
+    # recovering, end to end
+    p, kernel = pack_with_init(history, CASRegister())
+    cps = []
+
+    def killer(ctx):
+        if ctx["segment"] == 2 and not cps[2:]:
+            raise RuntimeError("bench-injected mid-run kill")
+
+    t0 = _t.time()
+    resilience._inject_fault = killer
+    try:
+        try:
+            resilience.supervised_check_packed(
+                p, kernel, segment_iters=128, on_checkpoint=cps.append)
+        except RuntimeError:
+            pass
+    finally:
+        resilience._inject_fault = None
+    r = resilience.supervised_check_packed(
+        p, kernel, segment_iters=128,
+        resume=cps[-1] if cps else None)
+    recov = _t.time() - t0
+    print(f"# recovery: single-shot={mono:.3f}s "
+          f"checkpointed={segd:.3f}s "
+          f"(+{(segd / mono - 1) * 100:.0f}% steady-state), "
+          f"kill@seg2+resume={recov:.3f}s valid={r['valid']} "
+          f"segments={r.get('segments')} "
+          f"({len(cps)} checkpoints taken)", file=sys.stderr)
 
 
 def _staggered_comparison():

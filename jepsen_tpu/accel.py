@@ -35,6 +35,20 @@ from typing import Optional
 #: silently costs the device path. Env-tunable for impatient callers.
 PROBE_TIMEOUT_S = float(os.environ.get("JEPSEN_ACCEL_PROBE_TIMEOUT", "300"))
 
+
+def _probe_timeout() -> float:
+    """The effective probe timeout, re-reading JEPSEN_ACCEL_PROBE_TIMEOUT
+    at call time — orchestrators set it after this module imports (and
+    tests monkeypatch PROBE_TIMEOUT_S directly, which stays honored as
+    the fallback)."""
+    v = os.environ.get("JEPSEN_ACCEL_PROBE_TIMEOUT")
+    if v:
+        try:
+            return float(v)
+        except ValueError:
+            pass
+    return PROBE_TIMEOUT_S
+
 #: The probe child's program. Module-level so tests can substitute a
 #: genuinely-hanging child without touching a real plugin.
 _PROBE_CODE = ("import jax\n"
@@ -123,7 +137,7 @@ def probe_default_backend(timeout: Optional[float] = None) -> Optional[str]:
         if plat is None and _configured_platforms().strip().lower() == "cpu":
             plat = "cpu"  # host backend: init cannot wedge
         if plat is None:
-            plat = _spawn_probe(PROBE_TIMEOUT_S if timeout is None
+            plat = _spawn_probe(_probe_timeout() if timeout is None
                                 else timeout)
         _state["platform"] = plat
         return plat
@@ -144,7 +158,7 @@ def ensure_usable(caller: str = "checker",
             _state["degraded"] = True
             warnings.warn(
                 f"accelerator backend initialization hung past "
-                f"{PROBE_TIMEOUT_S if timeout is None else timeout:.0f}s; "
+                f"{_probe_timeout() if timeout is None else timeout:.0f}s; "
                 f"{caller} degrading to the CPU backend "
                 f"(set JEPSEN_ACCEL_PROBE_TIMEOUT to wait longer)",
                 RuntimeWarning, stacklevel=3)
@@ -154,6 +168,50 @@ def ensure_usable(caller: str = "checker",
     except Exception:  # noqa: BLE001 — backend already up: leave it
         pass
     return "cpu"
+
+
+# ---------------------------------------------------------------------------
+# Run-time degradation (the execution-phase extension of the init watchdog;
+# driven by jepsen_tpu.resilience's segment supervisor)
+# ---------------------------------------------------------------------------
+
+
+def cpu_device():
+    """The host fallback device for mid-run degradation, or None when no
+    CPU backend is addressable (e.g. JAX_PLATFORMS pinned to a dead
+    accelerator only). Unlike ensure_usable this never re-pins platform
+    config — the ambient backend is already initialized mid-run."""
+    try:
+        import jax
+        return jax.devices("cpu")[0]
+    except Exception:  # noqa: BLE001 — no cpu platform registered
+        return None
+
+
+def runtime_wedged() -> bool:
+    """True once a mid-run device wedge was recorded this process —
+    supervised searches then start on the CPU fallback directly instead
+    of re-feeding work to a plugin that already ate one search."""
+    with _lock:
+        return bool(_state.get("runtime_wedged"))
+
+
+def note_runtime_wedge(caller: str, deadline_s: float, **detail) -> bool:
+    """Record (once, with a visible warning) that a device EXECUTION
+    wedged mid-run. Returns True the first time. The init verdict is
+    left alone — the backend did initialize; it is the run that died."""
+    with _lock:
+        first = not _state.get("runtime_wedged")
+        _state["runtime_wedged"] = True
+    if first:
+        extra = "".join(f" {k}={v}" for k, v in sorted(detail.items()))
+        warnings.warn(
+            f"device execution wedged past {deadline_s:.1f}s mid-run;"
+            f" {caller} resuming from its checkpoint on the CPU "
+            f"fallback{extra} (subsequent supervised searches start on "
+            f"the fallback; JTPU_SEGMENT_DEADLINE_S tunes the watchdog)",
+            RuntimeWarning, stacklevel=3)
+    return first
 
 
 def _reset_for_tests() -> None:

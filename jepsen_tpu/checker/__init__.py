@@ -60,11 +60,23 @@ class FnChecker(Checker):
 def check_safe(checker: Checker, test: dict, history: History,
                opts: Optional[dict] = None) -> Dict[str, Any]:
     """Like check, but exceptions yield {'valid': 'unknown'} with the trace
-    (checker.clj:63-74)."""
+    (checker.clj:63-74), the resilience failure class, and — when the
+    supervised device search died mid-run — the attempt trail it had
+    accumulated (jepsen_tpu.resilience attaches it to the exception)."""
     try:
         return checker.check(test, history, opts or {})
-    except Exception:  # noqa: BLE001
-        return {"valid": UNKNOWN, "error": traceback.format_exc()}
+    except Exception as e:  # noqa: BLE001
+        out: Dict[str, Any] = {"valid": UNKNOWN,
+                               "error": traceback.format_exc()}
+        try:
+            from jepsen_tpu.resilience import classify_failure
+            out["error-class"] = classify_failure(e)
+        except ImportError:  # pragma: no cover — partial install
+            pass
+        trail = getattr(e, "resilience_trail", None)
+        if trail:
+            out["attempts"] = list(trail)
+        return out
 
 
 class Compose(Checker):

@@ -33,16 +33,40 @@ from jepsen_tpu.models.core import (
 from jepsen_tpu.ops.encode import PackedHistory, RET_INF
 
 
+def _bound_stop(should_stop: Optional[Callable[[], bool]],
+                deadline_s: Optional[float]):
+    """Fold an optional wall-clock deadline into a should_stop predicate
+    (jepsen_tpu.resilience.deadline_stop) — the host-search analogue of
+    the device segment watchdog. Returns (should_stop, describe) where
+    ``describe(msg)`` rewrites a cancellation message when it was the
+    deadline that fired."""
+    if deadline_s is None:
+        return should_stop, (lambda msg: msg)
+    from jepsen_tpu.resilience import deadline_stop
+    import time as _time
+    t_end = _time.monotonic() + deadline_s
+
+    def describe(msg: str) -> str:
+        if _time.monotonic() > t_end:
+            return f"deadline {deadline_s}s exceeded"
+        return msg
+
+    return deadline_stop(deadline_s, should_stop), describe
+
+
 def check_jit_packed(p: PackedHistory, kernel: KernelSpec,
                      max_configs: Optional[int] = None,
-                     should_stop: Optional[Callable[[], bool]] = None
+                     should_stop: Optional[Callable[[], bool]] = None,
+                     deadline_s: Optional[float] = None
                      ) -> Dict[str, Any]:
     """JIT linearization over a packed single-key history.
 
     Returns {'valid': bool|'unknown', 'configs-explored': n, ...};
     ``should_stop`` is polled so a competition race can abandon the
-    slower algorithm.
+    slower algorithm, and ``deadline_s`` bounds the search by wall
+    clock the same way the device path's watchdog bounds segments.
     """
+    should_stop, _describe = _bound_stop(should_stop, deadline_s)
     n = p.n
     if p.n_required == 0:
         return {"valid": True, "configs-explored": 0}
@@ -85,7 +109,7 @@ def check_jit_packed(p: PackedHistory, kernel: KernelSpec,
             if should_stop is not None and explored % 512 == 0 \
                     and should_stop():
                 return {"valid": UNKNOWN, "configs-explored": explored,
-                        "error": "cancelled"}
+                        "error": _describe("cancelled")}
             if j in L:
                 # j committed: drop it from the in-flight set key
                 new_configs.add((L - {j}, s))
@@ -108,9 +132,11 @@ def check_jit_packed(p: PackedHistory, kernel: KernelSpec,
 
 def check_jit_model(history: History, model: Model,
                     max_configs: Optional[int] = None,
-                    should_stop: Optional[Callable[[], bool]] = None
+                    should_stop: Optional[Callable[[], bool]] = None,
+                    deadline_s: Optional[float] = None
                     ) -> Dict[str, Any]:
     """JIT linearization over arbitrary Model objects."""
+    should_stop, _describe = _bound_stop(should_stop, deadline_s)
     from jepsen_tpu.checker.wgl import _pair_sorted
     rows = _pair_sorted(history)
     n = len(rows)
@@ -147,7 +173,7 @@ def check_jit_model(history: History, model: Model,
             if should_stop is not None and explored % 512 == 0 \
                     and should_stop():
                 return {"valid": UNKNOWN, "configs-explored": explored,
-                        "error": "cancelled"}
+                        "error": _describe("cancelled")}
             if j in L:
                 new_configs.add((L - {j}, m))
                 continue

@@ -78,6 +78,25 @@ MAX_WINDOW = 128
 #: JTPU_UNROLL=2|4 and re-measure — compile time scales with the unroll.
 _UNROLL = 1
 
+#: Device iterations per checkpointed segment (see JTPU_SEGMENT_ITERS and
+#: jepsen_tpu.resilience): the single-history search runs as an outer host
+#: loop of bounded device segments, snapshotting the carry to host between
+#: them so a crashed / wedged / preempted search resumes where it left off
+#: instead of losing everything. 0 disables segmentation (one monolithic
+#: while_loop, the pre-resilience behavior).
+DEFAULT_SEGMENT_ITERS = 1024
+
+
+def _level_budget(n: int, n_cr: int) -> int:
+    """Iteration budget for a search over ``n`` padded required ops and
+    ``n_cr`` padded crashed ops: the witness path alone needs ~n+n_cr
+    expansions, and best-first backtracking re-expands some configs (no
+    global visited set); past this the run reports UNKNOWN rather than
+    spin. Shared by the in-device while_loop condition and the host-side
+    segment supervisor (jepsen_tpu.resilience), which must agree on when
+    a checkpointed carry is still worth resuming."""
+    return 2 * (n + n_cr) + 256
+
 
 def _bucket(n: int, lo: int = 16) -> int:
     """Round n up to a power of two so jit compilations are shared across
@@ -142,7 +161,7 @@ def _shr_by_mw(m, t, MW: int):
 def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
                expand: Optional[int] = None, unroll: int = 1,
                shard_axis: Optional[str] = None,
-               tiebreak: str = "lex"):
+               tiebreak: str = "lex", segment: bool = False):
     """Build the single-key search. ``n`` is the (static, padded) length of
     the *required* section — ops with finite return, sorted by return index.
     ``n_cr`` is the (static, padded) width of the *crashed* section — 'info'
@@ -223,7 +242,7 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
     #: iteration budget: the witness path alone needs ~n+CR expansions, and
     #: best-first backtracking re-expands some configs (no global visited
     #: set); past this the run reports UNKNOWN rather than spin.
-    LMAX = 2 * (n + CR) + 256
+    LMAX = _level_budget(n, CR)
 
     # Static bit matrices: bitmat[o, w] has bit (o mod 32) set iff offset o
     # lives in word w — one uint32 AND/OR against them tests/sets any bit of
@@ -245,7 +264,7 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
         return _shr_by_mw(m, t, MW)
 
     def search(f, v1, v2, ro, fr, inv, ret, sufmin, cf, cv1, cv2, cinv,
-               cps, n_required, init_state):
+               cps, n_required, init_state, seg_iters=None, carry_in=None):
         offs = jnp.arange(W, dtype=jnp.int32)          # [W]
 
         def crash_bound(cm_rows):
@@ -608,6 +627,22 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
                 c = body(c)
             return c
 
+        if segment:
+            # Checkpointed segment mode (jepsen_tpu.resilience): run at
+            # most seg_iters levels from the supplied carry and return
+            # the RAW carry — the host supervisor snapshots it between
+            # segments (the checkpoint), decides continuation, and
+            # summarizes via _summarize_carry when the search goes
+            # inactive. The body sequence is identical to the monolithic
+            # loop's, so verdicts and level counts match exactly.
+            carry = carry0 if carry_in is None else carry_in
+            lvl0 = carry[8]
+
+            def seg_active(c):
+                return active(c) & ((c[8] - lvl0) < seg_iters)
+
+            return lax.while_loop(seg_active, body_n, carry)
+
         out = lax.while_loop(active, body_n, carry0)
         alive_out, done = out[4], out[5]
         lossy, wovf = out[6], out[7]
@@ -660,6 +695,81 @@ def _jit_single(kernel_id: int, capacity: int, window: int,
                       cinv, cps, nr, ini)
 
     return jax.jit(single)
+
+
+@functools.lru_cache(maxsize=64)
+def _jit_segment(kernel_id: int, capacity: int, window: int,
+                 expand: Optional[int] = None, unroll: int = 1):
+    """One bounded-iteration device segment of the single-history search
+    (the checkpointed mode jepsen_tpu.resilience drives): takes the packed
+    columns, a traced per-call iteration bound, and the search carry;
+    returns the updated carry. The bound is traced (not static), so
+    changing segment length never recompiles."""
+    kernel = _KERNELS_BY_ID[kernel_id]
+
+    def seg(f, v1, v2, ro, fr, inv, ret, sm, cf, cv1, cv2, cinv,
+            cps, nr, ini, seg_iters, carry):
+        search = _search_fn(kernel.step, f.shape[0], cf.shape[0],
+                            capacity, window, expand, unroll, segment=True)
+        return search(f, v1, v2, ro, fr, inv, ret, sm, cf, cv1, cv2,
+                      cinv, cps, nr, ini, seg_iters, carry)
+
+    return jax.jit(seg)
+
+
+def _carry0_host(capacity: int, window: int, n_cr: int, init_state,
+                 n_required: int) -> tuple:
+    """Host-side initial search carry, mirroring _search_fn's carry0
+    layout exactly (k, mask, cmask, state, alive, done, lossy, wovf,
+    level, best_k, pool_k, pool_state, pool_alive). Built on host so the
+    segment supervisor owns the carry end to end — it IS the checkpoint
+    format (doc/resilience.md)."""
+    MW = (window + 31) // 32
+    MC = max((n_cr + 31) // 32, 1)
+    k0 = np.zeros(capacity, np.int32)
+    mask0 = np.zeros((capacity, MW), np.uint32)
+    cmask0 = np.zeros((capacity, MC), np.uint32)
+    state0 = np.full(capacity, int(np.int32(init_state)), np.int32)
+    alive0 = np.arange(capacity) == 0
+    return (k0, mask0, cmask0, state0, alive0,
+            np.bool_(n_required == 0), np.bool_(False), np.bool_(False),
+            np.int32(0), np.int32(0),
+            k0.copy(), state0.copy(), alive0.copy())
+
+
+def _carry_active(carry, lmax: int) -> bool:
+    """Host mirror of _search_fn's while condition: more segments are
+    worth running iff the search isn't done, some pool row lives, and the
+    level budget isn't exhausted."""
+    done, alive, level = carry[5], carry[4], carry[8]
+    return (not bool(done)) and bool(np.any(alive)) and int(level) <= lmax
+
+
+def _summarize_carry(carry) -> tuple:
+    """Host mirror of _search_fn's post-loop summary: returns (done,
+    lossy, wovf, best_k, levels, pool). Stopping at the iteration budget
+    with work left must not read as a refutation — exactly the
+    monolithic loop's final lossy adjustment."""
+    done, lossy, wovf = bool(carry[5]), bool(carry[6]), bool(carry[7])
+    lossy = lossy or (not done and bool(np.any(carry[4])))
+    return (done, lossy, wovf, int(carry[9]), int(carry[8]),
+            (carry[10], carry[11], carry[12]))
+
+
+def _segment_config(segment_iters: Optional[int]) -> Optional[int]:
+    """Resolve the segmentation knob: an explicit argument wins (0 =
+    disabled), then JTPU_SEGMENT_ITERS, then the module default. Returns
+    None when the monolithic while_loop should run instead."""
+    if segment_iters is not None:
+        return int(segment_iters) or None
+    env = _os_environ_get("JTPU_SEGMENT_ITERS")
+    if env is not None and env.strip():
+        try:
+            return int(env) or None
+        except ValueError:
+            raise ValueError(
+                f"JTPU_SEGMENT_ITERS must be an integer, got {env!r}")
+    return DEFAULT_SEGMENT_ITERS
 
 
 @functools.lru_cache(maxsize=64)
@@ -956,7 +1066,9 @@ def _prep_single(p: PackedHistory,
 def check_packed_tpu(p: PackedHistory, kernel: KernelSpec,
                      capacity: Optional[int] = None,
                      window: Optional[int] = WINDOW,
-                     expand: Optional[int] = None) -> Dict[str, Any]:
+                     expand: Optional[int] = None,
+                     segment_iters: Optional[int] = None,
+                     deadline_s: Optional[float] = None) -> Dict[str, Any]:
     """Check one packed single-key history on the default JAX backend.
 
     capacity=None auto-escalates through _ladder_for's rungs
@@ -964,9 +1076,22 @@ def check_packed_tpu(p: PackedHistory, kernel: KernelSpec,
     multi-word windows), retrying on capacity overflow (and on window
     overflow while the window can still grow).
     With an explicit capacity, ``expand`` < capacity selects best-first
-    search (None = exhaustive level-synchronous BFS)."""
+    search (None = exhaustive level-synchronous BFS).
+
+    By default the search runs SEGMENTED under the resilience supervisor
+    (jepsen_tpu.resilience): bounded device segments with host
+    checkpoints between them, OOM shrink-and-retry, and an optional
+    per-segment wedge watchdog (``deadline_s``, falling back to the CPU
+    backend mid-run). ``segment_iters`` overrides JTPU_SEGMENT_ITERS;
+    0 forces the monolithic single-while_loop path."""
     if window is not None:
         _check_window(window)
+    seg = _segment_config(segment_iters)
+    if seg:
+        from jepsen_tpu import resilience
+        return resilience.supervised_check_packed(
+            p, kernel, capacity=capacity, window=window, expand=expand,
+            segment_iters=seg, deadline_s=deadline_s)
     cols, early = _prep_single(p, kernel)
     if early is not None:
         return early
@@ -1105,16 +1230,29 @@ def warm_ladder(p: PackedHistory, kernel: KernelSpec,
     cols["nr"] = np.int32(0)
     full = _ladder_for(_window_needed(p))
     ladder = full[:rungs] if rungs else full
+    seg = _segment_config(None)
     for cap, win, exp in ladder:
-        fn = _jit_single(_kernel_key(kernel), cap, win, exp,
-                         _unroll_factor())
-        jax.block_until_ready(fn(*(cols[c] for c in _COLS)))
+        if seg:
+            # warm the checkpointed-segment executable — the path a
+            # default (segmented) check actually runs
+            fn = _jit_segment(_kernel_key(kernel), cap, win, exp,
+                              _unroll_factor())
+            carry = _carry0_host(cap, win, cols["cf"].shape[0],
+                                 cols["ini"], 0)
+            jax.block_until_ready(
+                fn(*(cols[c] for c in _COLS), np.int32(seg), carry))
+        else:
+            fn = _jit_single(_kernel_key(kernel), cap, win, exp,
+                             _unroll_factor())
+            jax.block_until_ready(fn(*(cols[c] for c in _COLS)))
 
 
 def check_history_tpu(history: History, model: Model,
                       capacity: Optional[int] = None,
                       window: Optional[int] = WINDOW,
-                      expand: Optional[int] = None
+                      expand: Optional[int] = None,
+                      segment_iters: Optional[int] = None,
+                      deadline_s: Optional[float] = None
                       ) -> Optional[Dict[str, Any]]:
     """Entry point used by LinearizableChecker(backend='tpu').
 
@@ -1130,7 +1268,9 @@ def check_history_tpu(history: History, model: Model,
     if pk is None:
         return None
     packed, kernel = pk
-    return check_packed_tpu(packed, kernel, capacity, window, expand)
+    return check_packed_tpu(packed, kernel, capacity, window, expand,
+                            segment_iters=segment_iters,
+                            deadline_s=deadline_s)
 
 
 def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,

@@ -77,6 +77,16 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
                    help="test phase duration in seconds")
     p.add_argument("--backend", default="cpu", choices=["cpu", "tpu"],
                    help="checker backend (tpu = batched device search)")
+    p.add_argument("--op-timeout", type=float, default=None,
+                   metavar="SECONDS",
+                   help="bound each client op: a hung invoke becomes an "
+                        ":info op and the process reincarnates, so one "
+                        "stuck connection cannot stall the run")
+    p.add_argument("--segment-iters", type=int, default=None,
+                   metavar="N",
+                   help="device-search iterations per checkpointed "
+                        "segment (resilient execution; 0 = one "
+                        "monolithic device call)")
 
 
 def parse_concurrency(c: str, n_nodes: int) -> int:
@@ -119,7 +129,20 @@ def test_opt_fn(opts: Dict[str, Any]) -> Dict[str, Any]:
                                             len(nodes))
     opts["time-limit"] = opts.pop("time_limit", 60)
     opts["test-count"] = opts.pop("test_count", 1)
+    opts["op-timeout"] = opts.pop("op_timeout", None)
+    opts["segment-iters"] = _apply_segment_iters(
+        opts.pop("segment_iters", None))
     return opts
+
+
+def _apply_segment_iters(seg):
+    """Deploy --segment-iters: the device checkers read the segmentation
+    knob from JTPU_SEGMENT_ITERS (like the other JTPU_* tuning knobs), so
+    the flag exports it for every check this process runs."""
+    if seg is not None:
+        import os
+        os.environ["JTPU_SEGMENT_ITERS"] = str(seg)
+    return seg
 
 
 def single_test_cmd(test_fn: Callable[[dict], dict],
@@ -229,10 +252,16 @@ def analyze_cmd() -> dict:
         p.add_argument("--algorithm", default="auto",
                        choices=["auto", "wgl", "linear", "native",
                                 "competition"])
+        p.add_argument("--segment-iters", type=int, default=None,
+                       metavar="N",
+                       help="device-search iterations per checkpointed "
+                            "segment (0 = monolithic)")
         return p
 
     def run_(opts) -> int:
         import json as _json
+
+        _apply_segment_iters(opts.pop("segment_iters", None))
 
         from jepsen_tpu import repl, store
         from jepsen_tpu.checker.wgl import linearizable

@@ -37,10 +37,37 @@ from jepsen_tpu import db as db_ns
 from jepsen_tpu import generator as gen
 from jepsen_tpu.checker import check_safe
 from jepsen_tpu.history import History, INFO, NEMESIS, Op
-from jepsen_tpu.util import (real_pmap, relative_time_nanos,
+from jepsen_tpu.util import (real_pmap, relative_time_nanos, timeout,
                              with_relative_time)
 
 log = logging.getLogger("jepsen")
+
+
+class OpTimeout(Exception):
+    """A client op exceeded the test's ``op-timeout`` budget. Raised by
+    :func:`with_op_timeout` so the worker's indeterminate-op path handles
+    it like any other client crash: record ``info``, reincarnate."""
+
+
+_OP_TIMED_OUT = object()  # sentinel: distinguishable from any completion
+
+
+def with_op_timeout(seconds: float, f, *args):
+    """Bound a client operation (reference jepsen.util:275-286 ``timeout``,
+    which client code wraps around invocations; here the worker applies it
+    uniformly when the test sets ``op-timeout``).
+
+    Runs ``f`` in a worker thread; if it does not return within
+    ``seconds``, raises :class:`OpTimeout`. Like the reference's
+    future-cancel, the hung thread is abandoned (daemon), not killed —
+    the caller must treat the op as indeterminate, which is exactly what
+    the worker's info/reincarnation path does: one stuck connection can
+    no longer stall a whole run."""
+    out = timeout(seconds * 1000.0, _OP_TIMED_OUT, f, *args)
+    if out is _OP_TIMED_OUT:
+        raise OpTimeout(f"operation exceeded the {seconds}s op-timeout; "
+                        f"treating it as indeterminate")
+    return out
 
 
 def synchronize(test: dict) -> None:
@@ -124,8 +151,13 @@ class Worker:
         (core.clj:143-217). Returns the client to use next (a fresh one if
         the process crashed)."""
         test = self.test
+        op_timeout = test.get("op-timeout")
         try:
-            completion = client.invoke(test, op)
+            if op_timeout:
+                completion = with_op_timeout(op_timeout, client.invoke,
+                                             test, op)
+            else:
+                completion = client.invoke(test, op)
             if (completion is None
                     or completion.type not in ("ok", "fail", "info")
                     or completion.f != op.f
@@ -146,9 +178,13 @@ class Worker:
             log.warning("Process %s crashed in %s: %s", self.process,
                         op.f, e)
         # info path: abandon this process, reincarnate as p + concurrency
-        # with a fresh client (core.clj:174-217)
+        # with a fresh client (core.clj:174-217). A hung connection's
+        # close can hang too — bound it like the op itself.
         try:
-            client.close(test)
+            if op_timeout:
+                with_op_timeout(op_timeout, client.close, test)
+            else:
+                client.close(test)
         except Exception:  # noqa: BLE001
             pass
         self.process += test["concurrency"]
@@ -218,13 +254,38 @@ def run_case(test: dict) -> History:
             if w.error is not None:
                 raise w.error
     finally:
+        # This block is the run's safety net: it executes whether the
+        # main phase finished cleanly or a worker raised above, so
+        # nemesis teardown AND network healing always run — a crashed
+        # worker must not leave the cluster partitioned.
         stop.set()
-        nemesis_thread.join(timeout=test.get("nemesis-join-timeout", 30))
+        join_s = test.get("nemesis-join-timeout", 30)
+        nemesis_thread.join(timeout=join_s)
+        if nemesis_thread.is_alive():
+            # The nemesis missed its join deadline: it is wedged inside
+            # an invocation. Abandon the (daemon) thread but make the
+            # leak VISIBLE — loudly in the log and as an info op in the
+            # history, so checkers and humans can see the fault window
+            # never formally closed.
+            log.error(
+                "Nemesis thread missed its %ss join deadline; recording "
+                ":nemesis-wedged and abandoning the thread", join_s)
+            conj_op(test, Op(type=INFO, f="nemesis-wedged", value=None,
+                             process=NEMESIS, time=relative_time_nanos(),
+                             error=f"nemesis thread still running after "
+                                   f"the {join_s}s join timeout"))
         if nemesis_obj is not None:
             try:
                 nemesis_obj.teardown(test)
             except Exception:  # noqa: BLE001
                 log.warning("Nemesis teardown failed: %s",
+                            traceback.format_exc())
+        net = test.get("net")
+        if net is not None:
+            try:
+                net.heal(test)
+            except Exception:  # noqa: BLE001
+                log.warning("net.heal failed during teardown: %s",
                             traceback.format_exc())
         test["_active_histories"].remove(history)
     return history

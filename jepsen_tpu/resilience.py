@@ -1,0 +1,545 @@
+"""Resilient execution for long-running device work.
+
+The device linearizability search is a long-lived accelerator workload,
+and accelerators fail in ways the host code must survive: a wedged XLA
+execution that never returns, a ``RESOURCE_EXHAUSTED`` on a pool sized
+for a bigger chip, a preempted TPU VM that kills the process mid-search.
+:mod:`jepsen_tpu.accel` guards *initialization*; this module guards
+*execution* — the whole run.
+
+Four pieces (doc/resilience.md has the operator view):
+
+* **Checkpointed segments** — the single-history pool search runs as an
+  outer host loop of bounded-iteration device segments
+  (:func:`jepsen_tpu.checker.tpu._jit_segment`); the search carry is
+  snapshotted to host numpy after every segment. The snapshot IS the
+  checkpoint: a crashed, preempted or wedged search resumes from it
+  instead of restarting. P-compositionality (Horn & Kroening,
+  1504.00204) is what makes this sound: the search state is a closed
+  configuration set, so cutting the iteration stream anywhere and
+  resuming it elsewhere changes nothing about the verdict.
+* **Wedge watchdog** — each segment optionally runs under a deadline
+  (``deadline_s`` / JTPU_SEGMENT_DEADLINE_S). A segment that overruns is
+  abandoned (the reference's util.clj:275-286 ``timeout`` semantics: the
+  thread is orphaned, not killed) and the saved checkpoint is re-routed
+  to the CPU fallback device with a visible warning — extending
+  accel.py's init-only guarantee to mid-run wedges.
+* **Structured retry policy** — failures are classified (:data:`OOM` /
+  :data:`WEDGE` / :data:`TRANSIENT` / :data:`FATAL`) and answered per
+  class: OOM halves the pool (re-embedding the checkpoint, marking the
+  search lossy if live rows fell off) under capped exponential backoff;
+  transients retry with jitter; wedges escalate to the fallback backend;
+  fatals rethrow. Every decision lands in the result's ``attempts``
+  trail, so store.py/web.py show *how* a verdict was reached.
+* **Bounded client ops** — :func:`jepsen_tpu.core.with_op_timeout` uses
+  the same taxonomy on the orchestrator side: a hung ``client.invoke``
+  becomes an ``info`` op and the process reincarnates.
+
+The fault-injection seam (:data:`_inject_fault`) lets tests and
+``tools/chaos_matrix.py`` drive every branch without a sick device.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from jepsen_tpu import accel
+from jepsen_tpu.checker import UNKNOWN
+from jepsen_tpu.checker import tpu as T
+from jepsen_tpu.models.core import KernelSpec, Model
+from jepsen_tpu.ops.encode import PackedHistory, pack_with_init
+
+log = logging.getLogger("jepsen.resilience")
+
+# ---------------------------------------------------------------------------
+# Failure taxonomy
+# ---------------------------------------------------------------------------
+
+#: Pool/device memory exhaustion: shrink the pool and retry from the
+#: checkpoint (soundness note: a truncated pool can still prove validity;
+#: it only forfeits exhaustive refutation, which the lossy flag records).
+OOM = "oom"
+#: A device call that never returned within its deadline: escalate the
+#: checkpoint to the fallback backend.
+WEDGE = "wedge"
+#: Plausibly-recoverable runtime errors (preemption, RPC resets,
+#: UNAVAILABLE): retry the same segment with jittered backoff.
+TRANSIENT = "transient"
+#: Everything else — a programming error or corrupted state: rethrow.
+FATAL = "fatal"
+
+
+class WedgeError(Exception):
+    """A supervised call exceeded its deadline (the watchdog fired)."""
+
+
+#: Substrings marking an out-of-memory failure in XLA/driver error text.
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "RESOURCE EXHAUSTED", "Out of memory",
+                "out of memory", "OOM", "failed to allocate")
+
+#: Substrings marking transient runtime faults worth a same-shape retry.
+_TRANSIENT_MARKERS = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED",
+                      "CANCELLED", "preempt", "Connection reset",
+                      "Socket closed", "temporarily unavailable")
+
+
+def classify_failure(exc: BaseException) -> str:
+    """Map an exception to its failure class (OOM/WEDGE/TRANSIENT/FATAL).
+
+    Works on error *text* as well as types: the jax runtime surfaces
+    device faults as XlaRuntimeError with a status-code prefix, and this
+    module must not import jax internals to pattern-match them."""
+    if isinstance(exc, WedgeError):
+        return WEDGE
+    if isinstance(exc, MemoryError):
+        return OOM
+    text = f"{type(exc).__name__}: {exc}"
+    if any(m in text for m in _OOM_MARKERS):
+        return OOM
+    if isinstance(exc, (ConnectionError, TimeoutError, InterruptedError)):
+        return TRANSIENT
+    if any(m in text for m in _TRANSIENT_MARKERS):
+        return TRANSIENT
+    return FATAL
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    if not v:
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        return default
+
+
+@dataclass
+class RetryPolicy:
+    """Per-class retry behavior. Backoff is capped exponential:
+    ``min(cap, base * 2**(attempt-1))``, jittered to [50%, 100%] so
+    synchronized workers don't stampede a recovering endpoint.
+    Base/cap default from JEPSEN_RETRY_BASE / JEPSEN_RETRY_CAP."""
+
+    max_retries: int = 3
+    backoff_base_s: float = field(
+        default_factory=lambda: _env_float("JEPSEN_RETRY_BASE", 0.05))
+    backoff_cap_s: float = field(
+        default_factory=lambda: _env_float("JEPSEN_RETRY_CAP", 10.0))
+    jitter: bool = True
+    #: OOM shrink floor: a pool this small that still OOMs is hopeless.
+    min_capacity: int = 8
+    rng: random.Random = field(default_factory=random.Random, repr=False)
+
+    def delay(self, attempt: int) -> float:
+        d = min(self.backoff_cap_s,
+                self.backoff_base_s * (2 ** max(attempt - 1, 0)))
+        if self.jitter:
+            d *= 0.5 + self.rng.random() / 2
+        return d
+
+
+def deadline_stop(deadline_s: float,
+                  inner: Optional[Callable[[], bool]] = None
+                  ) -> Callable[[], bool]:
+    """A ``should_stop`` predicate that fires ``deadline_s`` seconds from
+    now (and whenever ``inner`` fires) — bounds the host-side search
+    algorithms (wgl/jitlin) the same way the watchdog bounds device
+    segments."""
+    t_end = time.monotonic() + deadline_s
+
+    def stop() -> bool:
+        if inner is not None and inner():
+            return True
+        return time.monotonic() > t_end
+
+    return stop
+
+
+# ---------------------------------------------------------------------------
+# Checkpoints
+# ---------------------------------------------------------------------------
+
+#: Field names of the search carry, in _search_fn's carry order — the
+#: checkpoint format (doc/resilience.md documents each slot).
+CARRY_FIELDS = ("k", "mask", "cmask", "state", "alive", "done", "lossy",
+                "wovf", "level", "best", "pool_k", "pool_state",
+                "pool_alive")
+
+
+@dataclass
+class Checkpoint:
+    """A host snapshot of the device search, sufficient to resume it on
+    any backend. ``rung`` is the REQUESTED ladder rung; ``expand_eff``
+    and the carry's own row count give the effective (possibly
+    OOM-shrunk) shape. Serializes to one ``.npz`` file."""
+
+    carry: tuple
+    rung: tuple                      # (capacity, window, expand) requested
+    window: int
+    expand_eff: Optional[int]
+    crash_width: int
+    segment: int                     # segments completed so far
+
+    @property
+    def capacity_eff(self) -> int:
+        return int(self.carry[0].shape[0])
+
+    @property
+    def level(self) -> int:
+        return int(self.carry[8])
+
+    def save(self, path: str) -> None:
+        meta = dict(
+            rung=np.asarray([-1 if x is None else x for x in self.rung],
+                            np.int64),
+            window=np.int64(self.window),
+            expand_eff=np.int64(-1 if self.expand_eff is None
+                                else self.expand_eff),
+            crash_width=np.int64(self.crash_width),
+            segment=np.int64(self.segment))
+        arrays = {f"carry_{n}": np.asarray(v)
+                  for n, v in zip(CARRY_FIELDS, self.carry)}
+        np.savez(path, **meta, **arrays)
+
+    @classmethod
+    def load(cls, path: str) -> "Checkpoint":
+        with np.load(path) as z:
+            rung = tuple(None if int(x) < 0 else int(x)
+                         for x in z["rung"])
+            exp = int(z["expand_eff"])
+            carry = tuple(z[f"carry_{n}"] for n in CARRY_FIELDS)
+            # scalars round-trip as 0-d arrays; normalize the flag/count
+            # slots back to numpy scalars so jit sees identical avals
+            carry = (carry[:5]
+                     + (np.bool_(carry[5]), np.bool_(carry[6]),
+                        np.bool_(carry[7]), np.int32(carry[8]),
+                        np.int32(carry[9]))
+                     + carry[10:])
+            return cls(carry=carry, rung=rung, window=int(z["window"]),
+                       expand_eff=None if exp < 0 else exp,
+                       crash_width=int(z["crash_width"]),
+                       segment=int(z["segment"]))
+
+
+def _shrink_carry(carry: tuple, new_cap: int) -> tuple:
+    """Re-embed a checkpoint into a half-size pool: keep the first
+    ``new_cap`` rows (the pool is sorted deepest-first, so the prefix is
+    the best frontier). Returns (carry, dropped): if any LIVE row fell
+    off, the search is lossy from here on — a completion is still a
+    witness, but pool death no longer refutes."""
+    (k, mask, cmask, state, alive, done, lossy, wovf, level, best,
+     pk, ps, pa) = carry
+    dropped = bool(np.any(np.asarray(alive)[new_cap:]))
+    lossy = np.bool_(bool(lossy) or dropped)
+    return ((np.asarray(k)[:new_cap], np.asarray(mask)[:new_cap],
+             np.asarray(cmask)[:new_cap], np.asarray(state)[:new_cap],
+             np.asarray(alive)[:new_cap], done, lossy, wovf, level, best,
+             np.asarray(pk)[:new_cap], np.asarray(ps)[:new_cap],
+             np.asarray(pa)[:new_cap]), dropped)
+
+
+# ---------------------------------------------------------------------------
+# Segment execution + watchdog
+# ---------------------------------------------------------------------------
+
+#: Fault-injection seam for tests and tools/chaos_matrix.py: a callable
+#: invoked with a context dict ({rung, effective, segment, level,
+#: backend}) right before each device segment; raising from it simulates
+#: that failure at that point. None in production.
+_inject_fault: Optional[Callable[[Dict[str, Any]], None]] = None
+
+
+def _call_segment(fn, cols: dict, carry: tuple, seg_iters: int,
+                  device=None, deadline_s: Optional[float] = None) -> tuple:
+    """Run one device segment and snapshot its carry to host numpy (the
+    checkpoint). With a deadline, the call runs in a worker thread under
+    the watchdog: the supervisor joins with the deadline and raises
+    :class:`WedgeError` if the device never came back — the worker (and
+    whatever the plugin wedged) is abandoned as a daemon, exactly like
+    accel's init probe but for mid-run execution."""
+
+    def invoke() -> tuple:
+        args = [cols[c] for c in T._COLS]
+        if device is not None:
+            import jax
+            with jax.default_device(device):
+                out = fn(*args, np.int32(seg_iters), carry)
+                return tuple(np.asarray(x) for x in out)
+        out = fn(*args, np.int32(seg_iters), carry)
+        return tuple(np.asarray(x) for x in out)
+
+    if deadline_s is None:
+        return invoke()
+    box: Dict[str, Any] = {}
+
+    def target():
+        try:
+            box["ok"] = invoke()
+        except BaseException as e:  # noqa: BLE001 — relayed to supervisor
+            box["err"] = e
+
+    t = threading.Thread(target=target, daemon=True,
+                         name="jepsen-device-segment")
+    t.start()
+    t.join(deadline_s)
+    if t.is_alive():
+        raise WedgeError(
+            f"device segment exceeded its {deadline_s:.1f}s deadline")
+    if "err" in box:
+        raise box["err"]
+    return box["ok"]
+
+
+def _errstr(e: BaseException) -> str:
+    return f"{type(e).__name__}: {e}"
+
+
+# ---------------------------------------------------------------------------
+# The supervised checker
+# ---------------------------------------------------------------------------
+
+
+def supervised_check_packed(p: PackedHistory, kernel: KernelSpec,
+                            capacity: Optional[int] = None,
+                            window: Optional[int] = None,
+                            expand: Optional[int] = None,
+                            segment_iters: Optional[int] = None,
+                            deadline_s: Optional[float] = None,
+                            policy: Optional[RetryPolicy] = None,
+                            resume: Optional[Checkpoint] = None,
+                            checkpoint_path: Optional[str] = None,
+                            on_checkpoint: Optional[
+                                Callable[[Checkpoint], None]] = None
+                            ) -> Dict[str, Any]:
+    """Checkpointed, supervised single-history device search.
+
+    Semantics match :func:`jepsen_tpu.checker.tpu.check_packed_tpu`
+    (identical verdicts and level counts — the device body is the same;
+    only the while_loop is cut into host-checkpointed segments), plus:
+
+    * ``deadline_s`` — per-segment wedge watchdog; a wedged segment's
+      checkpoint continues on the CPU fallback device.
+    * OOM halves the pool and resumes the checkpoint in the smaller
+      shape; transients retry with jittered backoff; fatals rethrow
+      (with the trail attached as ``exc.resilience_trail``).
+    * ``resume`` — continue a saved :class:`Checkpoint` (same packed
+      history) instead of starting over; ``checkpoint_path`` /
+      ``on_checkpoint`` persist/observe checkpoints after each segment.
+    * The result carries ``attempts`` (the supervision trail),
+      ``segments``, and ``segment-iters`` alongside the usual keys.
+    """
+    if window is not None:
+        T._check_window(window)
+    seg = segment_iters or T._segment_config(None) or T.DEFAULT_SEGMENT_ITERS
+    cols, early = T._prep_single(p, kernel)
+    if early is not None:
+        return early
+    accel.ensure_usable("supervised_check_packed")
+    if deadline_s is None:
+        deadline_s = _env_float("JTPU_SEGMENT_DEADLINE_S", 0.0) or None
+    policy = policy or RetryPolicy()
+    if capacity is not None:
+        T._check_window(window or T.WINDOW)
+        ladder = ((capacity, window or T.WINDOW, expand),)
+    else:
+        ladder = T._ladder_for(T._window_needed(p))
+    crw = T._crash_width(p.n - p.n_required) or 0
+    cr_pad = cols["cf"].shape[0]
+    lmax = T._level_budget(cols["f"].shape[0], cr_pad)
+    # A prior mid-run wedge in this process routes new work straight to
+    # the CPU fallback — the run-time extension of accel's init verdict.
+    fallback = accel.cpu_device() if accel.runtime_wedged() else None
+    trail: list = []
+    work: list = []
+    out: Dict[str, Any] = {}
+    if resume is not None:
+        idx = next((i for i, r in enumerate(ladder)
+                    if tuple(r) == tuple(resume.rung)), None)
+        if idx is None:
+            ladder = (tuple(resume.rung),) + tuple(ladder)
+        else:
+            ladder = ladder[idx:]
+    for cap, win, exp in ladder:
+        if resume is not None and tuple(resume.rung) == (cap, win, exp):
+            carry = tuple(np.asarray(x) if isinstance(x, np.ndarray) else x
+                          for x in resume.carry)
+            cap_eff = resume.capacity_eff
+            exp_eff = resume.expand_eff
+            seg_idx = resume.segment
+            resume = None
+        else:
+            carry = T._carry0_host(cap, win, cr_pad, cols["ini"],
+                                   int(cols["nr"]))
+            cap_eff, exp_eff, seg_idx = cap, exp, 0
+        transients = ooms = 0
+        abort: Optional[str] = None
+        while T._carry_active(carry, lmax):
+            fn = T._jit_segment(T._kernel_key(kernel), cap_eff, win,
+                                exp_eff, T._unroll_factor())
+            ctx = {"rung": (cap, win, exp),
+                   "effective": (cap_eff, win, exp_eff),
+                   "segment": seg_idx, "level": int(carry[8]),
+                   "backend": ("cpu-fallback" if fallback is not None
+                               else "default")}
+            try:
+                if _inject_fault is not None:
+                    _inject_fault(dict(ctx))
+                # The watchdog guards the AMBIENT device only: host
+                # (fallback) execution is trusted the same way accel
+                # trusts CPU init — and its first segment legitimately
+                # spends deadline-sized time compiling.
+                carry = _call_segment(fn, cols, carry, seg,
+                                      device=fallback,
+                                      deadline_s=(None if fallback
+                                                  is not None
+                                                  else deadline_s))
+            except WedgeError as e:
+                if fallback is not None:
+                    trail.append({**ctx, "event": WEDGE,
+                                  "outcome": "gave-up",
+                                  "error": _errstr(e)})
+                    abort = ("segment wedged on the CPU fallback too: "
+                             f"{e}")
+                    break
+                dev = accel.cpu_device()
+                accel.note_runtime_wedge(
+                    "supervised_check_packed",
+                    deadline_s or 0.0, level=int(carry[8]))
+                if dev is None:
+                    trail.append({**ctx, "event": WEDGE,
+                                  "outcome": "gave-up",
+                                  "error": "no CPU fallback device"})
+                    abort = ("segment wedged and no CPU fallback device "
+                             f"is available: {e}")
+                    break
+                trail.append({**ctx, "event": WEDGE,
+                              "outcome": "cpu-fallback",
+                              "error": _errstr(e)})
+                log.warning(
+                    "device segment wedged at level %s; resuming the "
+                    "checkpoint on the CPU fallback", int(carry[8]))
+                fallback = dev
+            except Exception as e:  # noqa: BLE001 — classified below
+                cls = classify_failure(e)
+                if cls == OOM:
+                    ooms += 1
+                    new_cap = cap_eff // 2
+                    if new_cap < policy.min_capacity:
+                        trail.append({**ctx, "event": OOM,
+                                      "outcome": "gave-up",
+                                      "error": _errstr(e)})
+                        abort = (f"OOM at the {policy.min_capacity}-row "
+                                 f"pool floor: {e}")
+                        break
+                    carry, dropped = _shrink_carry(carry, new_cap)
+                    cap_eff = new_cap
+                    if isinstance(exp_eff, int):
+                        exp_eff = max(1, min(exp_eff // 2, cap_eff))
+                    delay = policy.delay(ooms)
+                    trail.append({**ctx, "event": OOM,
+                                  "outcome": f"pool-halved-to-{cap_eff}",
+                                  "lossy": dropped,
+                                  "backoff-s": round(delay, 3),
+                                  "error": _errstr(e)})
+                    log.warning(
+                        "device OOM at level %s; halving the pool to %s "
+                        "rows and resuming the checkpoint (backoff "
+                        "%.2fs)", int(carry[8]), cap_eff, delay)
+                    time.sleep(delay)
+                elif cls == TRANSIENT:
+                    transients += 1
+                    if transients > policy.max_retries:
+                        trail.append({**ctx, "event": TRANSIENT,
+                                      "outcome": "retries-exhausted",
+                                      "error": _errstr(e)})
+                        try:
+                            e.resilience_trail = trail
+                        except Exception:  # noqa: BLE001
+                            pass
+                        raise
+                    delay = policy.delay(transients)
+                    trail.append({**ctx, "event": TRANSIENT,
+                                  "outcome": f"retry-{transients}",
+                                  "backoff-s": round(delay, 3),
+                                  "error": _errstr(e)})
+                    log.warning(
+                        "transient device failure (%s); retrying the "
+                        "segment from its checkpoint in %.2fs",
+                        _errstr(e), delay)
+                    time.sleep(delay)
+                else:
+                    trail.append({**ctx, "event": FATAL,
+                                  "outcome": "raised",
+                                  "error": _errstr(e)})
+                    try:
+                        e.resilience_trail = trail
+                    except Exception:  # noqa: BLE001
+                        pass
+                    raise
+            else:
+                seg_idx += 1
+                transients = 0
+                if checkpoint_path or on_checkpoint is not None:
+                    cp = Checkpoint(carry=carry, rung=(cap, win, exp),
+                                    window=win, expand_eff=exp_eff,
+                                    crash_width=crw, segment=seg_idx)
+                    if checkpoint_path:
+                        cp.save(checkpoint_path)
+                    if on_checkpoint is not None:
+                        on_checkpoint(cp)
+        done, lossy, wovf, best, levels, pool = T._summarize_carry(carry)
+        rung_eff = (cap_eff, win, exp_eff)
+        trail.append({"rung": (cap, win, exp), "effective": rung_eff,
+                      "event": ("rung-aborted" if abort is not None
+                                else "rung-complete"),
+                      "segments": seg_idx, "levels": levels,
+                      "backend": ("cpu-fallback" if fallback is not None
+                                  else "default")})
+        if abort is not None:
+            out = {"valid": UNKNOWN, "backend": "tpu", "levels": levels,
+                   "error": abort}
+        else:
+            out = T._result(done, lossy, wovf, best, levels, p, pool=pool)
+        out["rung"] = rung_eff
+        if rung_eff != (cap, win, exp):
+            out["rung-requested"] = (cap, win, exp)
+        out["crash-width"] = crw
+        out["tiebreak"] = "lex"
+        work.append((rung_eff, crw, "lex", levels))
+        out["work"] = list(work)
+        out["segments"] = seg_idx
+        out["segment-iters"] = seg
+        out["attempts"] = list(trail)
+        if fallback is not None:
+            out["backend-fallback"] = "cpu"
+        if out["valid"] is not UNKNOWN:
+            return out
+        if abort is not None:
+            # OOM floor / exhausted fallback: a bigger rung would only
+            # fail harder, so escalation stops here
+            return out
+        if bool(wovf) and win >= T.MAX_WINDOW and not bool(lossy):
+            return out  # a bigger frontier won't fix a window overflow
+    return out
+
+
+def supervised_check_history(history, model: Model,
+                             **kwargs) -> Optional[Dict[str, Any]]:
+    """Pack + supervised check (see supervised_check_packed). None when
+    the model has no integer kernel."""
+    try:
+        pk = pack_with_init(history, model)
+    except ValueError:
+        return None
+    if pk is None:
+        return None
+    packed, kernel = pk
+    return supervised_check_packed(packed, kernel, **kwargs)

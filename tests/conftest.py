@@ -27,6 +27,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: full-scale (10k-op) checker runs; deselect with "
         "-m 'not slow'")
+    config.addinivalue_line(
+        "markers", "chaos: injected-fault resilience scenarios (OOM, "
+        "wedge, kill-mid-segment, hung client); tools/chaos_matrix.py "
+        "sweeps the grid standalone with -m chaos")
 
 
 def pytest_collection_modifyitems(config, items):

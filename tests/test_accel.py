@@ -84,6 +84,58 @@ def test_cpu_config_skips_probe(monkeypatch):
     assert accel.probe_default_backend() == "cpu"
 
 
+def test_probe_timeout_env_honored_at_call_time(monkeypatch):
+    # JEPSEN_ACCEL_PROBE_TIMEOUT is read per call, not at import: an
+    # orchestrator that sets it after jepsen_tpu imports still bounds the
+    # probe. With a hanging probe child and a ~1s env cap, ensure_usable
+    # must degrade in about that long instead of the 300s default.
+    import time
+
+    monkeypatch.setattr(accel, "_PROBE_CODE", HANGING_PROBE)
+    monkeypatch.setenv("JEPSEN_ACCEL_PROBE_TIMEOUT", "1.0")
+    t0 = time.time()
+    with pytest.warns(RuntimeWarning, match="degrading to the CPU"):
+        plat = accel.ensure_usable("test")  # no explicit timeout arg
+    assert plat == "cpu"
+    assert time.time() - t0 < 30.0
+
+
+def test_probe_timeout_env_malformed_falls_back(monkeypatch):
+    monkeypatch.setenv("JEPSEN_ACCEL_PROBE_TIMEOUT", "soon")
+    monkeypatch.setattr(accel, "PROBE_TIMEOUT_S", 123.0)
+    assert accel._probe_timeout() == 123.0
+
+
+def test_trusted_env_ensure_usable_no_probe_no_warning(monkeypatch):
+    # the JEPSEN_ACCEL_OK=1 pre-seed path through ensure_usable: no probe
+    # child is spawned, no degradation warning fires, and the caller gets
+    # the configured platform back
+    monkeypatch.setenv("JEPSEN_ACCEL_OK", "1")
+
+    def boom(timeout):
+        raise AssertionError("probe must not spawn")
+
+    monkeypatch.setattr(accel, "_spawn_probe", boom)
+    monkeypatch.setattr(accel, "_configured_platforms", lambda: "axon,cpu")
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert accel.ensure_usable("test") == "axon"
+        # cached: a second call is equally silent
+        assert accel.ensure_usable("test") == "axon"
+
+
+def test_runtime_wedge_is_sticky_and_warns_once():
+    assert not accel.runtime_wedged()
+    with pytest.warns(RuntimeWarning, match="execution wedged"):
+        assert accel.note_runtime_wedge("test", 2.5, level=7)
+    assert accel.runtime_wedged()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert not accel.note_runtime_wedge("test", 2.5)  # silent repeat
+    # the init verdict is untouched by a run-time wedge
+    assert "platform" not in accel._state
+
+
 def test_trusted_env_skips_probe(monkeypatch):
     monkeypatch.setenv("JEPSEN_ACCEL_OK", "1")
 

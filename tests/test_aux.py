@@ -80,6 +80,62 @@ class TestReconnect:
         assert len(FlakyConn.instances) == 2
         assert w.conn is not c1
 
+    def test_consecutive_failures_counted_and_reset(self):
+        w = self.wrapper()
+        for n in (1, 2, 3):
+            with pytest.raises(RuntimeError):
+                with w.with_conn():
+                    raise RuntimeError("down")
+            assert w.failures == n
+        # a successful use resets the streak
+        with w.with_conn():
+            pass
+        assert w.failures == 0
+
+    def test_failures_surface_in_repr(self):
+        w = self.wrapper()
+        assert "failures=0" in repr(w)
+        assert "closed" in repr(w)
+        w.open()
+        assert "open" in repr(w)
+        with pytest.raises(RuntimeError):
+            with w.with_conn():
+                raise RuntimeError("down")
+        assert "failures=1" in repr(w)
+
+    def test_backoff_caps_exponentially_with_jitter(self):
+        w = reconnect.wrapper(open=FlakyConn, close=lambda c: None,
+                              name="backoff", backoff_base_s=0.1,
+                              backoff_cap_s=0.4)
+        assert w.backoff_s() == 0.0          # no failures: no delay
+        for n, full in ((1, 0.1), (2, 0.2), (3, 0.4), (9, 0.4)):
+            w.failures = n
+            for _ in range(8):
+                d = w.backoff_s()
+                assert full / 2 <= d <= full  # jittered in [50%, 100%]
+
+    def test_backoff_env_tunable(self, monkeypatch):
+        monkeypatch.setenv("JEPSEN_RECONNECT_BASE", "0.25")
+        monkeypatch.setenv("JEPSEN_RECONNECT_CAP", "0.75")
+        w = reconnect.wrapper(open=FlakyConn, close=lambda c: None)
+        assert w._backoff_base == pytest.approx(0.25)
+        assert w._backoff_cap == pytest.approx(0.75)
+
+    def test_reopen_on_error_actually_backs_off(self):
+        import time
+        w = reconnect.wrapper(open=FlakyConn, close=lambda c: None,
+                              name="paced", backoff_base_s=0.05,
+                              backoff_cap_s=0.05)
+        w.open()
+        # second consecutive failure must wait ~backoff before reopening
+        for _ in range(2):
+            t0 = time.time()
+            with pytest.raises(RuntimeError):
+                with w.with_conn():
+                    raise RuntimeError("down")
+            dt = time.time() - t0
+        assert dt >= 0.025  # >= 50% jitter floor of the 0.05s backoff
+
     def test_close(self):
         w = self.wrapper()
         w.open()

@@ -31,6 +31,29 @@ class TestOptionProcessing:
         assert opts["concurrency"] == 5
         assert opts["ssh"]["username"] == "root"
 
+    def test_resilience_flags(self, monkeypatch):
+        monkeypatch.delenv("JTPU_SEGMENT_ITERS", raising=False)
+        p = cli.Parser(prog="t")
+        cli.add_test_opts(p)
+        ns = p.parse_args(["--op-timeout", "2.5", "--segment-iters",
+                           "256"])
+        opts = cli.test_opt_fn(vars(ns))
+        assert opts["op-timeout"] == 2.5
+        assert opts["segment-iters"] == 256
+        # the flag deploys the device-checker knob via env (like the
+        # other JTPU_* tuning knobs)
+        assert os.environ["JTPU_SEGMENT_ITERS"] == "256"
+        monkeypatch.delenv("JTPU_SEGMENT_ITERS", raising=False)
+
+    def test_resilience_flags_default_off(self, monkeypatch):
+        monkeypatch.delenv("JTPU_SEGMENT_ITERS", raising=False)
+        p = cli.Parser(prog="t")
+        cli.add_test_opts(p)
+        opts = cli.test_opt_fn(vars(p.parse_args([])))
+        assert opts["op-timeout"] is None
+        assert opts["segment-iters"] is None
+        assert "JTPU_SEGMENT_ITERS" not in os.environ
+
     def test_nodes_file(self, tmp_path):
         f = tmp_path / "nodes"
         f.write_text("h1\nh2\n\nh3\n")

@@ -1,0 +1,233 @@
+#!/usr/bin/env python
+"""Chaos matrix: sweep the injected-fault grid through the resilient
+execution layer and print a pass/fail matrix.
+
+Scenarios (the same grid tests/test_resilience.py covers under the
+``chaos`` pytest marker, here runnable standalone on any host — e.g. to
+qualify a new accelerator image before trusting it with long runs):
+
+  oom              RESOURCE_EXHAUSTED mid-search: pool halves (with
+                   backoff) and the verdict still matches the CPU oracle
+  wedge            a wedged device segment: the checkpoint completes on
+                   the CPU fallback instead of hanging
+  kill-mid-segment a fatal exception after N segments: the saved
+                   checkpoint resumes to the identical verdict
+  transient        flaky RPC errors: jittered retries, then success
+  hung-client      a client.invoke that never returns: op-timeout turns
+                   it into :info and the run completes
+
+Usage: python tools/chaos_matrix.py [--seed N]
+Exit code 0 iff every scenario passes.
+"""
+
+import argparse
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JEPSEN_RETRY_BASE", "0.001")
+
+from jepsen_tpu import accel, resilience  # noqa: E402
+from jepsen_tpu.checker.wgl import check_packed  # noqa: E402
+from jepsen_tpu.models import CASRegister  # noqa: E402
+from jepsen_tpu.ops.encode import pack_with_init  # noqa: E402
+from jepsen_tpu.resilience import (  # noqa: E402
+    RetryPolicy, WedgeError, supervised_check_packed)
+from jepsen_tpu.testing import simulate_register_history  # noqa: E402
+
+
+def _packed(seed):
+    h = simulate_register_history(150, n_procs=5, n_vals=4, seed=seed,
+                                  crash_p=0.02)
+    return pack_with_init(h, CASRegister())
+
+
+def _policy():
+    return RetryPolicy(backoff_base_s=0.001, backoff_cap_s=0.01)
+
+
+def scenario_oom(seed):
+    p, kernel = _packed(seed)
+    oracle = check_packed(p, kernel)["valid"]
+    fired = []
+
+    def oom_twice(ctx):
+        if ctx["segment"] == 1 and len(fired) < 2:
+            fired.append(1)
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+
+    resilience._inject_fault = oom_twice
+    try:
+        r = supervised_check_packed(p, kernel, capacity=256, expand=16,
+                                    segment_iters=8, policy=_policy())
+    finally:
+        resilience._inject_fault = None
+    ok = (r["valid"] == oracle and r["rung"][0] == 64
+          and len(fired) == 2)
+    return ok, (f"verdict {r['valid']} (oracle {oracle}), pool "
+                f"256->{r['rung'][0]}, {len(fired)} OOMs injected")
+
+
+def scenario_wedge(seed):
+    p, kernel = _packed(seed)
+    base = supervised_check_packed(p, kernel, capacity=128, expand=8,
+                                   segment_iters=8)
+    wedged = []
+
+    def wedge_once(ctx):
+        if ctx["segment"] == 2 and not wedged:
+            wedged.append(1)
+            raise WedgeError("injected wedge")
+
+    import warnings
+    resilience._inject_fault = wedge_once
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            r = supervised_check_packed(p, kernel, capacity=128, expand=8,
+                                        segment_iters=8)
+    finally:
+        resilience._inject_fault = None
+        accel._reset_for_tests()
+    ok = (r["valid"] == base["valid"] and r["levels"] == base["levels"]
+          and r.get("backend-fallback") == "cpu")
+    return ok, (f"verdict {r['valid']} == uninterrupted, completed on "
+                f"{r.get('backend-fallback')} fallback")
+
+
+def scenario_kill_mid_segment(seed):
+    p, kernel = _packed(seed)
+    base = supervised_check_packed(p, kernel, capacity=128, expand=8,
+                                   segment_iters=8)
+    cps = []
+
+    def killer(ctx):
+        if ctx["segment"] == 3:
+            raise ValueError("chaos kill")
+
+    resilience._inject_fault = killer
+    try:
+        try:
+            supervised_check_packed(
+                p, kernel, capacity=128, expand=8, segment_iters=8,
+                policy=RetryPolicy(max_retries=0, backoff_base_s=0.001),
+                on_checkpoint=cps.append)
+            return False, "kill never fired"
+        except ValueError:
+            pass
+    finally:
+        resilience._inject_fault = None
+    if not cps:
+        return False, "no checkpoints before the kill"
+    r = supervised_check_packed(p, kernel, capacity=128, expand=8,
+                                segment_iters=8, resume=cps[-1])
+    ok = (r["valid"] == base["valid"] and r["levels"] == base["levels"])
+    return ok, (f"resumed from segment {cps[-1].segment} -> verdict "
+                f"{r['valid']} levels {r['levels']} "
+                f"(uninterrupted {base['levels']})")
+
+
+def scenario_transient(seed):
+    p, kernel = _packed(seed)
+    base = supervised_check_packed(p, kernel, capacity=64, expand=8,
+                                   segment_iters=8)
+    flakes = []
+
+    def flaky(ctx):
+        if ctx["segment"] == 1 and len(flakes) < 2:
+            flakes.append(1)
+            raise ConnectionResetError("flaky rpc")
+
+    resilience._inject_fault = flaky
+    try:
+        r = supervised_check_packed(p, kernel, capacity=64, expand=8,
+                                    segment_iters=8, policy=_policy())
+    finally:
+        resilience._inject_fault = None
+    retries = [a for a in r["attempts"] if a.get("event") == "transient"]
+    ok = r["valid"] == base["valid"] and len(retries) == 2
+    return ok, f"verdict {r['valid']}, {len(retries)} jittered retries"
+
+
+def scenario_hung_client(seed):
+    from jepsen_tpu import core, generator as gen
+    from jepsen_tpu.testing import AtomClient, SharedRegister, atom_test
+
+    lock = threading.Lock()
+    state = {"n": 0, "hung": 0}
+
+    class HangingClient(AtomClient):
+        def open(self, test, node):
+            return HangingClient(self.register)
+
+        def invoke(self, test, op):
+            with lock:
+                state["n"] += 1
+                me = state["n"]
+            if me == 3 and not state["hung"]:
+                state["hung"] = 1
+                threading.Event().wait(60)
+            return super().invoke(test, op)
+
+    reg = SharedRegister()
+    t = atom_test(reg)
+    t["client"] = HangingClient(reg)
+    t["op-timeout"] = 0.3
+    t["store-dir"] = None
+    t["generator"] = gen.clients(
+        gen.stagger(0.01, gen.limit(80, gen.cas_gen())))
+    t0 = time.time()
+    t = core.run(t)
+    wall = time.time() - t0
+    infos = [o for o in t["history"]
+             if o.is_info and o.process != "nemesis"
+             and o.error and "OpTimeout" in str(o.error)]
+    ok = bool(infos) and state["hung"] == 1 and wall < 30
+    return ok, (f"run completed in {wall:.1f}s with "
+                f"{len(infos)} op-timeout info op(s)")
+
+
+SCENARIOS = (
+    ("oom", scenario_oom),
+    ("wedge", scenario_wedge),
+    ("kill-mid-segment", scenario_kill_mid_segment),
+    ("transient", scenario_transient),
+    ("hung-client", scenario_hung_client),
+)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args()
+
+    rows = []
+    failed = 0
+    for name, fn in SCENARIOS:
+        accel._reset_for_tests()
+        t0 = time.time()
+        try:
+            ok, detail = fn(args.seed)
+        except Exception as e:  # noqa: BLE001 — a crash is a failure
+            ok, detail = False, f"crashed: {type(e).__name__}: {e}"
+        finally:
+            resilience._inject_fault = None
+        rows.append((name, ok, time.time() - t0, detail))
+        failed += 0 if ok else 1
+
+    width = max(len(n) for n, *_ in rows)
+    print(f"{'scenario':<{width}}  result  secs  detail")
+    for name, ok, secs, detail in rows:
+        print(f"{name:<{width}}  {'PASS' if ok else 'FAIL':<6}"
+              f"  {secs:4.1f}  {detail}")
+    print(f"\n{len(rows) - failed}/{len(rows)} scenarios passed")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
