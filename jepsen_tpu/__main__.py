@@ -1,0 +1,7 @@
+"""``python -m jepsen_tpu`` — the stock CLI: run / analyze / recover /
+serve (cli.clj's -main dispatch, with the crash-recovery subcommand
+first-class so a killed run is one command away from a verdict)."""
+
+from jepsen_tpu import cli
+
+cli.main(cli.default_commands())

@@ -232,6 +232,34 @@ def suite_run_cmd() -> dict:
                     "run": run_}}
 
 
+def _model_registry() -> Dict[str, Any]:
+    """Model name -> constructor, shared by analyze/recover."""
+    from jepsen_tpu.models import (
+        CASRegister, FIFOQueue, Mutex, NoOp, SetModel, UnorderedQueue)
+    return {"cas-register": CASRegister, "mutex": Mutex,
+            "set": SetModel, "unordered-queue": UnorderedQueue,
+            "fifo-queue": FIFOQueue, "noop": NoOp}
+
+
+MODEL_CHOICES = ("cas-register", "mutex", "set", "unordered-queue",
+                 "fifo-queue", "noop")
+
+
+def _add_analysis_opts(p: argparse.ArgumentParser) -> None:
+    """Checker options shared by the analyze and recover subcommands."""
+    p.add_argument("--model", default="cas-register",
+                   choices=list(MODEL_CHOICES))
+    p.add_argument("--backend", default="cpu",
+                   choices=["cpu", "tpu"])
+    p.add_argument("--algorithm", default="auto",
+                   choices=["auto", "wgl", "linear", "native",
+                            "competition"])
+    p.add_argument("--segment-iters", type=int, default=None,
+                   metavar="N",
+                   help="device-search iterations per checkpointed "
+                        "segment (0 = monolithic)")
+
+
 def analyze_cmd() -> dict:
     """The 'analyze' subcommand: offline re-check of a saved run — load
     a store directory's history and re-run the linearizable checker on
@@ -244,18 +272,7 @@ def analyze_cmd() -> dict:
         p.add_argument("--store", default=None,
                        help="store directory (default: latest under "
                             "./store)")
-        p.add_argument("--model", default="cas-register",
-                       choices=["cas-register", "mutex", "set",
-                                "unordered-queue", "fifo-queue", "noop"])
-        p.add_argument("--backend", default="cpu",
-                       choices=["cpu", "tpu"])
-        p.add_argument("--algorithm", default="auto",
-                       choices=["auto", "wgl", "linear", "native",
-                                "competition"])
-        p.add_argument("--segment-iters", type=int, default=None,
-                       metavar="N",
-                       help="device-search iterations per checkpointed "
-                            "segment (0 = monolithic)")
+        _add_analysis_opts(p)
         return p
 
     def run_(opts) -> int:
@@ -265,11 +282,7 @@ def analyze_cmd() -> dict:
 
         from jepsen_tpu import repl, store
         from jepsen_tpu.checker.wgl import linearizable
-        from jepsen_tpu.models import (
-            CASRegister, FIFOQueue, Mutex, NoOp, SetModel, UnorderedQueue)
-        models = {"cas-register": CASRegister, "mutex": Mutex,
-                  "set": SetModel, "unordered-queue": UnorderedQueue,
-                  "fifo-queue": FIFOQueue, "noop": NoOp}
+        models = _model_registry()
         if opts.get("store"):
             import os as _os
             if not _os.path.isdir(opts["store"]):
@@ -293,6 +306,94 @@ def analyze_cmd() -> dict:
         return OK if out.get("valid") is True else TEST_FAILED
 
     return {"analyze": {"parser": build_parser, "run": run_}}
+
+
+def recover_cmd() -> dict:
+    """The 'recover' subcommand: crash recovery for runs that died
+    mid-flight. Scans the store for directories whose ``run.state``
+    says running/analyzing but whose recording process is gone,
+    reconstructs each history from its write-ahead journal
+    (``history.wal``: torn-tail tolerant, dangling invokes reconciled
+    to ``:info`` like worker-crash reincarnation), then feeds it
+    through the same offline-analysis path as ``analyze`` so the
+    crashed run still renders a verdict. Exit codes follow the test
+    contract: 0 when every recovered run checks valid, 1 when a
+    verdict is invalid or a recovery fails."""
+
+    def build_parser():
+        p = Parser(prog="recover",
+                   description="Recover crashed runs from their "
+                               "write-ahead journals and re-check them.")
+        p.add_argument("--store", default=None,
+                       help="a specific run directory (default: scan "
+                            "--store-root for dead runs)")
+        p.add_argument("--store-root", default="store",
+                       help="store root to scan for dead runs")
+        p.add_argument("--no-analyze", action="store_true",
+                       help="reconstruct histories only; skip the "
+                            "checker")
+        p.add_argument("--force", action="store_true",
+                       help="recover --store even if its run.state "
+                            "says done or its pid looks alive")
+        _add_analysis_opts(p)
+        return p
+
+    def run_(opts) -> int:
+        import os as _os
+
+        _apply_segment_iters(opts.pop("segment_iters", None))
+
+        from jepsen_tpu import repl, store
+        from jepsen_tpu.checker.wgl import linearizable
+        models = _model_registry()
+
+        if opts.get("store"):
+            d = opts["store"]
+            if not _os.path.isdir(d):
+                print(f"no such store directory: {d}", file=sys.stderr)
+                return INVALID_ARGS
+            status = store.run_status(d)
+            if status != "dead" and not opts.get("force"):
+                print(f"# recovery: {d}: status="
+                      f"{status or 'no run.state'}; nothing to recover "
+                      f"(--force overrides)")
+                return OK if status in ("done", "recovered") \
+                    else INVALID_ARGS
+            targets = [d]
+        else:
+            targets = store.dead_runs(opts.get("store_root") or "store")
+            if not targets:
+                print("# recovery: no dead runs found")
+                return OK
+
+        worst = OK
+        for d in targets:
+            try:
+                rec = store.recover_run(d)
+            except (OSError, ValueError) as e:
+                print(f"# recovery: {d}: FAILED: {e}", file=sys.stderr)
+                worst = TEST_FAILED
+                continue
+            s = rec["stats"]
+            print(f"# recovery: {d}: {s['ops']} ops recovered "
+                  f"({s['records']} WAL records, {s['torn']} torn, "
+                  f"{s['corrupt']} corrupt, {s['reconciled']} dangling "
+                  f"invoke(s) -> info)")
+            if opts.get("no_analyze"):
+                continue
+            test = store.load(d)
+            checker = linearizable(models[opts["model"]](),
+                                   backend=opts["backend"],
+                                   algorithm=opts["algorithm"])
+            out = repl.recheck(test, checker)
+            store.write_results(d, out)
+            store.write_state(d, "done", recovered=True, recovery=s)
+            print(f"# recovery: {d}: verdict valid={out.get('valid')}")
+            if out.get("valid") is not True:
+                worst = TEST_FAILED
+        return worst
+
+    return {"recover": {"parser": build_parser, "run": run_}}
 
 
 def merge_commands(*cmds: dict) -> dict:
@@ -341,5 +442,12 @@ def main(subcommands: Dict[str, dict],
     sys.exit(run(subcommands, argv if argv is not None else sys.argv[1:]))
 
 
-if __name__ == "__main__":  # default main: runner + analyzer + server
-    main(merge_commands(suite_run_cmd(), analyze_cmd(), serve_cmd()))
+def default_commands() -> dict:
+    """The stock subcommand set: runner + analyzer + recovery + server
+    (what ``python -m jepsen_tpu`` dispatches)."""
+    return merge_commands(suite_run_cmd(), analyze_cmd(), recover_cmd(),
+                          serve_cmd())
+
+
+if __name__ == "__main__":  # default main
+    main(default_commands())

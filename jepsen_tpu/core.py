@@ -86,10 +86,16 @@ def primary(test: dict):
 
 def conj_op(test: dict, op: Op) -> Op:
     """Append an op to every active history under the lock — THE
-    serialization point (core.clj:43-47)."""
+    serialization point (core.clj:43-47). The same lock orders the tee
+    into the write-ahead journal, so the WAL's record order IS the
+    history order: a run killed at any instant recovers to a prefix of
+    what the clean run would have saved."""
     with test["_history_lock"]:
         for h in test["_active_histories"]:
             h.append(op)
+        j = test.get("_journal")
+        if j is not None:
+            j.append(op)  # never raises; a failed journal disables itself
     return op
 
 
@@ -191,6 +197,32 @@ class Worker:
         return test["client"].open(test, self.node())
 
 
+def _probe_heal(test: dict, nemesis, op: Op) -> None:
+    """Post-fault convergence probe: after a heal-class nemesis op
+    completes, run the nemesis's ``heal_probe`` (if configured) and
+    record the outcome as a ``heal-verified`` / ``heal-failed`` info op
+    — so checkers and humans can see fault windows that never really
+    closed, instead of trusting that 'heal returned' means 'healed'."""
+    verify = getattr(nemesis, "verify_heal", None)
+    if verify is None:
+        return
+    try:
+        res = verify(test, op)
+    except Exception as e:  # noqa: BLE001 — a broken probe is a finding
+        res = {"verified": False, "error": f"{type(e).__name__}: {e}"}
+    if res is None:
+        return
+    verified = bool(res.get("verified"))
+    if not verified:
+        log.warning("post-heal convergence probe FAILED after %s: %r",
+                    op.f, res)
+    conj_op(test, Op(
+        type=INFO, f="heal-verified" if verified else "heal-failed",
+        value=res, process=NEMESIS, time=relative_time_nanos(),
+        error=None if verified else res.get("error",
+                                            "cluster did not converge")))
+
+
 def _nemesis_worker(test: dict, stop: threading.Event):
     """The privileged nemesis process (core.clj:267-309)."""
     nemesis = test.get("nemesis")
@@ -215,6 +247,8 @@ def _nemesis_worker(test: dict, stop: threading.Event):
                 completion = completion.replace(
                     type=INFO, process=NEMESIS, time=relative_time_nanos())
                 conj_op(test, completion)
+                if nemesis is not None:
+                    _probe_heal(test, nemesis, completion)
             except Exception as e:  # noqa: BLE001 (core.clj:301-306)
                 conj_op(test, op.replace(
                     type=INFO, time=relative_time_nanos(),
@@ -393,30 +427,47 @@ def run(test: dict) -> dict:
             store = store_ns
             store_ns.prepare_dir(test)
             store_ns.start_logging(test)
+            # Crash safety: mark the run live, and tee every recorded op
+            # into the write-ahead journal so a run killed at any
+            # instant loses at most one unsynced op and stays checkable
+            # via the `recover` subcommand (doc/resilience.md).
+            store_ns.write_state(test, "running")
+            from jepsen_tpu import journal as journal_ns
+            test["_journal"] = journal_ns.open_journal(test["store-dir"])
         except ImportError:
             store = None
 
-    with control.session_pool(test):
-        client = test["client"]
-        with with_os(test), with_db(test):
-            with with_relative_time():
-                client.setup(test)
-                try:
-                    history = run_case(test)
-                finally:
-                    client.teardown(test)
-        history.index()
-        test["history"] = history
-        if store:
-            store.save_1(test)
-        checker = test.get("checker")
-        if checker is not None:
-            test["results"] = check_safe(checker, test, history)
-        else:
-            test["results"] = {"valid": True}
-        if store:
-            store.save_2(test)
-            store.stop_logging(test)
+    try:
+        with control.session_pool(test):
+            client = test["client"]
+            with with_os(test), with_db(test):
+                with with_relative_time():
+                    client.setup(test)
+                    try:
+                        history = run_case(test)
+                    finally:
+                        client.teardown(test)
+            history.index()
+            test["history"] = history
+            if store:
+                store.save_1(test)
+                store.write_state(test, "analyzing")
+            checker = test.get("checker")
+            if checker is not None:
+                test["results"] = check_safe(checker, test, history)
+            else:
+                test["results"] = {"valid": True}
+            if store:
+                store.save_2(test)
+                store.write_state(test, "done")
+                store.stop_logging(test)
+    finally:
+        # The WAL survives on disk either way; close() just fsyncs the
+        # tail. On a crash path run.state stays 'running', which is
+        # exactly what makes the run discoverable by `recover`.
+        journal = test.pop("_journal", None)
+        if journal is not None:
+            journal.close()
     log.info("Test %s: valid=%s", test.get("name"),
              test["results"].get("valid"))
     return test

@@ -271,13 +271,33 @@ class History(List[Op]):
         return "\n".join(json.dumps(o.to_dict(), default=_json_default)
                          for o in self)
 
+    #: Lines from_jsonl could not decode (truncated/corrupted artifact).
+    decode_errors: int = 0
+
     @classmethod
     def from_jsonl(cls, text: str) -> "History":
+        """Parse a saved history. Undecodable lines are *skipped and
+        counted* (``decode_errors``) rather than raised: a truncated or
+        corrupted history.jsonl degrades to a warning, keeping the rest
+        of the run analyzable offline."""
+        import logging
         h = cls()
-        for line in text.splitlines():
+        bad = 0
+        for i, line in enumerate(text.splitlines()):
             line = line.strip()
-            if line:
-                h.append(Op.from_dict(json.loads(line)))
+            if not line:
+                continue
+            try:
+                d = json.loads(line)
+                if not isinstance(d, dict) or "type" not in d:
+                    raise ValueError("not an op dict")
+                h.append(Op.from_dict(d))
+            except (ValueError, TypeError, KeyError):
+                bad += 1
+                logging.getLogger("jepsen").warning(
+                    "history.jsonl line %d is undecodable; skipping it",
+                    i + 1)
+        h.decode_errors = bad
         return h
 
 

@@ -1,0 +1,274 @@
+"""Write-ahead op journal: crash-safe histories.
+
+``store.py`` writes ``history.jsonl`` only after ``core.run_case``
+returns, so before this module a SIGKILL/OOM/power loss mid-run
+destroyed the entire observed history — the one artifact the framework
+exists to produce. The journal closes that window: ``core.conj_op``
+tees every op into an append-only ``history.wal`` *as it is recorded*,
+and the recovery pipeline (``store.recover_run`` + the ``recover`` CLI
+subcommand) reconstructs a checkable :class:`~jepsen_tpu.history.History`
+from whatever landed on disk. Pairs with the reference's two-phase
+store seam (store.clj:279-302 ``save_1``/``save_2``): analysis always
+re-runs offline on a saved history, so a *partial* history recovered
+from the WAL is still fully checkable (P-compositionality,
+arXiv:1504.00204 — a prefix of a history is a history).
+
+Format — one record per line::
+
+    <crc32 as 8 lowercase hex chars> <compact JSON op dict>\\n
+
+The CRC covers exactly the JSON payload bytes, so the reader can tell a
+torn final record (the write was cut mid-line by the crash) from a
+corrupted earlier one. Every record is written with a single buffered
+``write`` and flushed to the OS per append: a SIGKILL loses at most the
+one record the kernel never saw. fsync cadence is the env-tunable part:
+
+* ``JTPU_WAL_SYNC=op``    — fsync after every append (power-loss-safe
+  per op; slowest)
+* ``JTPU_WAL_SYNC=batch`` — fsync at most once per
+  ``JTPU_WAL_BATCH_MS`` (default 50) window, plus on close (default:
+  SIGKILL-safe always, power-loss window bounded by the batch)
+* ``JTPU_WAL_SYNC=off``   — never fsync (still flushed per append)
+
+``JTPU_WAL=0`` disables the journal entirely — the pre-WAL write path
+is untouched either way (a clean run's ``history.jsonl`` is
+byte-identical with the WAL on or off; the WAL is a *separate* file).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+import zlib
+from typing import Optional, Tuple
+
+from jepsen_tpu.history import History, INFO, Op
+
+log = logging.getLogger("jepsen.journal")
+
+#: The journal's filename inside a run's store directory.
+WAL_NAME = "history.wal"
+
+SYNC_OP = "op"
+SYNC_BATCH = "batch"
+SYNC_OFF = "off"
+SYNC_POLICIES = (SYNC_OP, SYNC_BATCH, SYNC_OFF)
+
+DEFAULT_BATCH_MS = 50.0
+
+
+def _json_default(x):
+    # mirrors store._json_default: anything history.jsonl can hold, the
+    # WAL can hold (journal must not import store — store imports us)
+    if isinstance(x, (set, frozenset)):
+        return sorted(x, key=repr)
+    if isinstance(x, bytes):
+        return x.decode("utf-8", "replace")
+    return repr(x)
+
+
+def enabled() -> bool:
+    """Whether the WAL is on at all (JTPU_WAL, default on)."""
+    return os.environ.get("JTPU_WAL", "1").lower() not in (
+        "0", "false", "no", "off")
+
+
+def sync_policy() -> str:
+    """The fsync cadence from JTPU_WAL_SYNC (op|batch|off)."""
+    v = os.environ.get("JTPU_WAL_SYNC", SYNC_BATCH).strip().lower()
+    if v not in SYNC_POLICIES:
+        log.warning("JTPU_WAL_SYNC=%r is not one of %s; using %r",
+                    v, "|".join(SYNC_POLICIES), SYNC_BATCH)
+        return SYNC_BATCH
+    return v
+
+
+def batch_window_s() -> float:
+    """The batch-mode fsync window from JTPU_WAL_BATCH_MS, in seconds."""
+    v = os.environ.get("JTPU_WAL_BATCH_MS")
+    if not v:
+        return DEFAULT_BATCH_MS / 1000.0
+    try:
+        return max(0.0, float(v)) / 1000.0
+    except ValueError:
+        log.warning("JTPU_WAL_BATCH_MS=%r is not a number; using %s",
+                    v, DEFAULT_BATCH_MS)
+        return DEFAULT_BATCH_MS / 1000.0
+
+
+def encode_record(op: Op) -> bytes:
+    """One WAL line for an op: crc-prefixed compact JSON."""
+    payload = json.dumps(op.to_dict(), separators=(",", ":"),
+                         default=_json_default).encode("utf-8")
+    return b"%08x " % (zlib.crc32(payload) & 0xFFFFFFFF) + payload + b"\n"
+
+
+def decode_record(line: bytes) -> Optional[Op]:
+    """One WAL line back to an Op; None if the line is torn/corrupt."""
+    if len(line) < 10 or line[8:9] != b" ":
+        return None
+    crc, payload = line[:8], line[9:]
+    try:
+        if int(crc, 16) != (zlib.crc32(payload) & 0xFFFFFFFF):
+            return None
+        d = json.loads(payload)
+        if not isinstance(d, dict) or "type" not in d:
+            return None
+        return Op.from_dict(d)
+    except (ValueError, TypeError, KeyError):
+        return None
+
+
+class Journal:
+    """Append-only, fsync-batched op journal.
+
+    Appends are serialized by ``core.conj_op``'s history lock already,
+    but the journal keeps its own lock so direct users (tests, tools)
+    are safe too. A write failure disables the journal (the run itself
+    must never die because its crash-insurance file did) — visible via
+    :attr:`failed` and a log line.
+    """
+
+    def __init__(self, path: str, sync: Optional[str] = None,
+                 batch_s: Optional[float] = None):
+        self.path = path
+        self.sync = sync if sync in SYNC_POLICIES else sync_policy()
+        self.batch_s = batch_window_s() if batch_s is None else batch_s
+        self.records = 0
+        self.syncs = 0
+        self.failed: Optional[str] = None
+        self._lock = threading.Lock()
+        self._dirty = False
+        self._last_sync = time.monotonic()
+        self._f = open(path, "ab", buffering=0)
+
+    def __repr__(self):
+        state = f"failed: {self.failed}" if self.failed else \
+            ("closed" if self._f is None else "open")
+        return (f"<Journal {self.path!r} sync={self.sync} "
+                f"records={self.records} syncs={self.syncs} {state}>")
+
+    def _fsync(self) -> None:
+        os.fsync(self._f.fileno())
+        self.syncs += 1
+        self._dirty = False
+        self._last_sync = time.monotonic()
+
+    def append(self, op: Op) -> None:
+        """Tee one op. Single unbuffered write -> the kernel has the
+        whole record (SIGKILL-safe); fsync per the sync policy."""
+        line = encode_record(op)
+        with self._lock:
+            if self._f is None or self.failed is not None:
+                return
+            try:
+                self._f.write(line)
+                self.records += 1
+                self._dirty = True
+                if self.sync == SYNC_OP:
+                    self._fsync()
+                elif (self.sync == SYNC_BATCH and
+                        time.monotonic() - self._last_sync >= self.batch_s):
+                    self._fsync()
+            except OSError as e:
+                self.failed = f"{type(e).__name__}: {e}"
+                log.warning("WAL append to %s failed (%s); the journal "
+                            "is disabled for the rest of the run",
+                            self.path, self.failed)
+
+    def flush(self) -> None:
+        """Force an fsync now (unless the policy is off)."""
+        with self._lock:
+            if self._f is None or self.failed is not None:
+                return
+            try:
+                if self.sync != SYNC_OFF and self._dirty:
+                    self._fsync()
+            except OSError as e:
+                self.failed = f"{type(e).__name__}: {e}"
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is None:
+                return
+            try:
+                if self.sync != SYNC_OFF and self._dirty:
+                    self._fsync()
+            except OSError:
+                pass
+            try:
+                self._f.close()
+            finally:
+                self._f = None
+
+
+def open_journal(store_dir: Optional[str]) -> Optional[Journal]:
+    """A Journal for a run's store dir, or None when disabled/dir-less."""
+    if not store_dir or not enabled():
+        return None
+    try:
+        return Journal(os.path.join(store_dir, WAL_NAME))
+    except OSError as e:
+        log.warning("couldn't open the WAL in %s: %s", store_dir, e)
+        return None
+
+
+def read_wal(path: str) -> Tuple[History, dict]:
+    """Torn-tail-tolerant WAL reader.
+
+    Returns ``(history, stats)``. The final record may have been cut
+    mid-write by the crash: if it fails to decode it is dropped
+    silently as ``torn`` (at most one record — the crash-loss bound).
+    An *earlier* line that fails its CRC or JSON decode is real
+    corruption: skipped, counted as ``corrupt``, and warned about, so a
+    damaged journal degrades instead of taking recovery down."""
+    stats = {"records": 0, "torn": 0, "corrupt": 0}
+    with open(path, "rb") as f:
+        data = f.read()
+    lines = data.split(b"\n")
+    terminated = data.endswith(b"\n")
+    if lines and lines[-1] == b"":
+        lines.pop()
+    h = History()
+    for i, line in enumerate(lines):
+        op = decode_record(line)
+        if op is not None:
+            h.append(op)
+            stats["records"] += 1
+        elif i == len(lines) - 1 and not terminated:
+            stats["torn"] += 1
+        else:
+            stats["corrupt"] += 1
+            log.warning("WAL %s: dropping corrupt record at line %d",
+                        path, i + 1)
+    return h, stats
+
+
+def reconcile(history: History) -> Tuple[History, int]:
+    """Resolve dangling invokes to ``:info`` completions.
+
+    A run killed mid-flight leaves invocations whose workers never got
+    to record a completion. Exactly like worker-crash reincarnation
+    (core.clj:168-217): the op is *indeterminate* — it may or may not
+    have taken effect — so each dangling invoke gets a synthesized
+    ``info`` completion appended. Returns a new (history, n_reconciled);
+    does not mutate the input."""
+    open_by_proc: dict = {}
+    for o in history:
+        if o.is_invoke:
+            open_by_proc[o.process] = o
+        else:
+            open_by_proc.pop(o.process, None)
+    out = History(history)
+    t_end = max((o.time for o in history), default=0)
+    # deterministic order: by the dangling invoke's own time, then process
+    dangling = sorted(open_by_proc.values(),
+                      key=lambda o: (o.time, str(o.process)))
+    for inv in dangling:
+        out.append(inv.replace(
+            type=INFO, time=t_end, index=-1,
+            error="wal-recovery: the run died before this op completed"))
+    return out, len(dangling)

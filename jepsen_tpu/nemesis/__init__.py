@@ -26,10 +26,28 @@ from jepsen_tpu.util import majority
 # Protocol
 # ---------------------------------------------------------------------------
 
+#: Op f values whose successful completion claims to have healed the
+#: fault — the Partitioner heals on 'stop', explicit healers use 'heal'.
+HEAL_FS = frozenset({"stop", "heal"})
+
 
 class Nemesis:
     """Fault-injection protocol (nemesis.clj:9-12). setup returns the
-    nemesis ready to be invoked (possibly a new object)."""
+    nemesis ready to be invoked (possibly a new object).
+
+    Post-fault convergence: set :attr:`heal_probe` to a callable
+    ``(test, op) -> {"verified": bool, ...}`` and the nemesis worker
+    will run it after every successful heal-class op (``f`` in
+    :attr:`heal_fs`), recording a ``heal-verified`` / ``heal-failed``
+    info op in the history — a heal that *returned* is not the same as
+    a cluster that *converged*, and checkers/humans deserve to see
+    which fault windows never really closed.
+    """
+
+    #: f values treated as heals (override per nemesis if needed).
+    heal_fs: frozenset = HEAL_FS
+    #: Optional convergence probe; see :func:`client_ping_probe`.
+    heal_probe = None
 
     def setup(self, test: dict) -> "Nemesis":
         return self
@@ -39,6 +57,13 @@ class Nemesis:
 
     def teardown(self, test: dict) -> None:
         pass
+
+    def verify_heal(self, test: dict, op: Op) -> Optional[dict]:
+        """Run the heal probe for a completed nemesis op, or None when
+        the op is not a heal / no probe is configured."""
+        if self.heal_probe is None or op.f not in (self.heal_fs or ()):
+            return None
+        return self.heal_probe(test, op)
 
 
 class Noop(Nemesis):
@@ -226,9 +251,77 @@ class Compose(Nemesis):
         for fs, n in self.nemeses:
             n.teardown(test)
 
+    def verify_heal(self, test, op):
+        """Route the probe like invoke: the child that handled the op
+        decides whether it was a heal (seeing the renamed f). A probe
+        set on the Compose itself takes precedence and applies to every
+        heal-class f, whichever child handled it."""
+        if self.heal_probe is not None:
+            return Nemesis.verify_heal(self, test, op)
+        for fs, n in self.nemeses:
+            f2 = _route(fs, op.f)
+            if f2 is not None:
+                return n.verify_heal(test, op.replace(f=f2))
+        return None
+
 
 def compose(nemeses) -> Compose:
     return Compose(nemeses)
+
+
+# ---------------------------------------------------------------------------
+# Post-fault convergence probes
+# ---------------------------------------------------------------------------
+
+
+def client_ping_probe(deadline_s: float = 5.0, policy=None,
+                      op_f: str = "read", ok_types=("ok",)):
+    """A heal probe that pings every node through the test's client.
+
+    After a heal, each node gets up to ``deadline_s`` seconds of
+    open/invoke/close attempts under the resilience layer's retry
+    policy (jittered capped-exponential backoff,
+    :class:`jepsen_tpu.resilience.RetryPolicy`): a node counts as
+    converged once a ``op_f`` invocation completes with a type in
+    ``ok_types``. Returns the probe callable to assign to
+    ``nemesis.heal_probe``; its result dict lands in the history as the
+    ``heal-verified`` / ``heal-failed`` op's value, per-node attempt
+    counts and errors included."""
+
+    def probe(test: dict, op: Op) -> dict:
+        from jepsen_tpu.resilience import (RetryPolicy,
+                                           retry_until_deadline)
+        pol = policy or RetryPolicy()
+        t0 = _time.monotonic()
+        nodes = list(test.get("nodes") or [])
+        results: Dict[Any, dict] = {}
+        all_ok = True
+        for node in nodes:
+            def ping(node=node):
+                client = test["client"].open(test, node)
+                try:
+                    comp = client.invoke(
+                        test, Op(type="invoke", f=op_f, value=None,
+                                 process="heal-probe"))
+                    return comp is not None and comp.type in ok_types
+                finally:
+                    try:
+                        client.close(test)
+                    except Exception:  # noqa: BLE001
+                        pass
+
+            ok, attempts, err = retry_until_deadline(ping, deadline_s,
+                                                     policy=pol)
+            rec = {"ok": ok, "attempts": attempts}
+            if not ok and err:
+                rec["error"] = err
+            results[node] = rec
+            all_ok = all_ok and ok
+        return {"verified": all_ok, "deadline-s": deadline_s,
+                "elapsed-s": round(_time.monotonic() - t0, 3),
+                "nodes": results}
+
+    return probe
 
 
 # ---------------------------------------------------------------------------

@@ -146,6 +146,35 @@ class RetryPolicy:
         return d
 
 
+def retry_until_deadline(fn: Callable[[], Any], deadline_s: float,
+                         policy: Optional[RetryPolicy] = None
+                         ) -> tuple:
+    """Run ``fn`` until it returns truthy or ``deadline_s`` elapses,
+    sleeping ``policy.delay(attempt)`` (jittered capped-exponential)
+    between attempts; exceptions count as failed attempts. The shared
+    deadline+backoff primitive behind the nemesis layer's post-heal
+    convergence probes (:func:`jepsen_tpu.nemesis.client_ping_probe`).
+
+    Returns ``(ok, attempts, last_error)`` — ``last_error`` is a short
+    string for the trail, or None on success."""
+    policy = policy or RetryPolicy()
+    t_end = time.monotonic() + deadline_s
+    attempts = 0
+    last_err: Optional[str] = None
+    while True:
+        attempts += 1
+        try:
+            if fn():
+                return True, attempts, None
+            last_err = "probe returned falsy"
+        except Exception as e:  # noqa: BLE001 — a probe failure is data
+            last_err = _errstr(e)
+        remaining = t_end - time.monotonic()
+        if remaining <= 0:
+            return False, attempts, last_err
+        time.sleep(min(policy.delay(attempts), remaining))
+
+
 def deadline_stop(deadline_s: float,
                   inner: Optional[Callable[[], bool]] = None
                   ) -> Callable[[], bool]:

@@ -20,6 +20,18 @@ Two-phase saving preserved: save_1 after the run (history snapshot,
 store.clj:279-290), save_2 after analysis (results, 292-302) — so analysis
 can be re-run offline on a saved history, the seam the TPU checker plugs
 into (SURVEY §5 checkpoint/resume).
+
+Crash safety (doc/resilience.md "Crash-safe histories"):
+
+- every artifact is written tmp + ``os.replace`` (and the ``latest``
+  symlinks swap the same way), so a crash mid-save leaves either the old
+  file or the new one, never a torn half behind a live pointer;
+- a ``run.state`` marker (running -> analyzing -> done, atomically
+  replaced) plus the per-op WAL (:mod:`jepsen_tpu.journal`) make a run
+  that died mid-flight *discoverable* (:func:`dead_runs`) and
+  *recoverable* (:func:`recover_run`, surfaced as the ``recover`` CLI
+  subcommand): its history is rebuilt from the journal and fed through
+  the ordinary offline-analysis path.
 """
 
 from __future__ import annotations
@@ -45,6 +57,9 @@ NONSERIALIZABLE_KEYS = (
 PARALLEL_WRITE_THRESHOLD = 16384
 
 DEFAULT_ROOT = "store"
+
+#: The run-liveness marker file inside each run directory.
+RUN_STATE = "run.state"
 
 
 def _root(test: dict) -> str:
@@ -119,6 +134,19 @@ def _json_default(x):
     return repr(x)
 
 
+def _atomic_write(path: str, text: str) -> None:
+    """Write tmp + fsync + ``os.replace``: a crash during save leaves
+    either the previous artifact or the complete new one, never a torn
+    half (the tmp lives in the same directory so the replace is a
+    same-filesystem rename)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
 def write_history(d: str, history: History) -> None:
     """history.txt + history.jsonl; big histories are formatted in parallel
     chunks (util.clj:149-170 pwrite-history!)."""
@@ -138,19 +166,19 @@ def write_history(d: str, history: History) -> None:
         txt = "\n".join(str(o) for o in ops)
         jsonl = "\n".join(json.dumps(o.to_dict(), default=_json_default)
                           for o in ops)
-    with open(os.path.join(d, "history.txt"), "w") as f:
-        f.write(txt + "\n")
-    with open(os.path.join(d, "history.jsonl"), "w") as f:
-        f.write(jsonl + "\n")
+    _atomic_write(os.path.join(d, "history.txt"), txt + "\n")
+    _atomic_write(os.path.join(d, "history.jsonl"), jsonl + "\n")
 
 
 def write_results(d: str, results: dict) -> None:
-    with open(os.path.join(d, "results.json"), "w") as f:
-        json.dump(results, f, indent=2, default=_json_default)
+    _atomic_write(os.path.join(d, "results.json"),
+                  json.dumps(results, indent=2, default=_json_default))
 
 
 def update_symlinks(test: dict) -> None:
-    """store/<name>/latest and store/latest (store.clj:235-247)."""
+    """store/<name>/latest and store/latest (store.clj:235-247). The swap
+    is symlink-at-tmp-name + ``os.replace``: ``latest`` always points at
+    a run, never at nothing mid-swap."""
     d = test.get("store-dir")
     if not d:
         return
@@ -159,10 +187,14 @@ def update_symlinks(test: dict) -> None:
     root = os.path.dirname(name_dir)
     for link_dir, target in ((name_dir, d), (root, d)):
         link = os.path.join(link_dir, "latest")
+        tmp = f"{link}.tmp.{os.getpid()}"
         try:
-            if os.path.islink(link):
-                os.unlink(link)
-            os.symlink(os.path.relpath(target, link_dir), link)
+            try:
+                os.unlink(tmp)
+            except FileNotFoundError:
+                pass
+            os.symlink(os.path.relpath(target, link_dir), tmp)
+            os.replace(tmp, link)
         except OSError:
             pass
 
@@ -174,9 +206,9 @@ def save_1(test: dict) -> dict:
     history = test.get("history") or History()
 
     def write_test():
-        with open(os.path.join(d, "test.json"), "w") as f:
-            json.dump(serializable_test(test), f, indent=2,
-                      default=_json_default)
+        _atomic_write(os.path.join(d, "test.json"),
+                      json.dumps(serializable_test(test), indent=2,
+                                 default=_json_default))
 
     real_pmap(lambda f: f(), [write_test,
                               lambda: write_history(d, history)])
@@ -190,6 +222,96 @@ def save_2(test: dict) -> dict:
     write_results(d, test.get("results", {}))
     update_symlinks(test)
     return test
+
+
+# ---------------------------------------------------------------------------
+# Run liveness + recovery (doc/resilience.md "Crash-safe histories")
+# ---------------------------------------------------------------------------
+
+def write_state(test_or_dir, state: str, **extra) -> None:
+    """Atomically update the run's ``run.state`` marker. Lifecycle:
+    ``running`` (before the workload) -> ``analyzing`` (history saved,
+    checker running) -> ``done`` (results written). The recorded pid is
+    what lets :func:`run_status` tell a live run from a dead one."""
+    d = test_or_dir if isinstance(test_or_dir, str) \
+        else test_or_dir.get("store-dir")
+    if not d or not os.path.isdir(d):
+        return
+    doc = {"state": state, "pid": os.getpid(), "updated": time_str()}
+    doc.update(extra)
+    try:
+        _atomic_write(os.path.join(d, RUN_STATE),
+                      json.dumps(doc, indent=2, default=_json_default))
+    except OSError as e:  # liveness marker must never kill the run
+        logging.getLogger("jepsen").warning(
+            "couldn't write %s in %s: %s", RUN_STATE, d, e)
+
+
+def read_state(d: str) -> Optional[dict]:
+    """The run.state document, or None when absent/unreadable."""
+    try:
+        with open(os.path.join(d, RUN_STATE)) as f:
+            doc = json.load(f)
+        return doc if isinstance(doc, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def pid_alive(pid) -> bool:
+    """Is a pid currently running (signal-0 probe)?"""
+    if not isinstance(pid, int) or pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # someone else's process, but alive
+    except OSError:
+        return False
+    return True
+
+
+def run_status(d: str) -> Optional[str]:
+    """One of 'running' | 'dead' | 'done' | 'recovered', or None for a
+    run with no run.state marker (pre-WAL runs: nothing to recover)."""
+    st = read_state(d)
+    if st is None:
+        return None
+    if st.get("state") == "done":
+        return "recovered" if st.get("recovered") else "done"
+    return "running" if pid_alive(st.get("pid")) else "dead"
+
+
+def dead_runs(root: str = DEFAULT_ROOT) -> List[str]:
+    """Run directories whose run.state says running/analyzing but whose
+    recording process is gone — the ``recover`` scan."""
+    return [d for d in tests(root=root) if run_status(d) == "dead"]
+
+
+def recover_run(d: str) -> dict:
+    """Reconstruct a dead run's history from its write-ahead journal.
+
+    Reads the WAL (torn-tail tolerant: at most the final partial record
+    is dropped), reconciles dangling invokes to ``:info`` exactly like
+    worker-crash reincarnation, indexes, and writes the standard
+    ``history.jsonl``/``history.txt`` artifacts — after which the run
+    analyzes exactly like a clean one (``load`` + any checker). Marks
+    run.state ``analyzing`` with the recovery stats. Returns
+    ``{"history": History, "stats": {...}}``."""
+    from jepsen_tpu import journal as journal_ns
+    wal = os.path.join(d, journal_ns.WAL_NAME)
+    if not os.path.exists(wal):
+        raise FileNotFoundError(
+            f"no {journal_ns.WAL_NAME} in {d}: nothing to recover "
+            f"(the run predates the WAL or disabled it via JTPU_WAL=0)")
+    h, stats = journal_ns.read_wal(wal)
+    h, reconciled = journal_ns.reconcile(h)
+    h.index()
+    write_history(d, h)
+    stats = dict(stats, reconciled=reconciled, ops=len(h))
+    write_state(d, "analyzing", recovered=True, recovery=stats)
+    return {"history": h, "stats": stats}
 
 
 # ---------------------------------------------------------------------------

@@ -245,9 +245,17 @@ def _nemesis_cycle(period: float):
 def localkv_test(opts: dict) -> dict:
     """Safe mode: linearizable by construction; the run should validate.
     Hammer-time pauses a node mid-run to exercise crashed ops and client
-    reincarnation against real frozen processes."""
+    reincarnation against real frozen processes. Each resume (f=stop)
+    is followed by a convergence probe — every node must answer a read
+    again before the heal is trusted — recorded as heal-verified /
+    heal-failed ops (opts: 'heal-probe' False disables,
+    'heal-probe-deadline' tunes the per-node budget)."""
     opts = dict(opts)
     nodes = opts.get("nodes") or ["kv1", "kv2", "kv3"]
+    nem = pause_nemesis()
+    if opts.get("heal-probe", True):
+        nem.heal_probe = nemesis.client_ping_probe(
+            deadline_s=opts.get("heal-probe-deadline", 3.0))
     test = noop_test()
     test.update({
         "name": "local-kv",
@@ -256,7 +264,7 @@ def localkv_test(opts: dict) -> dict:
         "ssh": {"mode": "local"},
         "db": LocalKVDB(),
         "client": LocalKVClient(),
-        "nemesis": pause_nemesis(),
+        "nemesis": nem,
         "model": CASRegister(),
         "checker": compose({
             "perf": perf(),

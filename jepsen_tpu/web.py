@@ -36,9 +36,23 @@ a {{ text-decoration: none; color: #0366d6; }}
 </style></head><body><h1>{title}</h1>{body}</body></html>"""
 
 
-def run_rows(root: str) -> List[Tuple[str, str, object]]:
-    """(name, timestamp, valid) for every saved run, newest first
-    (web.clj:47-67 fast-tests)."""
+def _run_status(run_dir: str):
+    """run.state-derived status ('running'/'dead'/'done'/'recovered') or
+    None for pre-WAL runs; never raises (the browser must render even
+    over a half-broken store)."""
+    try:
+        from jepsen_tpu import store as store_ns
+        return store_ns.run_status(run_dir)
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def run_rows(root: str) -> List[Tuple[str, str, object, object]]:
+    """(name, timestamp, valid, status) for every saved run, newest
+    first (web.clj:47-67 fast-tests). ``status`` surfaces crashed runs:
+    'dead' means run.state says running/analyzing but the pid is gone —
+    recoverable via ``python -m jepsen_tpu recover``; 'recovered' means
+    the verdict came from a WAL-reconstructed history."""
     rows = []
     if not os.path.isdir(root):
         return rows
@@ -59,7 +73,7 @@ def run_rows(root: str) -> List[Tuple[str, str, object]]:
                         valid = json.load(f).get("valid")
                 except (OSError, ValueError):
                     valid = "unknown"
-            rows.append((name, ts, valid))
+            rows.append((name, ts, valid, _run_status(run_dir)))
     rows.sort(key=lambda r: r[1], reverse=True)
     return rows
 
@@ -155,20 +169,33 @@ class Handler(BaseHTTPRequestHandler):
             self._page("Error", f"<pre>{html.escape(repr(e))}</pre>",
                        code=500)
 
+    #: run.state statuses worth a badge (quiet for ordinary done runs).
+    STATUS_LABELS = {
+        "dead": "dead — recoverable (python -m jepsen_tpu recover)",
+        "running": "running",
+        "recovered": "recovered from WAL",
+    }
+
     def home(self):
-        """Test table with validity colors (web.clj:116-128)."""
+        """Test table with validity colors (web.clj:116-128); crashed
+        and recovered runs carry a status badge."""
         rows = []
-        for name, ts, valid in run_rows(self.root):
+        for name, ts, valid, status in run_rows(self.root):
             color = VALID_COLORS.get(valid, "#ffffff")
+            if status == "dead":
+                color = VALID_COLORS["unknown"]
             link = f"/files/{quote(name)}/{quote(ts)}/"
+            badge = self.STATUS_LABELS.get(status, "")
             rows.append(
                 f"<tr style='background:{color}'>"
                 f"<td class=valid>{html.escape(str(valid))}</td>"
                 f"<td><a href='{link}'>{html.escape(name)}</a></td>"
                 f"<td><a href='{link}'>{html.escape(ts)}</a></td>"
+                f"<td>{html.escape(badge)}</td>"
                 f"<td><a href='{link[:-1]}?zip'>zip</a></td></tr>")
         body = ("<table><tr><th>valid</th><th>test</th><th>time</th>"
-                "<th></th></tr>" + "".join(rows) + "</table>"
+                "<th>state</th><th></th></tr>" + "".join(rows) +
+                "</table>"
                 if rows else "<p>No tests run yet.</p>")
         self._page("Jepsen-TPU results", body)
 

@@ -1,0 +1,452 @@
+"""Crash-safe histories: the write-ahead op journal, run recovery, and
+post-fault convergence probes.
+
+The WAL (jepsen_tpu/journal.py) tees every op ``core.conj_op`` records
+into ``history.wal``; ``store.recover_run`` + the ``recover`` CLI
+subcommand rebuild a checkable history from whatever a killed run left
+on disk. tools/chaos_matrix.py drives the real SIGKILL-a-localkv-run
+variant standalone; here the same machinery is exercised on synthetic
+dead runs, torn tails, corrupt records, and sync-policy knobs."""
+
+import io
+import contextlib
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from jepsen_tpu import cli, core, journal, store
+from jepsen_tpu import generator as gen
+from jepsen_tpu import nemesis
+from jepsen_tpu.history import History, Op
+from jepsen_tpu.testing import atom_test, simulate_register_history
+
+
+def _ops(n=12, seed=0):
+    return simulate_register_history(n, n_procs=3, n_vals=4, seed=seed)
+
+
+def _write_wal(path, ops, sync="op"):
+    j = journal.Journal(path, sync=sync)
+    for o in ops:
+        j.append(o)
+    j.close()
+    return j
+
+
+def _dead_pid():
+    """A pid guaranteed dead: a child we already reaped."""
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    p.wait()
+    return p.pid
+
+
+def _mark_dead(d, pid=None):
+    store.write_state(d, "running")
+    st = json.load(open(os.path.join(d, store.RUN_STATE)))
+    st["pid"] = pid if pid is not None else _dead_pid()
+    with open(os.path.join(d, store.RUN_STATE), "w") as f:
+        json.dump(st, f)
+
+
+class TestWALFormat:
+    def test_roundtrip(self, tmp_path):
+        ops = _ops(20)
+        path = str(tmp_path / "history.wal")
+        _write_wal(path, ops)
+        h, stats = journal.read_wal(path)
+        assert stats == {"records": len(ops), "torn": 0, "corrupt": 0}
+        # values survive modulo JSON normalization (tuples -> lists),
+        # the same normalization the history.jsonl load path applies
+        reloaded = History.from_jsonl("\n".join(
+            json.dumps(o.to_dict()) for o in ops))
+        assert h == reloaded
+
+    def test_torn_final_line_dropped_silently(self, tmp_path):
+        ops = _ops(10)
+        path = str(tmp_path / "history.wal")
+        _write_wal(path, ops)
+        with open(path, "ab") as f:
+            f.write(journal.encode_record(ops[0])[:13])  # cut mid-write
+        h, stats = journal.read_wal(path)
+        assert len(h) == len(ops)
+        assert stats["torn"] == 1 and stats["corrupt"] == 0
+
+    def test_crc_mismatch_line_skipped_and_counted(self, tmp_path):
+        ops = _ops(10)
+        path = str(tmp_path / "history.wal")
+        _write_wal(path, ops)
+        data = bytearray(open(path, "rb").read())
+        lines = bytes(data).split(b"\n")
+        # flip a payload byte in the middle record (keep line structure)
+        victim = bytearray(lines[4])
+        victim[-2] ^= 0x01
+        lines[4] = bytes(victim)
+        with open(path, "wb") as f:
+            f.write(b"\n".join(lines))
+        h, stats = journal.read_wal(path)
+        assert len(h) == len(ops) - 1
+        assert stats["corrupt"] == 1 and stats["torn"] == 0
+
+    def test_crc_guards_whole_payload(self):
+        rec = journal.encode_record(Op(type="invoke", f="read"))
+        assert journal.decode_record(rec[:-1]) is not None  # sans \n
+        assert journal.decode_record(b"zz" + rec[2:-1]) is None
+        assert journal.decode_record(b"") is None
+        assert journal.decode_record(b"00000000 {}") is None  # not an op
+
+
+class TestSyncPolicy:
+    def _count_fsyncs(self, monkeypatch):
+        calls = []
+        real = os.fsync
+        monkeypatch.setattr(os, "fsync", lambda fd: (calls.append(fd),
+                                                     real(fd))[1])
+        return calls
+
+    def test_sync_op_fsyncs_every_append(self, tmp_path, monkeypatch):
+        calls = self._count_fsyncs(monkeypatch)
+        _write_wal(str(tmp_path / "w"), _ops(8), sync="op")
+        assert len(calls) >= 8
+
+    def test_sync_batch_fsyncs_by_window(self, tmp_path, monkeypatch):
+        calls = self._count_fsyncs(monkeypatch)
+        j = journal.Journal(str(tmp_path / "w"), sync="batch",
+                            batch_s=3600.0)
+        for o in _ops(8):
+            j.append(o)
+        assert len(calls) == 0  # window never elapsed
+        j.close()
+        assert len(calls) == 1  # the close() flush
+
+    def test_sync_off_never_fsyncs(self, tmp_path, monkeypatch):
+        calls = self._count_fsyncs(monkeypatch)
+        _write_wal(str(tmp_path / "w"), _ops(8), sync="off")
+        assert len(calls) == 0
+        # still readable: appends are flushed to the OS regardless
+        h, stats = journal.read_wal(str(tmp_path / "w"))
+        assert stats["records"] == 16  # 8 ops = invoke+completion pairs
+
+    def test_env_knobs(self, monkeypatch):
+        monkeypatch.setenv("JTPU_WAL_SYNC", "op")
+        assert journal.sync_policy() == "op"
+        monkeypatch.setenv("JTPU_WAL_SYNC", "bogus")
+        assert journal.sync_policy() == "batch"  # default on nonsense
+        monkeypatch.setenv("JTPU_WAL_BATCH_MS", "250")
+        assert journal.batch_window_s() == 0.25
+        monkeypatch.setenv("JTPU_WAL", "0")
+        assert not journal.enabled()
+        assert journal.open_journal("/tmp") is None
+        monkeypatch.delenv("JTPU_WAL")
+        assert journal.enabled()
+
+
+class TestReconcile:
+    def test_dangling_invoke_becomes_info(self):
+        h = History.of([
+            {"type": "invoke", "f": "write", "value": 1, "process": 0,
+             "time": 10},
+            {"type": "ok", "f": "write", "value": 1, "process": 0,
+             "time": 20},
+            {"type": "invoke", "f": "cas", "value": [1, 2], "process": 1,
+             "time": 30},
+        ])
+        out, n = journal.reconcile(h)
+        assert n == 1 and len(out) == 4
+        tail = out[-1]
+        assert tail.type == "info" and tail.f == "cas"
+        assert tail.process == 1 and "wal-recovery" in tail.error
+        assert len(h) == 3  # input not mutated
+
+    def test_clean_history_untouched(self):
+        h = _ops(10)
+        out, n = journal.reconcile(h)
+        assert n == 0 and list(out) == list(h)
+
+    def test_reincarnated_process_only_latest_invoke_dangles(self):
+        # an info completion abandons the process; the next invoke on
+        # p + concurrency is a different process id, so only genuinely
+        # open invocations reconcile
+        h = History.of([
+            {"type": "invoke", "f": "write", "value": 1, "process": 0},
+            {"type": "info", "f": "write", "value": 1, "process": 0},
+            {"type": "invoke", "f": "read", "value": None, "process": 5},
+        ])
+        out, n = journal.reconcile(h)
+        assert n == 1 and out[-1].process == 5
+
+
+class TestFromJsonlTolerance:
+    def test_skips_and_counts_bad_lines(self):
+        good = json.dumps({"type": "invoke", "f": "read", "process": 0})
+        text = "\n".join([good, "{truncated", good, '["not a dict"]'])
+        h = History.from_jsonl(text)
+        assert len(h) == 2
+        assert h.decode_errors == 2
+
+    def test_clean_text_counts_zero(self):
+        h = History.from_jsonl(_ops(5).to_jsonl())
+        assert h.decode_errors == 0 and len(h) == 10
+
+
+class TestAtomicStore:
+    def test_no_tmp_residue_after_save(self, tmp_path):
+        d = str(tmp_path / "run")
+        os.makedirs(d)
+        test = {"store-dir": d, "name": "t",
+                "history": _ops(6), "results": {"valid": True}}
+        store.save_1(test)
+        store.save_2(test)
+        files = os.listdir(d)
+        assert not [f for f in files if ".tmp." in f], files
+        assert {"test.json", "results.json", "history.jsonl",
+                "history.txt"} <= set(files)
+        assert json.load(open(os.path.join(d, "results.json")))["valid"] \
+            is True
+
+    def test_atomic_write_replaces_not_truncates(self, tmp_path,
+                                                 monkeypatch):
+        # simulate a crash between tmp-write and replace: the original
+        # artifact must be intact
+        path = str(tmp_path / "results.json")
+        store._atomic_write(path, '{"valid": true}')
+        monkeypatch.setattr(os, "replace",
+                            lambda a, b: (_ for _ in ()).throw(
+                                OSError("crash")))
+        with pytest.raises(OSError):
+            store._atomic_write(path, '{"valid": fal')
+        monkeypatch.undo()
+        assert json.load(open(path))["valid"] is True
+
+    def test_latest_symlink_swap(self, tmp_path):
+        root = tmp_path / "store"
+        d1 = root / "t" / "r1"
+        d2 = root / "t" / "r2"
+        for d in (d1, d2):
+            os.makedirs(d)
+        store.update_symlinks({"store-dir": str(d1)})
+        store.update_symlinks({"store-dir": str(d2)})
+        latest = root / "t" / "latest"
+        assert os.path.islink(latest)
+        assert os.path.realpath(latest) == os.path.realpath(d2)
+        assert not [f for f in os.listdir(root / "t") if ".tmp." in f]
+
+
+class TestRunStateLifecycle:
+    def test_clean_run_tees_wal_and_lands_done(self, tmp_path):
+        d = str(tmp_path / "atom-cas" / "r1")
+        t = atom_test()
+        t["store-dir"] = d
+        t["generator"] = gen.clients(
+            gen.stagger(0.001, gen.limit(25, gen.cas_gen())))
+        out = core.run(t)
+        assert out["results"]["valid"] is True
+        assert store.run_status(d) == "done"
+        h, stats = journal.read_wal(os.path.join(d, journal.WAL_NAME))
+        assert stats == {"records": len(out["history"]), "torn": 0,
+                         "corrupt": 0}
+        # the WAL is a tee, not a rewrite: history.jsonl is byte-for-byte
+        # what the pre-WAL path wrote
+        jl = open(os.path.join(d, "history.jsonl")).read()
+        expect = "\n".join(
+            json.dumps(o.to_dict(), default=store._json_default)
+            for o in out["history"]) + "\n"
+        assert jl == expect
+
+    def test_live_run_is_not_dead(self, tmp_path):
+        d = str(tmp_path / "t" / "r1")
+        os.makedirs(d)
+        store.write_state(d, "running")  # records OUR (live) pid
+        assert store.run_status(d) == "running"
+        assert store.dead_runs(str(tmp_path)) == []
+
+    def test_pre_wal_run_has_no_status(self, tmp_path):
+        d = str(tmp_path / "t" / "r1")
+        os.makedirs(d)
+        assert store.run_status(d) is None
+        assert store.dead_runs(str(tmp_path)) == []
+
+
+@pytest.mark.chaos
+class TestRecoverEndToEnd:
+    def _dead_run(self, root, torn=True, seed=3):
+        d = os.path.join(root, "synthetic", "r1")
+        os.makedirs(d)
+        h = simulate_register_history(40, n_procs=3, n_vals=4, seed=seed)
+        _write_wal(os.path.join(d, journal.WAL_NAME), h[:-1])
+        if torn:
+            with open(os.path.join(d, journal.WAL_NAME), "ab") as f:
+                f.write(journal.encode_record(h[-1])[:15])
+        _mark_dead(d)
+        return d
+
+    def test_recover_scan_to_verdict(self, tmp_path):
+        root = str(tmp_path)
+        d = self._dead_run(root)
+        assert store.dead_runs(root) == [d]
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = cli.run(cli.default_commands(),
+                         ["recover", "--store-root", root])
+        out = buf.getvalue()
+        assert rc == cli.OK
+        assert "# recovery:" in out and "torn" in out
+        res = json.load(open(os.path.join(d, "results.json")))
+        assert res["valid"] is True
+        assert store.run_status(d) == "recovered"
+        # the reconstructed history is a standard artifact: analyzable
+        loaded = store.load(d)
+        assert len(loaded["history"]) > 0
+        assert loaded["history"].decode_errors == 0
+
+    def test_recover_specific_dir_and_dangling_invokes(self, tmp_path):
+        root = str(tmp_path)
+        d = os.path.join(root, "synthetic", "r1")
+        os.makedirs(d)
+        ops = History.of([
+            {"type": "invoke", "f": "write", "value": 1, "process": 0,
+             "time": 1},
+            {"type": "ok", "f": "write", "value": 1, "process": 0,
+             "time": 2},
+            {"type": "invoke", "f": "read", "value": None, "process": 1,
+             "time": 3},
+        ])
+        _write_wal(os.path.join(d, journal.WAL_NAME), ops)
+        _mark_dead(d)
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = cli.run(cli.default_commands(),
+                         ["recover", "--store", d])
+        assert rc == cli.OK
+        assert "1 dangling invoke(s)" in buf.getvalue()
+        recovered = store.load(d)["history"]
+        infos = [o for o in recovered if o.type == "info"]
+        assert len(infos) == 1 and infos[0].process == 1
+
+    def test_recover_refuses_done_and_running_runs(self, tmp_path):
+        root = str(tmp_path)
+        d = os.path.join(root, "t", "r1")
+        os.makedirs(d)
+        store.write_state(d, "done")
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = cli.run(cli.default_commands(),
+                         ["recover", "--store", d])
+        assert rc == cli.OK and "nothing to recover" in buf.getvalue()
+        store.write_state(d, "running")  # our live pid
+        with contextlib.redirect_stdout(io.StringIO()):
+            rc = cli.run(cli.default_commands(),
+                         ["recover", "--store", d])
+        assert rc == cli.INVALID_ARGS
+
+    def test_recover_without_wal_fails_loudly(self, tmp_path):
+        root = str(tmp_path)
+        d = os.path.join(root, "t", "r1")
+        os.makedirs(d)
+        _mark_dead(d)
+        buf_err = io.StringIO()
+        with contextlib.redirect_stdout(io.StringIO()), \
+                contextlib.redirect_stderr(buf_err):
+            rc = cli.run(cli.default_commands(),
+                         ["recover", "--store-root", root])
+        assert rc == cli.TEST_FAILED
+        assert "nothing to recover" in buf_err.getvalue()
+
+    def test_no_analyze_reconstructs_only(self, tmp_path):
+        root = str(tmp_path)
+        d = self._dead_run(root)
+        with contextlib.redirect_stdout(io.StringIO()):
+            rc = cli.run(cli.default_commands(),
+                         ["recover", "--store-root", root,
+                          "--no-analyze"])
+        assert rc == cli.OK
+        assert os.path.exists(os.path.join(d, "history.jsonl"))
+        assert not os.path.exists(os.path.join(d, "results.json"))
+
+
+@pytest.mark.chaos
+class TestHealProbes:
+    def _ngen(self):
+        yield gen.sleep(0.02)
+        yield gen.once({"type": "info", "f": "start"})
+        yield gen.sleep(0.02)
+        yield gen.once({"type": "info", "f": "stop"})
+
+    def _run(self, nem):
+        t = atom_test()
+        t["store-dir"] = None
+        t["nemesis"] = nem
+        t["generator"] = gen.time_limit(5, gen.clients(
+            gen.stagger(0.02, gen.limit(300, gen.cas_gen())),
+            gen.seq(self._ngen())))
+        return core.run(t)
+
+    def test_heal_verified_recorded(self):
+        nem = nemesis.Noop()
+        nem.heal_probe = nemesis.client_ping_probe(deadline_s=1.0)
+        out = self._run(nem)
+        probes = [o for o in out["history"] if o.f == "heal-verified"]
+        assert probes, [o.f for o in out["history"]
+                        if o.process == "nemesis"]
+        val = probes[0].value
+        assert val["verified"] is True
+        assert set(val["nodes"]) == set(out["nodes"])
+        assert all(v["ok"] for v in val["nodes"].values())
+
+    def test_heal_failed_recorded_with_error(self):
+        nem = nemesis.Noop()
+        nem.heal_probe = lambda test, op: {"verified": False,
+                                           "error": "still partitioned"}
+        out = self._run(nem)
+        failed = [o for o in out["history"] if o.f == "heal-failed"]
+        assert failed and failed[0].error == "still partitioned"
+        assert not [o for o in out["history"] if o.f == "heal-verified"]
+
+    def test_probe_only_fires_on_heal_fs(self):
+        fired = []
+        nem = nemesis.Noop()
+        nem.heal_probe = lambda test, op: (fired.append(op.f),
+                                           {"verified": True})[1]
+        self._run(nem)
+        assert fired == ["stop"]  # never on f=start
+
+    def test_broken_probe_is_a_heal_failure_not_a_crash(self):
+        nem = nemesis.Noop()
+
+        def boom(test, op):
+            raise RuntimeError("probe exploded")
+        nem.heal_probe = boom
+        out = self._run(nem)
+        failed = [o for o in out["history"] if o.f == "heal-failed"]
+        assert failed and "RuntimeError" in failed[0].value["error"]
+
+    def test_compose_routes_probe_to_handling_child(self):
+        routed = []
+        child = nemesis.Noop()
+        child.heal_probe = lambda test, op: (routed.append(op.f),
+                                             {"verified": True})[1]
+        comp = nemesis.compose([({"resume": "stop"}, child)])
+        r = comp.verify_heal({}, Op(type="info", f="resume"))
+        assert routed == ["stop"] and r["verified"] is True
+        assert comp.verify_heal({}, Op(type="info", f="start")) is None
+
+    def test_retry_until_deadline_backoff(self):
+        from jepsen_tpu.resilience import RetryPolicy, retry_until_deadline
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise ConnectionResetError("flake")
+            return True
+
+        ok, attempts, err = retry_until_deadline(
+            flaky, 5.0, policy=RetryPolicy(backoff_base_s=0.001,
+                                           backoff_cap_s=0.002))
+        assert ok and attempts == 3 and err is None
+        ok, attempts, err = retry_until_deadline(
+            lambda: False, 0.05,
+            policy=RetryPolicy(backoff_base_s=0.01, backoff_cap_s=0.01))
+        assert not ok and attempts >= 2 and "falsy" in err
