@@ -1,0 +1,87 @@
+"""Small AST helpers shared by the three code-analysis passes."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional, Tuple
+
+from jepsen_tpu.analysis import ERROR, Finding, relpath
+
+
+def parse_file(path: str, root: Optional[str] = None
+               ) -> Tuple[Optional[ast.Module], Optional[Finding], str]:
+    """Parse a python file. Returns (tree, None, relpath) on success,
+    (None, syntax-finding, relpath) on failure — unparsable code is
+    itself a finding (rule LINT-SYNTAX), not a crash."""
+    rp = relpath(path, root)
+    try:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+    except OSError as e:
+        return None, Finding(rule="LINT-SYNTAX", severity=ERROR, path=rp,
+                             line=0, message=f"unreadable: {e}",
+                             anchor="unreadable"), rp
+    try:
+        return ast.parse(src, filename=path), None, rp
+    except SyntaxError as e:
+        return None, Finding(rule="LINT-SYNTAX", severity=ERROR, path=rp,
+                             line=e.lineno or 0,
+                             message=f"syntax error: {e.msg}",
+                             anchor="syntax"), rp
+
+
+def scope_map(tree: ast.Module) -> Dict[ast.AST, str]:
+    """node -> qualname of the innermost enclosing function/class scope
+    ('' at module level). Drives line-number-independent anchors."""
+    scopes: Dict[ast.AST, str] = {}
+
+    def walk(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            scopes[child] = prefix
+            p = prefix
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                p = f"{prefix}.{child.name}" if prefix else child.name
+            walk(child, p)
+
+    walk(tree, "")
+    return scopes
+
+
+def dotted(func: ast.AST) -> str:
+    """Best-effort dotted name of a call target: Name 'f' -> 'f',
+    Attribute chains 'a.b.c' -> 'a.b.c', anything else -> ''."""
+    parts = []
+    node = func
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    if parts:  # e.g. <call>.attr — keep the attr tail
+        return "." + ".".join(reversed(parts))
+    return ""
+
+
+def snippet(node: ast.AST, limit: int = 60) -> str:
+    """Compact normalized source of a node, for baseline anchors."""
+    try:
+        s = ast.unparse(node)
+    except Exception:  # noqa: BLE001 — very old/odd nodes
+        s = type(node).__name__
+    s = " ".join(s.split())
+    return s[:limit]
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def keyword_arg(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None

@@ -1,0 +1,83 @@
+"""Lint baseline: deliberately-accepted findings, committed with
+justifications, so CI gates on *new* findings only.
+
+Format — one accepted finding per line::
+
+    <rule> <path>#<anchor> — <one-line justification>
+
+The key is line-number-independent (rule + file + structural anchor:
+enclosing qualname / normalized snippet), so baselines survive
+reformatting; ``#`` separates path from anchor and `` — `` (em dash)
+separates the key from its mandatory justification. Lines starting with
+``#`` are comments. The default baseline lives at the repo root as
+``lint.baseline``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from jepsen_tpu.analysis import Finding, repo_root
+
+BASELINE_NAME = "lint.baseline"
+_SEP = " — "  # " — "
+
+
+def default_path(root: Optional[str] = None) -> str:
+    return os.path.join(root or repo_root(), BASELINE_NAME)
+
+
+def load(path: Optional[str] = None,
+         root: Optional[str] = None) -> Dict[str, str]:
+    """key -> justification. A missing file is an empty baseline."""
+    p = path or default_path(root)
+    out: Dict[str, str] = {}
+    if not os.path.exists(p):
+        return out
+    with open(p, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            if _SEP in line:
+                key, just = line.split(_SEP, 1)
+            else:
+                key, just = line, ""
+            out[key.strip()] = just.strip()
+    return out
+
+
+def split(findings: Iterable[Finding], baseline: Dict[str, str]
+          ) -> Tuple[List[Finding], List[Finding]]:
+    """(new, accepted): findings not/covered by the baseline."""
+    new, accepted = [], []
+    for f in findings:
+        (accepted if f.key() in baseline else new).append(f)
+    return new, accepted
+
+
+def render(findings: Iterable[Finding],
+           justifications: Optional[Dict[str, str]] = None) -> str:
+    """Baseline text for a finding set, preserving any existing
+    justifications and stubbing the rest (a stub must be replaced by a
+    real justification before committing — the gate treats the entry as
+    accepted either way, the review process should not)."""
+    justifications = justifications or {}
+    lines = [
+        "# jtpu lint baseline — deliberately accepted findings.",
+        "# One per line: <rule> <path>#<anchor> — <justification>.",
+        "# Regenerate with: python -m jepsen_tpu lint --write-baseline",
+        "",
+    ]
+    for f in sorted(set(x.key() for x in findings)):
+        just = justifications.get(f, "TODO: justify this acceptance")
+        lines.append(f"{f}{_SEP}{just}")
+    return "\n".join(lines) + "\n"
+
+
+def write(path: str, findings: Iterable[Finding],
+          keep_existing: bool = True) -> None:
+    existing = load(path) if keep_existing else {}
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(render(findings, existing))

@@ -1,0 +1,293 @@
+"""Pass 3: JAX hazard linter — jit-unsafe patterns in the checker stack
+and the packed op encoding.
+
+Scope: ``jepsen_tpu/checker/*.py`` and ``jepsen_tpu/ops/encode.py`` —
+the files whose functions end up inside ``jax.jit`` traces. Three
+hazard classes, all of which historically cost device time to discover:
+
+==========================  ========  =================================
+rule                        severity  what it catches
+==========================  ========  =================================
+JAX-HOST-SYNC               error     host-sync calls inside a traced
+                                      body (``.item()``, ``.tolist()``,
+                                      ``np.*`` math, ``print``,
+                                      ``.block_until_ready()``) — these
+                                      either poison the trace or
+                                      silently serialize the device
+JAX-HOST-CAST               warning   ``float()/int()/bool()`` on a
+                                      non-literal inside a traced body
+                                      (a concretization point)
+JAX-UNHASHABLE-STATIC       error     a list/dict/set literal passed to
+                                      an ``lru_cache``'d jit factory
+                                      (``_jit_single``/``_jit_segment``/
+                                      ``_jit_batch``): unhashable keys
+                                      raise — or, worse, near-miss keys
+                                      defeat the compile cache
+JAX-INT32-OVERFLOW          error     an integer literal outside the
+                                      target width in an
+                                      ``int32``/``uint32`` cast (the
+                                      packed encoding is int32 columns)
+JAX-SHIFT-WIDTH             error     a constant shift of >= 32 bits (a
+                                      32-bit lane shifts by the count
+                                      mod 32 on TPU — silent garbage)
+==========================  ========  =================================
+
+Traced-body detection is lexical, not dataflow: a function is traced if
+it is (a) decorated with ``jit``/``jax.jit``, (b) passed by name to
+``jax.jit``, (c) passed by name to ``lax.while_loop``/``lax.scan``/
+``lax.cond``/``vmap``/``pmap``, or (d) lexically nested inside one of
+those. Host-side *builders* that construct constants with numpy before
+returning a traced closure are deliberately not flagged — trace-time
+numpy on static data is legitimate and idiomatic.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from jepsen_tpu.analysis import ERROR, Finding, WARNING
+from jepsen_tpu.analysis.astutil import (dotted, parse_file, scope_map,
+                                         snippet)
+
+#: Call targets that hand a function into a traced context (the passed
+#: function arguments become traced bodies).
+_TRACE_TAKERS = {
+    "jax.jit": None, "jit": None,
+    "lax.while_loop": None, "jax.lax.while_loop": None,
+    "lax.scan": None, "jax.lax.scan": None,
+    "lax.cond": None, "jax.lax.cond": None,
+    "lax.fori_loop": None, "jax.lax.fori_loop": None,
+    "jax.vmap": None, "vmap": None,
+    "jax.pmap": None, "pmap": None,
+}
+
+#: Method calls that force a device->host sync (or break tracing).
+_SYNC_METHODS = ("item", "tolist", "block_until_ready")
+
+#: numpy module aliases whose calls inside a traced body are hazards.
+_NP_NAMES = ("np", "numpy")
+
+INT32_MIN, INT32_MAX = -(2 ** 31), 2 ** 31 - 1
+UINT32_MAX = 2 ** 32 - 1
+
+
+def _const_int(node: ast.AST) -> Optional[int]:
+    """Fold a compile-time integer expression (literals combined with
+    + - * ** << >> & | and unary +/-, e.g. ``2**31 - 1``)."""
+    if isinstance(node, ast.Constant):
+        v = node.value
+        return v if isinstance(v, int) and not isinstance(v, bool) \
+            else None
+    if isinstance(node, ast.UnaryOp) and isinstance(
+            node.op, (ast.USub, ast.UAdd)):
+        v = _const_int(node.operand)
+        if v is None:
+            return None
+        return -v if isinstance(node.op, ast.USub) else v
+    if isinstance(node, ast.BinOp):
+        left, right = _const_int(node.left), _const_int(node.right)
+        if left is None or right is None:
+            return None
+        op = node.op
+        try:
+            if isinstance(op, ast.Add):
+                return left + right
+            if isinstance(op, ast.Sub):
+                return left - right
+            if isinstance(op, ast.Mult):
+                return left * right
+            if isinstance(op, ast.Pow) and 0 <= right <= 128:
+                return left ** right
+            if isinstance(op, ast.LShift) and 0 <= right <= 128:
+                return left << right
+            if isinstance(op, ast.RShift) and 0 <= right <= 128:
+                return left >> right
+            if isinstance(op, ast.BitAnd):
+                return left & right
+            if isinstance(op, ast.BitOr):
+                return left | right
+        except (OverflowError, ValueError):
+            return None
+    return None
+
+
+class _Regions(ast.NodeVisitor):
+    """Collect the traced-body function set.
+
+    Two root flavors with different closure behavior: *loop roots*
+    (while_loop/scan/cond/vmap bodies) execute per traced step, so
+    helpers they call by name are traced too and the region closes over
+    the call graph. *jit roots* (functions handed to ``jax.jit``) are
+    scanned directly but do NOT seed the call closure: a jitted wrapper
+    commonly calls a host-side *builder* that precomputes numpy
+    constants before returning the traced closure, and flagging builder
+    numpy would be noise (trace-time numpy on static data is idiom)."""
+
+    def __init__(self):
+        self.defs: Dict[str, List[ast.AST]] = {}
+        self.jit_roots: Set[str] = set()
+        self.loop_roots: Set[str] = set()
+
+    def visit_FunctionDef(self, node):
+        self.defs.setdefault(node.name, []).append(node)
+        for dec in node.decorator_list:
+            d = dotted(dec.func) if isinstance(dec, ast.Call) \
+                else dotted(dec)
+            if d in ("jit", "jax.jit") or d.endswith(".jit"):
+                self.jit_roots.add(node.name)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node):
+        name = dotted(node.func)
+        if name in _TRACE_TAKERS:
+            dest = (self.jit_roots if name.endswith("jit")
+                    else self.loop_roots)
+            for arg in list(node.args) + [kw.value
+                                          for kw in node.keywords]:
+                if isinstance(arg, ast.Name):
+                    dest.add(arg.id)
+        self.generic_visit(node)
+
+
+def _region_nodes(tree: ast.Module) -> List[ast.AST]:
+    """All function defs that are traced bodies: the roots, every def
+    lexically nested inside a root, and (for loop roots) the same-file
+    helpers they call by name."""
+    r = _Regions()
+    r.visit(tree)
+    out: List[ast.AST] = []
+    worklist: List[ast.AST] = []
+    seen: Set[int] = set()
+
+    def take(fn, close: bool):
+        if id(fn) in seen:
+            return
+        seen.add(id(fn))
+        out.append(fn)
+        if close:
+            worklist.append(fn)
+        for node in ast.walk(fn):
+            if node is not fn and isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                take(node, close)
+
+    # Loop roots first: a loop body lexically nested inside a jitted
+    # wrapper must still get the call closure (take() marks nodes seen
+    # on first visit, so order decides which flavor wins).
+    for name in r.loop_roots:
+        for fn in r.defs.get(name, ()):
+            take(fn, close=True)
+    for name in r.jit_roots:
+        for fn in r.defs.get(name, ()):
+            take(fn, close=False)
+    while worklist:
+        fn = worklist.pop()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name):
+                for cand in r.defs.get(node.func.id, ()):
+                    take(cand, close=True)
+    return out
+
+
+def _lru_cached_names(tree: ast.Module) -> Set[str]:
+    out: Set[str] = set()
+    for node in tree.body:
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in node.decorator_list:
+            d = dotted(dec.func) if isinstance(dec, ast.Call) \
+                else dotted(dec)
+            if "lru_cache" in d or d.endswith(".cache"):
+                out.add(node.name)
+    return out
+
+
+def lint_file(path: str, root: Optional[str] = None) -> List[Finding]:
+    tree, err, rp = parse_file(path, root)
+    if tree is None:
+        return [err]
+    scopes = scope_map(tree)
+    findings: List[Finding] = []
+
+    def add(rule, sev, node, msg):
+        findings.append(Finding(
+            rule=rule, severity=sev, path=rp,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0), message=msg,
+            anchor=f"{scopes.get(node, '')}/{snippet(node)}"))
+
+    # -- traced-body hazards ------------------------------------------------
+    flagged: Set[int] = set()
+    for fn in _region_nodes(tree):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) or id(node) in flagged:
+                continue
+            name = dotted(node.func)
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _SYNC_METHODS \
+                    and not node.args:
+                flagged.add(id(node))
+                add("JAX-HOST-SYNC", ERROR, node,
+                    f".{node.func.attr}() inside the traced body "
+                    f"{fn.name!r} forces a device->host sync (or "
+                    f"fails tracing outright)")
+            elif name.split(".", 1)[0] in _NP_NAMES and "." in name:
+                flagged.add(id(node))
+                add("JAX-HOST-SYNC", ERROR, node,
+                    f"{name}() inside the traced body {fn.name!r}: "
+                    f"numpy runs on host — use jnp/lax so the op "
+                    f"stays on device")
+            elif name == "print":
+                flagged.add(id(node))
+                add("JAX-HOST-SYNC", ERROR, node,
+                    f"print() inside the traced body {fn.name!r} "
+                    f"(use jax.debug.print for traced values)")
+            elif name in ("float", "int", "bool") and node.args \
+                    and _const_int(node.args[0]) is None \
+                    and not isinstance(node.args[0], ast.Constant):
+                flagged.add(id(node))
+                add("JAX-HOST-CAST", WARNING, node,
+                    f"{name}() on a traced value inside {fn.name!r} "
+                    f"is a concretization point (breaks under jit)")
+
+    # -- whole-file hazards -------------------------------------------------
+    cached = _lru_cached_names(tree)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = dotted(node.func)
+            if name in cached:
+                for arg in list(node.args) + [kw.value
+                                              for kw in node.keywords]:
+                    if isinstance(arg, (ast.List, ast.Dict, ast.Set,
+                                        ast.ListComp, ast.DictComp,
+                                        ast.SetComp)):
+                        add("JAX-UNHASHABLE-STATIC", ERROR, node,
+                            f"unhashable {type(arg).__name__.lower()} "
+                            f"literal passed to the lru_cache'd jit "
+                            f"factory {name}() — raises TypeError and "
+                            f"defeats the compile cache")
+            tail = name.rsplit(".", 1)[-1]
+            if tail in ("int32", "uint32") and len(node.args) == 1:
+                v = _const_int(node.args[0])
+                if v is not None:
+                    lo, hi = ((0, UINT32_MAX) if tail == "uint32"
+                              else (INT32_MIN, INT32_MAX))
+                    if not (lo <= v <= hi):
+                        add("JAX-INT32-OVERFLOW", ERROR, node,
+                            f"literal {v} does not fit {tail} "
+                            f"[{lo}, {hi}] — the packed encoding "
+                            f"would silently wrap")
+        elif isinstance(node, ast.BinOp) and isinstance(
+                node.op, (ast.LShift, ast.RShift)):
+            sh = _const_int(node.right)
+            if sh is not None and sh >= 32 and \
+                    _const_int(node.left) is None:
+                add("JAX-SHIFT-WIDTH", ERROR, node,
+                    f"constant shift by {sh} bits: a 32-bit lane "
+                    f"shifts modulo 32 on device — this is silent "
+                    f"garbage, widen the type or split the shift")
+    return findings

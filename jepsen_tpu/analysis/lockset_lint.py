@@ -1,0 +1,123 @@
+"""Pass 4: lockset linter — a static race detector for the threaded
+orchestrator.
+
+``core.conj_op`` is THE serialization point (core.clj:43-47): every
+worker, the nemesis thread, and the WAL tee append through it under
+``test["_history_lock"]``. The state that lock guards —
+``test["_active_histories"]`` (the list of histories ops fan into) and
+``test["_journal"]`` (the write-ahead journal handle) — must therefore
+never be read or mutated off-lock while those threads can be live, or
+ops race with the tee and recovery order diverges from history order.
+
+This pass is lexical lockset analysis over the orchestrator files
+(``core.py``, ``journal.py``, ``nemesis/``): any access to a guarded
+key outside a ``with <x>["_history_lock"]`` block is flagged.
+
+==========================  ========  =================================
+rule                        severity  what it catches
+==========================  ========  =================================
+LOCK-UNGUARDED              error     read/mutation of guarded state
+                                      (method call, iteration,
+                                      subscript read) off-lock
+LOCK-LIFECYCLE              warning   off-lock lifecycle transitions
+                                      (``setdefault``/``pop`` of a
+                                      guarded key) — racy unless the
+                                      call site can prove no other
+                                      thread is live
+LINT-SYNTAX                 error     the module does not parse
+==========================  ========  =================================
+
+Plain assignments that *create* a guarded key (``test[k] = ...``) are
+treated as initialization and not flagged: publishing fresh state
+before threads exist is the normal construction pattern.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from jepsen_tpu.analysis import ERROR, Finding, WARNING
+from jepsen_tpu.analysis.astutil import parse_file, scope_map, snippet
+
+#: Keys of test-map state serialized by the history lock.
+GUARDED_KEYS = ("_active_histories", "_journal")
+
+LOCK_KEY = "_history_lock"
+
+
+def _const(node: ast.AST):
+    return node.value if isinstance(node, ast.Constant) else None
+
+
+def _is_lock_ctx(expr: ast.AST) -> bool:
+    """Does a with-item context expression acquire the history lock?
+    Matches ``<x>["_history_lock"]`` and ``<x>.get("_history_lock")``."""
+    if isinstance(expr, ast.Subscript) and _const(expr.slice) == LOCK_KEY:
+        return True
+    if isinstance(expr, ast.Call) and \
+            isinstance(expr.func, ast.Attribute) and \
+            expr.func.attr == "get" and expr.args and \
+            _const(expr.args[0]) == LOCK_KEY:
+        return True
+    return False
+
+
+def _guarded_ids(tree: ast.Module) -> Set[int]:
+    """ids of all nodes lexically inside a history-lock with-block."""
+    out: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)) and any(
+                _is_lock_ctx(item.context_expr) for item in node.items):
+            for stmt in node.body:
+                for sub in ast.walk(stmt):
+                    out.add(id(sub))
+    return out
+
+
+def lint_file(path: str, root: Optional[str] = None) -> List[Finding]:
+    tree, err, rp = parse_file(path, root)
+    if tree is None:
+        return [err]
+    scopes = scope_map(tree)
+    guarded = _guarded_ids(tree)
+    findings: List[Finding] = []
+
+    # Assignment targets that create a key are initialization.
+    init_targets: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    init_targets.add(id(t))
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)) and \
+                isinstance(node.target, ast.Subscript):
+            init_targets.add(id(node.target))
+
+    def add(rule, sev, node, key, what):
+        findings.append(Finding(
+            rule=rule, severity=sev, path=rp, line=node.lineno,
+            col=node.col_offset,
+            message=f"{what} of lock-guarded {key!r} outside a "
+                    f"'with ...[\"{LOCK_KEY}\"]' block",
+            anchor=f"{scopes.get(node, '')}/{snippet(node)}"))
+
+    for node in ast.walk(tree):
+        if id(node) in guarded:
+            continue
+        if isinstance(node, ast.Subscript):
+            key = _const(node.slice)
+            if key in GUARDED_KEYS and id(node) not in init_targets:
+                add("LOCK-UNGUARDED", ERROR, node, key, "access")
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and node.args:
+            key = _const(node.args[0])
+            if key not in GUARDED_KEYS:
+                continue
+            attr = node.func.attr
+            if attr in ("setdefault", "pop"):
+                add("LOCK-LIFECYCLE", WARNING, node, key,
+                    f"{attr}()")
+            elif attr == "get":
+                add("LOCK-UNGUARDED", ERROR, node, key, "get()")
+    return findings

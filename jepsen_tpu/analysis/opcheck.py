@@ -1,0 +1,62 @@
+"""Shared op-shape validation — the ONE place that knows what a legal
+operation looks like.
+
+Both the runtime decode path (:meth:`jepsen_tpu.history.Op.from_dict`)
+and the static/history linters (:mod:`jepsen_tpu.analysis.history_lint`,
+:mod:`jepsen_tpu.analysis.suite_lint`) call into this module, so the
+lint rule and the runtime guard can never drift apart: an op `type` the
+linter rejects is exactly an op `type` the decoder flags.
+
+Deliberately dependency-free (imports nothing from the package) so the
+low-level :mod:`jepsen_tpu.history` can import it without cycles.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+#: The only legal op types (jepsen core.clj:157-163; knossos.op). Kept as
+#: a plain tuple here — history.py re-exports its own VALID_TYPES built
+#: from the same literal values, asserted equal in tests.
+VALID_OP_TYPES = ("invoke", "ok", "fail", "info")
+
+#: Op types that are completions (everything but the invocation).
+COMPLETION_TYPES = ("ok", "fail", "info")
+
+#: The extra-dict key the runtime decode path uses to flag an op whose
+#: type failed validation (the op is tolerated, not dropped: a single
+#: corrupt record must not unload a 100k-op history, but checkers and
+#: the pre-search gate must be able to see it was damaged).
+INVALID_TYPE_FLAG = "lint:invalid-type"
+
+
+def invalid_op_type(t: Any) -> Optional[str]:
+    """None when ``t`` is a legal op type; else a short reason string.
+
+    This is the shared validation function: the HIST-OP-TYPE lint rule
+    and ``Op.from_dict``'s runtime guard both call it.
+    """
+    if t in VALID_OP_TYPES:
+        return None
+    return (f"op type {t!r} is not one of "
+            f"{'/'.join(VALID_OP_TYPES)}")
+
+
+def check_op_dict(d: dict) -> Optional[str]:
+    """Validate a raw (decoded) op dict's shape; None when well-formed.
+
+    Checks only what every op must satisfy regardless of workload:
+    a legal ``type`` and, for invocations, the presence of ``f`` (a
+    completion inherits its invocation's f, but an invoke with no f is
+    unmatchable by any model).
+    """
+    if not isinstance(d, dict):
+        return "op is not a dict"
+    if "type" not in d:
+        return "op has no 'type' key"
+    bad = invalid_op_type(d.get("type"))
+    if bad:
+        return bad
+    if d.get("type") == "invoke" and d.get("f") is None:
+        return "invoke op has no 'f'"
+    return None

@@ -1201,7 +1201,10 @@ def check_history_sharded(history: History, model: Model,
                           mesh: "jax.sharding.Mesh",
                           **kwargs) -> Optional[Dict[str, Any]]:
     """Pack + pool-sharded check (see check_packed_sharded). None when
-    the model has no integer kernel."""
+    the model has no integer kernel. Gated like check_history_tpu: a
+    malformed history is rejected before packing or compilation."""
+    from jepsen_tpu.analysis.history_lint import gate_history
+    gate_history(history, where="the pool-sharded device search")
     try:
         pk = pack_with_init(history, model)
     except ValueError:
@@ -1258,9 +1261,20 @@ def check_history_tpu(history: History, model: Model,
 
     Returns None when the model has no single-word integer kernel (the
     caller then uses the generic CPU object search).
+
+    The history passes the mandatory pre-search gate first
+    (:func:`jepsen_tpu.analysis.history_lint.gate_history`): a
+    structurally malformed history — unmatched completions, process
+    reuse, illegal op types, non-monotonic indices — raises
+    :class:`~jepsen_tpu.analysis.history_lint.MalformedHistoryError`
+    with rule ids and positions BEFORE any packing or jit compilation,
+    instead of wedging or poisoning a device search a 10 ms host walk
+    could have refused.
     """
     if window is not None:
         _check_window(window)
+    from jepsen_tpu.analysis.history_lint import gate_history
+    gate_history(history, where="the packed device search")
     try:
         pk = pack_with_init(history, model)
     except ValueError:  # op f unsupported by the integer kernel
@@ -1304,9 +1318,22 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
     accel.ensure_usable("check_keyed_tpu")
     results: Dict[Any, Dict[str, Any]] = {}
     packed: Dict[Any, PackedHistory] = {}
+    from jepsen_tpu.analysis import summarize
+    from jepsen_tpu.analysis.history_lint import (MalformedHistoryError,
+                                                  gate_history)
     for k in keys:
         try:
+            # Per-key pre-search gate: a malformed key goes UNKNOWN
+            # with rule ids (the batch must not abort, matching the
+            # per-key encode-failure contract below), and never reaches
+            # the packed encoder or a compilation.
+            gate_history(keyed[k], where=f"the keyed device search "
+                                         f"(key {k!r})")
             packed[k] = pack_with_init(keyed[k], model, kernel)[0]
+        except MalformedHistoryError as e:
+            results[k] = {"valid": UNKNOWN, "backend": "tpu",
+                          "error": str(e),
+                          "lint": summarize(e.findings)}
         except ValueError as e:
             # One key with an op the integer kernel can't encode must not
             # abort the batch; the caller can fall back per key.

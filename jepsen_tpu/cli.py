@@ -298,6 +298,13 @@ def analyze_cmd() -> dict:
         if test is None:
             print("no stored test found", file=sys.stderr)
             return INVALID_ARGS
+        # Offline histories are arbitrary disk artifacts: surface their
+        # structural lint summary (counts by rule) before re-checking,
+        # so a damaged history is diagnosed here and not mid-search.
+        from jepsen_tpu import analysis
+        from jepsen_tpu.analysis.history_lint import lint_history
+        print(analysis.summary_line(
+            lint_history(test.get("history") or [])))
         checker = linearizable(models[opts["model"]](),
                                backend=opts["backend"],
                                algorithm=opts["algorithm"])
@@ -379,6 +386,28 @@ def recover_cmd() -> dict:
                   f"({s['records']} WAL records, {s['torn']} torn, "
                   f"{s['corrupt']} corrupt, {s['reconciled']} dangling "
                   f"invoke(s) -> info)")
+            # Structural lint of the reconstructed history, printed
+            # alongside the recovery stats; error-severity findings
+            # (e.g. a corrupt WAL dropped a completion mid-stream and
+            # left a process reusing itself) fail the recovery with a
+            # diagnostic instead of feeding a damaged history to the
+            # checker.
+            from jepsen_tpu import analysis
+            from jepsen_tpu.analysis import history_lint as hl
+            # decode damage (corrupt/torn records) already degraded
+            # gracefully inside read_wal and is reported above — the
+            # gate here is about STRUCTURE the reconciler couldn't fix.
+            findings = hl.lint_history(rec["history"], decode_errors=0)
+            print(analysis.summary_line(findings))
+            errs = hl.errors(findings)
+            if errs:
+                for f in errs[:10]:
+                    print(f"# lint: {d}: {f.format()}", file=sys.stderr)
+                print(f"# recovery: {d}: FAILED: recovered history is "
+                      f"malformed ({len(errs)} error finding(s); see "
+                      f"above)", file=sys.stderr)
+                worst = TEST_FAILED
+                continue
             if opts.get("no_analyze"):
                 continue
             test = store.load(d)
@@ -394,6 +423,95 @@ def recover_cmd() -> dict:
         return worst
 
     return {"recover": {"parser": build_parser, "run": run_}}
+
+
+def lint_cmd() -> dict:
+    """The 'lint' subcommand: the four-pass static analyzer
+    (jepsen_tpu.analysis) — suite linter, history linter, JAX hazard
+    pass, lockset pass — gated against the committed baseline so CI
+    fails on NEW findings only. See doc/lint.md for the rule catalog."""
+
+    def build_parser():
+        from jepsen_tpu import analysis
+        p = Parser(prog="lint",
+                   description="Static analysis: reject broken suites, "
+                               "malformed histories, and JAX kernel "
+                               "hazards before they burn device time.")
+        p.add_argument("paths", nargs="*", metavar="PATH",
+                       help="files to lint (.py through the code "
+                            "passes, .jsonl/.wal through the history "
+                            "pass); default: the whole repo at the "
+                            "standard scopes")
+        p.add_argument("--history", action="append", default=[],
+                       metavar="FILE",
+                       help="additionally lint a saved history "
+                            "artifact (repeatable)")
+        p.add_argument("--pass", dest="passes", action="append",
+                       choices=list(analysis.PASSES), metavar="PASS",
+                       help=f"run only these passes (repeatable; "
+                            f"choices: {', '.join(analysis.PASSES)})")
+        p.add_argument("--baseline", default=None, metavar="FILE",
+                       help="baseline file (default: lint.baseline at "
+                            "the repo root)")
+        p.add_argument("--no-baseline", action="store_true",
+                       help="ignore the baseline: report everything")
+        p.add_argument("--write-baseline", action="store_true",
+                       help="accept the current findings into the "
+                            "baseline file (existing justifications "
+                            "are preserved; new entries get a TODO "
+                            "stub to fill in before committing)")
+        p.add_argument("--strict", action="store_true",
+                       help="exit nonzero on new warnings too, not "
+                            "just errors")
+        p.add_argument("--format", default="text",
+                       choices=["text", "json"])
+        p.add_argument("--root", default=None,
+                       help="repo root override (fixtures/tests)")
+        return p
+
+    def run_(opts) -> int:
+        import json as _json
+
+        from jepsen_tpu import analysis
+        from jepsen_tpu.analysis import baseline as bl
+        root = opts.get("root") or analysis.repo_root()
+        passes = tuple(opts.get("passes") or analysis.PASSES)
+        if opts["paths"]:
+            findings = analysis.lint_files(
+                list(opts["paths"]) + list(opts["history"]),
+                passes=passes, root=root)
+        else:
+            findings = analysis.lint_repo(root=root, passes=passes,
+                                          histories=opts["history"])
+
+        bpath = opts.get("baseline") or bl.default_path(root)
+        if opts.get("write_baseline"):
+            bl.write(bpath, findings)
+            print(f"# lint: baseline written to {bpath} "
+                  f"({len(findings)} finding(s))")
+            return OK
+        accepted_keys = {} if opts.get("no_baseline") else bl.load(bpath)
+        new, accepted = bl.split(findings, accepted_keys)
+
+        if opts["format"] == "json":
+            print(_json.dumps({
+                "findings": [vars(f) for f in new],
+                "accepted": [vars(f) for f in accepted],
+                "counts": analysis.summarize(new),
+            }, indent=2))
+        else:
+            for f in sorted(new, key=lambda x: (x.path, x.line)):
+                print(f.format())
+            print(analysis.summary_line(new))
+            if accepted:
+                print(f"# lint: {len(accepted)} finding(s) accepted "
+                      f"by {bpath}")
+        gate = [f for f in new
+                if f.severity == "error"
+                or (opts.get("strict") and f.severity == "warning")]
+        return TEST_FAILED if gate else OK
+
+    return {"lint": {"parser": build_parser, "run": run_}}
 
 
 def merge_commands(*cmds: dict) -> dict:
@@ -443,10 +561,10 @@ def main(subcommands: Dict[str, dict],
 
 
 def default_commands() -> dict:
-    """The stock subcommand set: runner + analyzer + recovery + server
-    (what ``python -m jepsen_tpu`` dispatches)."""
+    """The stock subcommand set: runner + analyzer + recovery + linter
+    + server (what ``python -m jepsen_tpu`` dispatches)."""
     return merge_commands(suite_run_cmd(), analyze_cmd(), recover_cmd(),
-                          serve_cmd())
+                          lint_cmd(), serve_cmd())
 
 
 if __name__ == "__main__":  # default main

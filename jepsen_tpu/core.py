@@ -262,7 +262,8 @@ def run_case(test: dict) -> History:
     history = History()
     test.setdefault("_history_lock", threading.Lock())
     test.setdefault("_active_histories", [])
-    test["_active_histories"].append(history)
+    with test["_history_lock"]:
+        test["_active_histories"].append(history)
 
     nemesis_obj = test.get("nemesis")
     if nemesis_obj is not None:
@@ -321,7 +322,11 @@ def run_case(test: dict) -> History:
             except Exception:  # noqa: BLE001
                 log.warning("net.heal failed during teardown: %s",
                             traceback.format_exc())
-        test["_active_histories"].remove(history)
+        # Under the lock: a wedged nemesis thread abandoned above may
+        # still be appending through conj_op — an unlocked remove races
+        # with its iteration over the active-history list.
+        with test["_history_lock"]:
+            test["_active_histories"].remove(history)
     return history
 
 

@@ -22,6 +22,8 @@ import json
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterable, Iterator, List, Optional, Union
 
+from jepsen_tpu.analysis.opcheck import INVALID_TYPE_FLAG, invalid_op_type
+
 # Process id of the nemesis pseudo-process. The reference uses the keyword
 # :nemesis (core.clj:267-309); we use a negative sentinel so process columns
 # stay integral, with NEMESIS exposed symbolically at the API level.
@@ -100,6 +102,15 @@ class Op:
     def from_dict(cls, d: dict) -> "Op":
         known = {"type", "f", "value", "process", "time", "index", "error"}
         extra = {k: v for k, v in d.items() if k not in known}
+        # Tolerate-and-flag an illegal op type (shared validation with
+        # the HIST-OP-TYPE lint rule): the op is kept — one corrupt
+        # record must not unload a whole history — but it carries the
+        # flag, so History.from_jsonl counts it and the pre-search gate
+        # (jepsen_tpu.analysis.history_lint) rejects the history with a
+        # diagnostic instead of letting it flow silently into a checker.
+        bad = invalid_op_type(d["type"])
+        if bad and INVALID_TYPE_FLAG not in extra:
+            extra[INVALID_TYPE_FLAG] = bad
         return cls(
             type=d["type"],
             f=d.get("f"),
@@ -274,15 +285,23 @@ class History(List[Op]):
     #: Lines from_jsonl could not decode (truncated/corrupted artifact).
     decode_errors: int = 0
 
+    #: Decoded ops whose 'type' failed validation (tolerated but
+    #: flagged by Op.from_dict; the history linter's HIST-OP-TYPE rule
+    #: and the pre-search gate key off the same flag).
+    type_errors: int = 0
+
     @classmethod
     def from_jsonl(cls, text: str) -> "History":
         """Parse a saved history. Undecodable lines are *skipped and
         counted* (``decode_errors``) rather than raised: a truncated or
         corrupted history.jsonl degrades to a warning, keeping the rest
-        of the run analyzable offline."""
+        of the run analyzable offline. Decodable ops with an illegal
+        ``type`` are kept but flagged (``type_errors``) — the
+        pre-search gate rejects them with a rule id instead of letting
+        them corrupt a checker silently."""
         import logging
         h = cls()
-        bad = 0
+        bad = bad_types = 0
         for i, line in enumerate(text.splitlines()):
             line = line.strip()
             if not line:
@@ -291,13 +310,20 @@ class History(List[Op]):
                 d = json.loads(line)
                 if not isinstance(d, dict) or "type" not in d:
                     raise ValueError("not an op dict")
-                h.append(Op.from_dict(d))
+                op = Op.from_dict(d)
+                if op.extra and INVALID_TYPE_FLAG in op.extra:
+                    bad_types += 1
+                    logging.getLogger("jepsen").warning(
+                        "history.jsonl line %d: %s", i + 1,
+                        op.extra[INVALID_TYPE_FLAG])
+                h.append(op)
             except (ValueError, TypeError, KeyError):
                 bad += 1
                 logging.getLogger("jepsen").warning(
                     "history.jsonl line %d is undecodable; skipping it",
                     i + 1)
         h.decode_errors = bad
+        h.type_errors = bad_types
         return h
 
 

@@ -31,6 +31,10 @@ def pytest_configure(config):
         "markers", "chaos: injected-fault resilience scenarios (OOM, "
         "wedge, kill-mid-segment, hung client); tools/chaos_matrix.py "
         "sweeps the grid standalone with -m chaos")
+    config.addinivalue_line(
+        "markers", "lint: static-analysis subsystem tests "
+        "(tests/test_lint.py): per-pass fixtures, the pre-search "
+        "history gate, and the repo self-lint against lint.baseline")
 
 
 def pytest_collection_modifyitems(config, items):

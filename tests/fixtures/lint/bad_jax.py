@@ -1,0 +1,53 @@
+"""Synthetic BAD JAX fixture: every hazard the JAX pass owns should
+fire somewhere in this file. Never imported — AST fodder only."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@functools.lru_cache(maxsize=8)
+def _jit_thing(kernel_id, capacity, window):
+    def run(x):
+        return x * capacity
+
+    return jax.jit(run)
+
+
+def search(xs):
+    def cond(c):
+        # JAX-HOST-SYNC: numpy inside a traced loop condition
+        return np.any(c[1] > 0)
+
+    def body(c):
+        k, m = c
+        # JAX-HOST-SYNC: .item() forces a device->host sync
+        v = m.item()
+        # JAX-HOST-SYNC: print inside a traced body
+        print("level", v)
+        # JAX-HOST-CAST: int() on a traced value concretizes
+        return k + int(m[0]), helper(m)
+
+    return lax.while_loop(cond, body, (jnp.int32(0), xs))
+
+
+def helper(m):
+    # JAX-HOST-SYNC: reached from the traced body via the call closure
+    return jnp.asarray(np.cumsum(m))
+
+
+def launch(xs):
+    # JAX-UNHASHABLE-STATIC: a list literal defeats the lru_cache key
+    fn = _jit_thing(1, [128, 8], 32)
+    return fn(xs)
+
+
+def pack(v):
+    # JAX-INT32-OVERFLOW: 2**40 cannot fit an int32 column
+    hi = np.int32(2 ** 40)
+    # JAX-SHIFT-WIDTH: a 32-bit lane shifts modulo 32 on device
+    lo = v << 33
+    return hi, lo

@@ -1,0 +1,35 @@
+"""Synthetic BAD lockset fixture: guarded-state access off-lock. Never
+imported — AST fodder only."""
+
+
+def conj_op_ok(test, op):
+    with test["_history_lock"]:
+        for h in test["_active_histories"]:
+            h.append(op)
+        j = test.get("_journal")
+        if j is not None:
+            j.append(op)
+    return op
+
+
+def racy_reader(test):
+    # LOCK-UNGUARDED: iterating the active-history list off-lock races
+    # with conj_op's append
+    return [len(h) for h in test["_active_histories"]]
+
+
+def racy_tee(test, op):
+    # LOCK-UNGUARDED: the journal handle read off-lock
+    j = test.get("_journal")
+    if j is not None:
+        j.append(op)
+
+
+def racy_lifecycle(test):
+    # LOCK-LIFECYCLE: pop off-lock while threads may be live
+    test.pop("_journal", None)
+
+
+def init_is_fine(test):
+    # plain assignment creates the key: initialization, not flagged
+    test["_active_histories"] = []

@@ -1,0 +1,54 @@
+"""Synthetic BAD suite fixture: every rule the suite linter owns should
+fire somewhere in this file. Never imported — AST fodder only."""
+
+import socket
+import urllib.request
+
+from jepsen_tpu import client as client_ns
+from jepsen_tpu import generator as gen
+from jepsen_tpu.history import Op
+
+
+class BrokenClient(client_ns.Client):
+    """SUITE-CLIENT-NO-INVOKE: subclasses the protocol root but never
+    implements invoke — its worker dies on the first op."""
+
+    def open(self, test, node):
+        return self
+
+
+class StallingClient(client_ns.Client):
+    def open(self, test, node):
+        return self
+
+    def _rpc(self):
+        # SUITE-BLOCKING-NO-TIMEOUT (reached from invoke via self._rpc)
+        sock = socket.create_connection(("127.0.0.1", 1234))
+        return sock
+
+    def invoke(self, test, op):
+        # SUITE-BLOCKING-NO-TIMEOUT (directly on the invoke path)
+        urllib.request.urlopen("http://127.0.0.1:1234/kv")
+        self._rpc()
+        return op.replace(type="ok")
+
+
+def bad_ops():
+    # SUITE-OP-TYPE: 'invokee' is not a legal op type
+    yield gen.once({"type": "invokee", "f": "read", "value": None})
+    # SUITE-OP-NO-F: an op template with no f is unmatchable
+    yield gen.once({"type": "invoke", "value": 42})
+    # SUITE-OP-TYPE via the Op constructor
+    yield Op(type="complete", f="read")
+    # SUITE-OP-NO-F via the Op constructor
+    yield Op(type="invoke")
+
+
+def complete(op):
+    # SUITE-OP-TYPE via op.replace: 'done' is not a completion type
+    return op.replace(type="done")
+
+
+def broken_test(opts, extra_required):
+    """SUITE-CTOR-ARITY: not callable with one opts dict."""
+    return {"name": "broken", "client": BrokenClient()}

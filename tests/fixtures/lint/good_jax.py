@@ -1,0 +1,40 @@
+"""Synthetic GOOD JAX fixture: trace-time numpy in a host-side builder
+plus a clean device body — the JAX pass must report nothing. Never
+imported — AST fodder only."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@functools.lru_cache(maxsize=8)
+def _jit_thing(kernel_id, capacity, window):
+    # host-side builder: numpy on STATIC data at trace time is idiom
+    bitmat = np.zeros((window, 1), dtype=np.uint32)
+    for o in range(window):
+        bitmat[o, 0] = np.uint32(1) << np.uint32(o & 31)
+
+    def run(x):
+        def cond(c):
+            return jnp.any(c > 0)
+
+        def body(c):
+            return c - jnp.asarray(bitmat).sum().astype(jnp.int32)
+
+        return lax.while_loop(cond, body, x)
+
+    return jax.jit(run)
+
+
+def launch(xs):
+    fn = _jit_thing(1, 128, 32)
+    return fn(xs)
+
+
+def pack(v):
+    hi = np.int32(2 ** 31 - 1)
+    lo = v << 31
+    return hi, lo

@@ -1,0 +1,33 @@
+"""Synthetic GOOD suite fixture: structurally clean — the suite linter
+must report nothing here. Never imported — AST fodder only."""
+
+import socket
+
+from jepsen_tpu import client as client_ns
+from jepsen_tpu import generator as gen
+
+
+class FineClient(client_ns.Client):
+    def __init__(self, timeout: float = 2.0):
+        self.timeout = timeout
+
+    def open(self, test, node):
+        return self
+
+    def invoke(self, test, op):
+        with socket.create_connection(("127.0.0.1", 1234),
+                                      timeout=self.timeout):
+            pass
+        return op.replace(type="ok")
+
+
+def ops():
+    yield gen.once({"type": "invoke", "f": "read", "value": None})
+    yield gen.once({"type": "info", "f": "start"})
+    # a non-op record: 'type' is exotic AND there is no 'f' — skipped
+    yield {"type": "wrong-total", "expected": 10, "found": 9}
+
+
+def fine_test(opts):
+    return {"name": "fine", "client": FineClient(),
+            "generator": ops()}

@@ -1,0 +1,388 @@
+"""The static-analysis subsystem (jepsen_tpu.analysis): per-pass unit
+tests over synthetic good/bad fixtures, the pre-search history gate,
+the shared op-type validation, the baseline machinery, and a self-lint
+asserting the repo is clean against its committed baseline. All tier-1
+(marker: lint)."""
+
+import io
+import json
+import os
+import sys
+
+import pytest
+
+from jepsen_tpu import analysis, cli
+from jepsen_tpu.analysis import baseline as bl
+from jepsen_tpu.analysis import history_lint as hl
+from jepsen_tpu.analysis.opcheck import (INVALID_TYPE_FLAG,
+                                         VALID_OP_TYPES, invalid_op_type)
+from jepsen_tpu.history import History, Op, VALID_TYPES
+
+pytestmark = pytest.mark.lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIX = os.path.join(REPO, "tests", "fixtures", "lint")
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+def _lint(path, **kw):
+    return analysis.lint_files([os.path.join(FIX, path)], **kw)
+
+
+# ---------------------------------------------------------------------------
+# Pass 1: suite linter
+# ---------------------------------------------------------------------------
+
+class TestSuiteLint:
+    def test_bad_suite_fixture_fires_every_rule(self):
+        fs = _lint("bad_suite.py")
+        assert {"SUITE-OP-TYPE", "SUITE-OP-NO-F",
+                "SUITE-CLIENT-NO-INVOKE",
+                "SUITE-BLOCKING-NO-TIMEOUT"} <= _rules(fs)
+        # findings carry file:line
+        assert all(f.path.endswith("bad_suite.py") and f.line > 0
+                   for f in fs)
+
+    def test_good_suite_fixture_is_clean(self):
+        assert _lint("good_suite.py") == []
+
+    def test_blocking_call_reached_through_self_helper(self):
+        fs = [f for f in _lint("bad_suite.py")
+              if f.rule == "SUITE-BLOCKING-NO-TIMEOUT"]
+        # one direct (urlopen in invoke), one via self._rpc
+        assert len(fs) == 2
+
+    def test_registry_cross_check(self):
+        from jepsen_tpu.analysis import suite_lint
+        paths = [os.path.join(FIX, "bad_suite.py"),
+                 os.path.join(FIX, "good_suite.py")]
+        reg = {"fine": ("good_suite", "fine_test"),
+               "broken": ("bad_suite", "broken_test"),
+               "missing-attr": ("good_suite", "no_such_ctor"),
+               "missing-mod": ("no_such_module", "x_test")}
+        fs = suite_lint.lint_suites(paths, registry=reg)
+        assert "SUITE-CTOR-ARITY" in _rules(fs)          # broken_test
+        missing = [f for f in fs if f.rule == "SUITE-REGISTRY-MISSING"]
+        assert len(missing) == 2                          # attr + module
+
+    def test_real_registry_resolves_statically(self):
+        # the real SUITES registry must produce no registry findings
+        fs = analysis.lint_repo(passes=("suite",))
+        assert "SUITE-REGISTRY-MISSING" not in _rules(fs)
+        assert "SUITE-CTOR-ARITY" not in _rules(fs)
+
+
+# ---------------------------------------------------------------------------
+# Pass 2: history linter + the pre-search gate
+# ---------------------------------------------------------------------------
+
+class TestHistoryLint:
+    def test_bad_history_fixture_fires_every_rule(self):
+        fs = _lint("bad_history.jsonl")
+        assert {"HIST-DECODE", "HIST-DANGLING-INVOKE", "HIST-PROC-REUSE",
+                "HIST-UNMATCHED-COMPLETE", "HIST-OP-TYPE",
+                "HIST-INDEX-ORDER"} <= _rules(fs)
+
+    def test_good_history_fixture_has_no_errors(self):
+        fs = _lint("good_history.jsonl")
+        assert hl.errors(fs) == []
+        # the crashed op surfaces as a note, not damage
+        assert "HIST-OPEN-INVOKE" in _rules(fs)
+
+    def test_crashed_op_is_legal(self):
+        h = History.of([
+            Op(type="invoke", f="write", value=1, process=0, time=0),
+            Op(type="ok", f="write", value=1, process=0, time=1),
+            Op(type="invoke", f="write", value=2, process=1, time=2),
+        ])
+        assert hl.errors(hl.lint_history(h)) == []
+
+    def test_nemesis_ops_never_pair(self):
+        h = History.of([
+            Op(type="info", f="start", process="nemesis", time=0),
+            Op(type="info", f="stop", process="nemesis", time=1),
+            Op(type="info", f="heal-verified", process="nemesis", time=2),
+        ])
+        assert hl.lint_history(h) == []
+
+    def test_f_mismatch_between_pairs(self):
+        h = History.of([
+            Op(type="invoke", f="write", value=1, process=0, time=0),
+            Op(type="ok", f="read", value=1, process=0, time=1),
+        ])
+        assert "HIST-F-MISMATCH" in _rules(hl.lint_history(h))
+
+    def test_gate_rejects_with_rule_id_before_any_jit(self, monkeypatch):
+        from jepsen_tpu.checker import tpu
+        from jepsen_tpu.models import CASRegister
+
+        def boom(*a, **k):  # any compilation attempt is a failure
+            raise AssertionError("jit factory invoked for a "
+                                 "malformed history")
+
+        monkeypatch.setattr(tpu, "_jit_single", boom)
+        monkeypatch.setattr(tpu, "_jit_segment", boom)
+        monkeypatch.setattr(tpu, "_jit_batch", boom)
+        bad = History.of([
+            Op(type="invoke", f="write", value=1, process=0, time=0),
+            Op(type="invoke", f="read", value=None, process=0, time=1),
+            Op(type="ok", f="read", value=1, process=0, time=2),
+        ])
+        with pytest.raises(hl.MalformedHistoryError) as ei:
+            tpu.check_history_tpu(bad, CASRegister())
+        assert "HIST-DANGLING-INVOKE" in str(ei.value)
+
+    def test_gate_surfaces_through_check_safe(self):
+        from jepsen_tpu.checker import check_safe
+        from jepsen_tpu.checker.wgl import linearizable
+        from jepsen_tpu.models import CASRegister
+        bad = History.of([
+            Op(type="ok", f="read", value=1, process=0, time=0),
+        ])
+        out = check_safe(linearizable(CASRegister(), backend="tpu"),
+                         {}, bad)
+        assert out["valid"] == "unknown"
+        assert "HIST-UNMATCHED-COMPLETE" in out["error"]
+
+    def test_gate_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("JTPU_HISTORY_GATE", "0")
+        bad = History.of([
+            Op(type="ok", f="read", value=1, process=0, time=0),
+        ])
+        assert hl.gate_history(bad) == []
+
+    def test_keyed_gate_isolates_the_malformed_key(self):
+        from jepsen_tpu.checker.tpu import check_keyed_tpu
+        from jepsen_tpu.models import CASRegister
+        good = [Op(type="invoke", f="write", value=1, process=0, time=0),
+                Op(type="ok", f="write", value=1, process=0, time=1)]
+        bad = [Op(type="ok", f="read", value=1, process=0, time=0)]
+        out = check_keyed_tpu({"g": History.of(good),
+                               "b": History.of(bad)}, CASRegister())
+        assert out["results"]["g"]["valid"] is True
+        assert out["results"]["b"]["valid"] == "unknown"
+        assert out["results"]["b"]["lint"] == {
+            "HIST-UNMATCHED-COMPLETE": 1}
+        assert out["valid"] == "unknown"
+
+
+class TestSharedOpValidation:
+    def test_one_validation_function(self):
+        # the runtime guard and the lint rule share the same notion
+        assert tuple(VALID_TYPES) == VALID_OP_TYPES
+        for t in VALID_OP_TYPES:
+            assert invalid_op_type(t) is None
+        assert invalid_op_type("okk")
+
+    def test_from_dict_tolerates_and_flags(self):
+        op = Op.from_dict({"type": "okk", "f": "read", "process": 0})
+        assert op.type == "okk"  # tolerated
+        assert INVALID_TYPE_FLAG in op.extra  # flagged
+
+    def test_from_jsonl_counts_type_errors(self):
+        h = History.from_jsonl(
+            '{"type": "invoke", "f": "read", "process": 0}\n'
+            '{"type": "okk", "f": "read", "process": 0}\n')
+        assert len(h) == 2 and h.type_errors == 1
+        assert "HIST-OP-TYPE" in _rules(hl.lint_history(h))
+
+    def test_clean_roundtrip_unchanged(self):
+        d = {"type": "ok", "f": "read", "value": 3, "process": 0,
+             "time": 5, "index": 2}
+        assert Op.from_dict(d).to_dict() == d
+
+
+# ---------------------------------------------------------------------------
+# Pass 3: JAX hazard linter
+# ---------------------------------------------------------------------------
+
+class TestJaxLint:
+    def test_bad_jax_fixture_fires_every_rule(self):
+        fs = _lint("bad_jax.py")
+        assert {"JAX-HOST-SYNC", "JAX-HOST-CAST",
+                "JAX-UNHASHABLE-STATIC", "JAX-INT32-OVERFLOW",
+                "JAX-SHIFT-WIDTH"} <= _rules(fs)
+
+    def test_call_closure_reaches_named_helpers(self):
+        fs = [f for f in _lint("bad_jax.py")
+              if f.rule == "JAX-HOST-SYNC" and "helper" in f.message]
+        assert fs, "np call in a loop-body helper must be flagged"
+
+    def test_good_jax_fixture_is_clean(self):
+        # trace-time numpy in a host-side builder is idiom, not hazard
+        assert _lint("good_jax.py") == []
+
+
+# ---------------------------------------------------------------------------
+# Pass 4: lockset linter
+# ---------------------------------------------------------------------------
+
+class TestLocksetLint:
+    def test_bad_lockset_fixture(self):
+        fs = _lint("bad_lockset.py")
+        assert {"LOCK-UNGUARDED", "LOCK-LIFECYCLE"} <= _rules(fs)
+        # guarded accesses and plain initialization are NOT flagged
+        lines = {f.line for f in fs}
+        assert all(line >= 14 for line in lines), \
+            "conj_op_ok's guarded accesses were wrongly flagged"
+
+    def test_core_conj_op_is_clean(self):
+        fs = analysis.lint_files(["jepsen_tpu/core.py"],
+                                 passes=("lockset",))
+        assert all("conj_op" not in f.anchor for f in fs)
+        assert not [f for f in fs if f.severity == "error"]
+
+
+# ---------------------------------------------------------------------------
+# Baseline + CLI + self-lint
+# ---------------------------------------------------------------------------
+
+class TestBaseline:
+    def test_roundtrip_and_split(self, tmp_path):
+        fs = _lint("bad_lockset.py")
+        assert fs
+        p = tmp_path / "lint.baseline"
+        bl.write(str(p), fs)
+        loaded = bl.load(str(p))
+        assert len(loaded) == len({f.key() for f in fs})
+        new, accepted = bl.split(fs, loaded)
+        assert new == [] and len(accepted) == len(fs)
+
+    def test_justifications_survive_rewrite(self, tmp_path):
+        fs = _lint("bad_lockset.py")
+        p = tmp_path / "lint.baseline"
+        key = fs[0].key()
+        p.write_text(f"{key} — because reasons\n")
+        bl.write(str(p), fs)
+        assert bl.load(str(p))[key] == "because reasons"
+
+    def test_committed_baseline_entries_are_justified(self):
+        for key, just in bl.load().items():
+            assert just and "TODO" not in just, \
+                f"baseline entry {key!r} lacks a real justification"
+
+
+def _run_cli(argv):
+    buf = io.StringIO()
+    old = sys.stdout
+    sys.stdout = buf
+    try:
+        rc = cli.run(cli.default_commands(), argv)
+    finally:
+        sys.stdout = old
+    return rc, buf.getvalue()
+
+
+class TestLintCLI:
+    def test_bad_fixtures_exit_nonzero_with_location_and_rule(self):
+        for fixture in ("bad_suite.py", "bad_jax.py", "bad_lockset.py",
+                        "bad_history.jsonl"):
+            rc, out = _run_cli(["lint", os.path.join(FIX, fixture)])
+            assert rc == cli.TEST_FAILED, fixture
+            assert fixture + ":" in out and "[" in out, fixture
+
+    def test_good_fixtures_exit_zero(self):
+        rc, out = _run_cli(["lint", os.path.join(FIX, "good_suite.py"),
+                            os.path.join(FIX, "good_jax.py"),
+                            os.path.join(FIX, "good_history.jsonl")])
+        assert rc == cli.OK
+        # the only finding is the legal crashed op's note — no gate
+        assert ": error:" not in out and ": warning:" not in out
+        assert "HIST-OPEN-INVOKE" in out
+
+    def test_missing_path_is_not_clean(self):
+        rc, out = _run_cli(["lint", "no/such/file.py"])
+        assert rc == cli.TEST_FAILED
+        assert "LINT-MISSING-FILE" in out
+
+    def test_json_format(self):
+        rc, out = _run_cli(["lint", "--format", "json",
+                            os.path.join(FIX, "bad_history.jsonl")])
+        assert rc == cli.TEST_FAILED
+        doc = json.loads(out)
+        assert doc["counts"]["HIST-PROC-REUSE"] == 1
+
+    def test_write_baseline_then_clean(self, tmp_path):
+        p = tmp_path / "b.baseline"
+        target = os.path.join(FIX, "bad_lockset.py")
+        rc, _ = _run_cli(["lint", "--baseline", str(p),
+                          "--write-baseline", target])
+        assert rc == cli.OK
+        rc, out = _run_cli(["lint", "--baseline", str(p), target])
+        assert rc == cli.OK and "accepted" in out
+
+    def test_self_lint_repo_clean_against_committed_baseline(self):
+        # the acceptance gate: all four passes over the live tree,
+        # exit 0 against lint.baseline
+        rc, out = _run_cli(["lint"])
+        assert rc == cli.OK, out
+        assert "# lint: clean" in out
+
+    def test_lint_gate_tool_is_clean(self):
+        import subprocess
+        pr = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "lint_gate.py")],
+            capture_output=True, text=True, timeout=120)
+        assert pr.returncode == 0, pr.stdout + pr.stderr
+        assert "clean against the baseline" in pr.stdout
+        assert "stale baseline entry" not in pr.stdout
+
+
+class TestRecoverPathGate:
+    def test_recover_fails_on_structurally_damaged_wal(self, tmp_path):
+        """A WAL whose mid-stream completion record was lost (CRC
+        corruption) leaves a process reusing itself; recovery must fail
+        with a lint diagnostic instead of checking the damaged
+        history."""
+        import contextlib
+
+        from jepsen_tpu import journal, store
+        d = tmp_path / "run"
+        d.mkdir()
+        ops = [
+            Op(type="invoke", f="write", value=1, process=0, time=0),
+            # the ok completion for process 0 was here — corrupted away
+            Op(type="invoke", f="read", value=None, process=0, time=2),
+            Op(type="ok", f="read", value=1, process=0, time=3),
+        ]
+        with open(d / "history.wal", "wb") as f:
+            for o in ops:
+                f.write(journal.encode_record(o))
+        store.write_state(str(d), "running")
+        # fake a dead recorder
+        st = store.read_state(str(d))
+        st["pid"] = 2 ** 22 + 12345  # vanishingly unlikely to be alive
+        import json as _json
+        (d / "run.state").write_text(_json.dumps(st))
+        assert store.run_status(str(d)) == "dead"
+
+        buf, err = io.StringIO(), io.StringIO()
+        with contextlib.redirect_stdout(buf), \
+                contextlib.redirect_stderr(err):
+            rc = cli.run(cli.default_commands(),
+                         ["recover", "--store", str(d)])
+        assert rc == cli.TEST_FAILED
+        assert "# lint:" in buf.getvalue()
+        assert "HIST-DANGLING-INVOKE" in buf.getvalue() + err.getvalue()
+        # no results.json: the checker never ran on damaged structure
+        assert not (d / "results.json").exists()
+
+    def test_analyze_prints_lint_summary(self, tmp_path):
+        import contextlib
+        d = tmp_path / "run"
+        d.mkdir()
+        h = History.of([
+            Op(type="invoke", f="write", value=1, process=0, time=0),
+            Op(type="ok", f="write", value=1, process=0, time=1),
+        ]).index()
+        (d / "history.jsonl").write_text(h.to_jsonl() + "\n")
+        (d / "test.json").write_text('{"name": "t"}')
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = cli.run(cli.default_commands(),
+                         ["analyze", "--store", str(d)])
+        assert rc == cli.OK
+        assert "# lint: clean" in buf.getvalue()

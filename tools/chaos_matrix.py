@@ -18,6 +18,11 @@ qualify a new accelerator image before trusting it with long runs):
   kill9-recover    SIGKILL a real localkv run mid-workload: `recover`
                    rebuilds the history from the write-ahead journal
                    and the offline checker renders a verdict
+  malformed-history  corrupt a real localkv history three ways
+                   (dangling invoke, process reuse, bad op type): the
+                   pre-search lint gate rejects each with the right
+                   rule id BEFORE any jit compilation; the clean
+                   history still checks valid
 
 Usage: python tools/chaos_matrix.py [--seed N] [--only NAME ...]
 Exit code 0 iff every selected scenario passes — nonzero on any
@@ -297,6 +302,106 @@ def scenario_kill9_recover(seed):
                 f"status={store.run_status(run_dir)}; {summary}")
 
 
+def scenario_malformed_history(seed):
+    """Corrupt a REAL localkv history (dangling invoke, process reuse,
+    bad op type); assert the pre-search lint gate rejects each with the
+    right rule id before any jit compilation occurs."""
+    from jepsen_tpu import core
+    from jepsen_tpu.analysis.history_lint import MalformedHistoryError
+    from jepsen_tpu.checker import tpu
+    from jepsen_tpu.history import History
+    from jepsen_tpu.suites.localkv import localkv_test
+
+    # A real localkv run (real daemons, real sockets), store disabled —
+    # only the history matters here.
+    test = localkv_test({"time-limit": 6, "nemesis-period": 2})
+    test["store-dir"] = None
+    test = core.run(test)
+    h = test["history"]
+    if test["results"].get("valid") is not True:
+        return False, (f"clean localkv run should validate, got "
+                       f"{test['results'].get('valid')}")
+
+    def corrupt_dangling(ops):
+        """Drop an ok completion whose process later invokes a
+        DIFFERENT op — the abandoned invoke is mid-stream dangling.
+        (An identical next invoke would read as process reuse, which
+        is the next corruption's job.)"""
+        for i, o in enumerate(ops):
+            if o.type != "ok":
+                continue
+            nxt = next((p for p in ops[i + 1:]
+                        if p.process == o.process
+                        and p.type == "invoke"), None)
+            if nxt is not None and nxt.f != o.f:
+                return History.of(ops[:i] + ops[i + 1:])
+        return None
+
+    def corrupt_reuse(ops):
+        """Duplicate an invoke in place: the process is reused before
+        its open op completes."""
+        for i, o in enumerate(ops):
+            if o.type == "invoke":
+                dup = o.replace(index=-1)
+                return History.of(ops[:i + 1] + [dup] + ops[i + 1:])
+        return None
+
+    def corrupt_type(ops):
+        """Flip one completion's type to a value outside the op
+        algebra."""
+        for i, o in enumerate(ops):
+            if o.type == "ok":
+                return History.of(
+                    ops[:i] + [o.replace(type="okk")] + ops[i + 1:])
+        return None
+
+    corruptions = (("dangling invoke", corrupt_dangling,
+                    "HIST-DANGLING-INVOKE"),
+                   ("process reuse", corrupt_reuse, "HIST-PROC-REUSE"),
+                   ("bad op type", corrupt_type, "HIST-OP-TYPE"))
+
+    # Any jit-factory call on a rejected history is a scenario failure.
+    compiled = []
+    real = (tpu._jit_single, tpu._jit_segment, tpu._jit_batch)
+
+    def _traced(name):
+        def f(*a, **k):
+            compiled.append(name)
+            raise AssertionError(f"{name} invoked for a malformed "
+                                 f"history")
+        return f
+
+    details = []
+    ok = True
+    tpu._jit_single = _traced("_jit_single")
+    tpu._jit_segment = _traced("_jit_segment")
+    tpu._jit_batch = _traced("_jit_batch")
+    try:
+        for label, fn, want_rule in corruptions:
+            bad = fn(list(h))
+            if bad is None:
+                ok = False
+                details.append(f"{label}: no corruptible op found")
+                continue
+            try:
+                tpu.check_history_tpu(bad, test["model"])
+                ok = False
+                details.append(f"{label}: NOT rejected")
+            except MalformedHistoryError as e:
+                if want_rule in str(e):
+                    details.append(f"{label}->{want_rule}")
+                else:
+                    ok = False
+                    details.append(f"{label}: wrong rule in {e}")
+    finally:
+        (tpu._jit_single, tpu._jit_segment, tpu._jit_batch) = real
+    if compiled:
+        ok = False
+        details.append(f"jit fired: {compiled}")
+    return ok, ("gate rejected " + ", ".join(details)
+                + f"; clean run valid over {len(h)} ops")
+
+
 SCENARIOS = (
     ("oom", scenario_oom),
     ("wedge", scenario_wedge),
@@ -304,6 +409,7 @@ SCENARIOS = (
     ("transient", scenario_transient),
     ("hung-client", scenario_hung_client),
     ("kill9-recover", scenario_kill9_recover),
+    ("malformed-history", scenario_malformed_history),
 )
 
 

@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""CI lint gate: run the four-pass static analyzer over the repo and
+exit nonzero on any finding not covered by the committed baseline.
+
+Stricter than ``python -m jepsen_tpu lint`` (whose exit code gates on
+new *errors* only): CI should not accumulate new warnings silently
+either — either fix them or accept them into ``lint.baseline`` with a
+one-line justification.
+
+Usage: python tools/lint_gate.py [--baseline FILE] [--root DIR]
+Exit code 0 iff the tree is clean against the baseline.
+"""
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from jepsen_tpu import analysis  # noqa: E402
+from jepsen_tpu.analysis import baseline as bl  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: lint.baseline at the "
+                         "repo root)")
+    ap.add_argument("--root", default=None, help="repo root override")
+    args = ap.parse_args()
+
+    root = args.root or REPO
+    bpath = args.baseline or bl.default_path(root)
+    findings = analysis.lint_repo(root=root)
+    accepted_keys = bl.load(bpath)
+    new, accepted = bl.split(findings, accepted_keys)
+
+    # A baseline entry that no longer matches anything is stale — warn
+    # so accepted debt gets cleaned out when the finding is fixed.
+    live = {f.key() for f in findings}
+    stale = [k for k in accepted_keys if k not in live]
+    for k in stale:
+        print(f"# lint-gate: stale baseline entry (fixed? remove it): "
+              f"{k}")
+
+    for f in sorted(new, key=lambda x: (x.path, x.line)):
+        print(f.format())
+    print(analysis.summary_line(new))
+    if accepted:
+        print(f"# lint-gate: {len(accepted)} finding(s) accepted by "
+              f"{bpath}")
+    if new:
+        print(f"# lint-gate: FAILED — {len(new)} new finding(s) not in "
+              f"the baseline; fix them or accept them with a "
+              f"justification", file=sys.stderr)
+        return 1
+    print("# lint-gate: clean against the baseline")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
