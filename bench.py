@@ -119,7 +119,7 @@ def child_main(platform: str) -> int:
     # Contract line FIRST: if a slow device makes the secondaries blow
     # the orchestrator's timeout, the headline is already on stdout (and
     # the orchestrator salvages a timed-out child's output).
-    print(json.dumps({
+    rec = {
         "metric": METRIC,
         "value": round(warm, 3),
         "unit": "s",
@@ -127,8 +127,21 @@ def child_main(platform: str) -> int:
         "platform": dev.platform,
         "cold_s": round(cold, 3),
         "cold_vs_baseline": round(TARGET_S / cold, 2),
-    }))
+    }
+    # compile/execute split from the obs layer (doc/observability.md):
+    # the supervised search reports host-measured device phases, so
+    # BENCH_*.json can attribute the cold number to XLA compilation vs
+    # actual search execution. Cold ran first, so its device-s carries
+    # the compile phase; warm's is pure execute.
+    split = result.get("device-s") or {}
+    split2 = result2.get("device-s") or {}
+    if split or split2:
+        rec["compile_s"] = round(split.get("compile", 0.0), 3)
+        rec["execute_s"] = round(split2.get("execute", 0.0)
+                                 or split.get("execute", 0.0), 3)
+    print(json.dumps(rec))
     sys.stdout.flush()
+    _search_line("10k headline", result2, warm)
     # util AFTER the contract line: the roofline compiles+runs device
     # code and must not be able to starve the headline of stdout.
     _util_line("headline", warm, [result2])
@@ -188,6 +201,36 @@ def child_main(platform: str) -> int:
             except Exception as e:  # noqa: BLE001 — must not eat the line
                 print(f"# {label} failed: {e!r}", file=sys.stderr)
     return 0
+
+
+def _search_line(label, result, wall_s):
+    """One '# search:' stderr line attributing a check's wall-clock to
+    compile/device/host phases, from the telemetry the supervised
+    search surfaces (device-s, segment-levels, frontier-hwm,
+    transfer-bytes — doc/observability.md). Host time is the wall
+    minus the device phases: packing, gating, checkpoint snapshots,
+    supervisor bookkeeping. Diagnostics only — never raises."""
+    try:
+        dev = result.get("device-s") or {}
+        comp = float(dev.get("compile", 0.0))
+        exe = float(dev.get("execute", 0.0))
+        host = max(0.0, wall_s - comp - exe)
+        line = (f"# search {label}: compile={comp:.3f}s "
+                f"execute={exe:.3f}s host={host:.3f}s of "
+                f"{wall_s:.3f}s wall")
+        if result.get("segments"):
+            segl = result.get("segment-levels") or []
+            line += (f", {result['segments']} segment(s)"
+                     + (f" x {max(segl)} level(s) max" if segl else ""))
+        if result.get("frontier-hwm") is not None:
+            line += f", frontier-hwm={result['frontier-hwm']} rows"
+        if result.get("transfer-bytes"):
+            line += (f", {result['transfer-bytes'] / 1e6:.1f} MB "
+                     f"transferred")
+        print(line, file=sys.stderr)
+    except Exception as e:  # noqa: BLE001
+        print(f"# search {label}: accounting failed: {e!r}",
+              file=sys.stderr)
 
 
 def _level_work(rung, crash_width, tiebreak="lex", batch=1):
@@ -924,7 +967,8 @@ def main() -> int:
         if rec is None and "wedged" in note:
             break  # hard init hang: a retry would hang identically
         if rec is not None and rec.get("value") is not None:
-            extras = {k: rec[k] for k in ("cold_s", "cold_vs_baseline")
+            extras = {k: rec[k] for k in ("cold_s", "cold_vs_baseline",
+                                          "compile_s", "execute_s")
                       if k in rec}
             # Second cold child: same measurement in a FRESH process —
             # its cold_s shows whether the persistent compilation cache
@@ -959,7 +1003,8 @@ def main() -> int:
         rec, note = _run_child("cpu", remaining - 30)
         notes.append(note)
         if rec is not None and rec.get("value") is not None:
-            extras = {k: rec[k] for k in ("cold_s", "cold_vs_baseline")
+            extras = {k: rec[k] for k in ("cold_s", "cold_vs_baseline",
+                                          "compile_s", "execute_s")
                       if k in rec}
             emit(rec["value"], rec["vs_baseline"], platform="cpu",
                  note="tpu unavailable; cpu-backend fallback", **extras)

@@ -25,6 +25,9 @@ Layer map (mirrors SURVEY.md §1, TPU-first):
 - jepsen_tpu.native                        — host-side C++ components compiled
   on demand (the native linearizability engine)
 - jepsen_tpu.store / cli / web             — persistence, runner, browser
+- jepsen_tpu.obs                           — observability: span tracer
+  (trace.jsonl / Perfetto export) + metrics registry (Prometheus
+  /metrics, metrics.json)
 """
 
 __version__ = "0.1.0"

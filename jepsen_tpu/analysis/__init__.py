@@ -126,9 +126,10 @@ def worst_severity(findings: Iterable[Finding]) -> Optional[str]:
 #: pre-search gate, `recover`/`analyze`, or `lint --history FILE`).
 DEFAULT_SCOPES = {
     "suite": ("jepsen_tpu/suites",),
-    "jax": ("jepsen_tpu/checker", "jepsen_tpu/ops/encode.py"),
+    "jax": ("jepsen_tpu/checker", "jepsen_tpu/ops/encode.py",
+            "jepsen_tpu/obs", "jepsen_tpu/resilience.py"),
     "lockset": ("jepsen_tpu/core.py", "jepsen_tpu/journal.py",
-                "jepsen_tpu/nemesis"),
+                "jepsen_tpu/nemesis", "jepsen_tpu/obs"),
 }
 
 PASSES = ("suite", "history", "jax", "lockset")

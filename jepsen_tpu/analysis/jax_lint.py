@@ -30,6 +30,15 @@ JAX-INT32-OVERFLOW          error     an integer literal outside the
 JAX-SHIFT-WIDTH             error     a constant shift of >= 32 bits (a
                                       32-bit lane shifts by the count
                                       mod 32 on TPU — silent garbage)
+JAX-TRACE-IN-JIT            error     an ``obs.span``/``obs.event`` or
+                                      host-clock call
+                                      (``time.monotonic``/
+                                      ``perf_counter``/...) inside a
+                                      traced body: it would time the
+                                      TRACE, not the device — device
+                                      timing must be measured on the
+                                      host around
+                                      ``block_until_ready``
 ==========================  ========  =================================
 
 Traced-body detection is lexical, not dataflow: a function is traced if
@@ -67,6 +76,21 @@ _SYNC_METHODS = ("item", "tolist", "block_until_ready")
 
 #: numpy module aliases whose calls inside a traced body are hazards.
 _NP_NAMES = ("np", "numpy")
+
+#: Host-clock attributes: called on a time-module alias inside a traced
+#: body they run at TRACE time (once, on host), so the recorded numbers
+#: are garbage — and a span context manager would additionally close
+#: around the trace, not the execution. The obs discipline
+#: (doc/observability.md): measure on the host around
+#: ``block_until_ready``.
+_CLOCK_ATTRS = ("monotonic", "monotonic_ns", "perf_counter",
+                "perf_counter_ns", "time", "time_ns", "process_time")
+_TIME_ALIASES = ("time", "_time", "_t", "_hosttime")
+
+#: Span/event call names (module-level helpers or tracer methods from
+#: jepsen_tpu.obs) that must never appear inside a traced body.
+_OBS_ALIASES = ("obs", "trace", "tracer", "_tracer", "obs_trace")
+_OBS_ATTRS = ("span", "event")
 
 INT32_MIN, INT32_MAX = -(2 ** 31), 2 ** 31 - 1
 UINT32_MAX = 2 ** 32 - 1
@@ -253,6 +277,25 @@ def lint_file(path: str, root: Optional[str] = None) -> List[Finding]:
                 add("JAX-HOST-CAST", WARNING, node,
                     f"{name}() on a traced value inside {fn.name!r} "
                     f"is a concretization point (breaks under jit)")
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _CLOCK_ATTRS \
+                    and name.split(".", 1)[0] in _TIME_ALIASES:
+                flagged.add(id(node))
+                add("JAX-TRACE-IN-JIT", ERROR, node,
+                    f"{name}() inside the traced body {fn.name!r} runs "
+                    f"at trace time, not per step — device timing must "
+                    f"be measured on the host around "
+                    f"block_until_ready (doc/observability.md)")
+            elif (name in ("span", "event")
+                  or (isinstance(node.func, ast.Attribute)
+                      and node.func.attr in _OBS_ATTRS
+                      and name.split(".", 1)[0] in _OBS_ALIASES)):
+                flagged.add(id(node))
+                add("JAX-TRACE-IN-JIT", ERROR, node,
+                    f"{name}() inside the traced body {fn.name!r}: a "
+                    f"span would close around the TRACE, not the "
+                    f"device execution — instrument the host call "
+                    f"site instead")
 
     # -- whole-file hazards -------------------------------------------------
     cached = _lru_cached_names(tree)

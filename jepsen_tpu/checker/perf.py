@@ -25,10 +25,19 @@ RATE_DT = 10.0     # seconds per rate bucket (perf.clj:303)
 
 
 def latency_series(history: History) -> List[dict]:
-    """[(time_s, latency_ms, f, type)] for each completed op."""
+    """[(time_s, latency_ms, f, type)] for each completed op.
+
+    Pairs whose completion is ``:info`` with no timestamp are skipped:
+    synthesized completions (WAL recovery's reconciled dangling
+    invokes, crash bookkeeping) carry ``time=0`` or a time before the
+    invocation, which used to emit negative/zero latencies that
+    poisoned the quantile buckets. A genuine timed ``:info`` (a crashed
+    op whose completion was recorded live) still yields a point."""
     out = []
     for inv, comp in history.pairs():
         if inv is None or comp is None or inv.process == "nemesis":
+            continue
+        if comp.is_info and (not comp.time or comp.time < inv.time):
             continue
         out.append({
             "time": inv.time / 1e9,
@@ -64,18 +73,21 @@ def quantile_series(points: List[dict],
 
 def rate_series(history: History, dt: float = RATE_DT) -> Dict[str, list]:
     """Completion rate (ops/sec) per (f, type) in dt buckets
-    (perf.clj:285-303)."""
+    (perf.clj:285-303), plus an all-types rollup per f (the missing
+    ``f``-label breakdown: the reference plots per-f totals alongside
+    the per-(f, type) splits, and without the rollup a dashboard cannot
+    show 'reads/sec' without re-summing the splits client-side)."""
     acc: Dict[tuple, Dict[int, int]] = {}
     for o in history:
         if o.is_invoke or o.process == "nemesis":
             continue
         b = int(o.time / 1e9 // dt)
-        key = (str(o.f), o.type)
-        acc.setdefault(key, {}).setdefault(b, 0)
-        acc[key][b] += 1
+        for key in ((str(o.f), o.type), (str(o.f), None)):
+            acc.setdefault(key, {}).setdefault(b, 0)
+            acc[key][b] += 1
     return {
-        f"{f} {t}": [[(b + 0.5) * dt, c / dt]
-                     for b, c in sorted(buckets.items())]
+        (f"{f} {t}" if t is not None else str(f)): [
+            [(b + 0.5) * dt, c / dt] for b, c in sorted(buckets.items())]
         for (f, t), buckets in acc.items()
     }
 

@@ -47,13 +47,16 @@ caller finally falls back to the exact CPU search.
 from __future__ import annotations
 
 import functools
+import time as _hosttime
 from typing import Any, Dict, Optional, Sequence
 
 import numpy as np
 
+from jepsen_tpu import obs
 from jepsen_tpu.checker import UNKNOWN
 from jepsen_tpu.history import History
 from jepsen_tpu.models.core import KernelSpec, Model, kernel_spec_for
+from jepsen_tpu.obs import metrics as obs_metrics
 from jepsen_tpu.ops.encode import PackedHistory, RET_INF, pack_with_init
 
 try:  # JAX is a hard dependency of this module, soft for the package.
@@ -656,6 +659,68 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
     return search
 
 
+# ---------------------------------------------------------------------------
+# Telemetry (doc/observability.md): every metric/span here is recorded on
+# the HOST side, around block_until_ready — never inside a traced body
+# (the JAX-TRACE-IN-JIT lint rule rejects clocks/spans under jit, where
+# they would either poison the trace or time the dispatch, not the math).
+# ---------------------------------------------------------------------------
+
+_DEVICE_SECONDS = obs_metrics.histogram(
+    "jtpu_device_call_seconds",
+    "wall time of one device executable call (host-side, around "
+    "block_until_ready), labeled kind=single|segment|batch|sharded and "
+    "phase=compile|execute; 'compile' is the shape's first call in this "
+    "process — XLA compilation plus one execution",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0))
+_LEVELS_TOTAL = obs_metrics.counter(
+    "jtpu_search_levels_total",
+    "search levels executed on device (per-call/per-segment deltas)")
+_SEGMENTS_TOTAL = obs_metrics.counter(
+    "jtpu_search_segments_total", "checkpointed device segments run")
+_FRONTIER_HWM = obs_metrics.gauge(
+    "jtpu_search_frontier_rows_hwm",
+    "high-water mark of live pool rows observed at segment boundaries")
+_TRANSFER_BYTES = obs_metrics.counter(
+    "jtpu_search_transfer_bytes_total",
+    "packed-history and checkpoint bytes moved, labeled by direction")
+
+#: Executable shapes (cache key + padded input shape) that have already
+#: run once in this process — the compile/execute phase separator.
+_EXECUTED_SHAPES: set = set()
+
+
+def _first_call(key: tuple) -> bool:
+    """True iff this executable shape has not run in this process yet.
+    First calls pay XLA compilation (the persistent compilation cache
+    can shrink but not remove that phase), so their timings are recorded
+    under phase="compile" and steady-state calls under "execute" — the
+    split bench.py and the ``# search:`` summary report."""
+    first = key not in _EXECUTED_SHAPES
+    _EXECUTED_SHAPES.add(key)
+    return first
+
+
+def _timed_call(kind: str, key: tuple, fn, args, **attrs):
+    """Run one jitted executable with host-side phase timing. Returns
+    ``(outputs, seconds, phase)`` — outputs fully materialized via
+    block_until_ready so the clock covers the device work, not just the
+    dispatch."""
+    phase = "compile" if _first_call(key) else "execute"
+    with obs.span(f"checker.device.{kind}", phase=phase, **attrs):
+        t0 = _hosttime.perf_counter()
+        out = jax.block_until_ready(fn(*args))
+        dt = _hosttime.perf_counter() - t0
+    _DEVICE_SECONDS.observe(dt, kind=kind, phase=phase)
+    return out, dt, phase
+
+
+def _cols_nbytes(cols: dict) -> int:
+    """Host->device payload size of one packed-column set."""
+    return int(sum(np.asarray(cols[c]).nbytes for c in _COLS))
+
+
 # The jit caches key on kernel *identity* (two KernelSpecs sharing a name
 # must not share compiled search code); the side table pins the object so
 # its id cannot be recycled.
@@ -1105,10 +1170,15 @@ def check_packed_tpu(p: PackedHistory, kernel: KernelSpec,
     out: Dict[str, Any] = {}
     work: list = []
     for cap, win, exp in ladder:
-        fn = _jit_single(_kernel_key(kernel), cap, win, exp,
-                         _unroll_factor())
-        done, lossy, wovf, best, levels, pk, ps, pa = fn(
-            *(cols[c] for c in _COLS))
+        unroll = _unroll_factor()
+        fn = _jit_single(_kernel_key(kernel), cap, win, exp, unroll)
+        shape_key = ("single", _kernel_key(kernel), cap, win, exp,
+                     unroll, cols["f"].shape[0], cols["cf"].shape[0])
+        outs, _, _ = _timed_call(
+            "single", shape_key, fn, [cols[c] for c in _COLS],
+            rung=(cap, win, exp))
+        done, lossy, wovf, best, levels, pk, ps, pa = outs
+        _LEVELS_TOTAL.inc(int(levels))
         out = _result(bool(done), bool(lossy), bool(wovf), int(best),
                       int(levels), p, pool=(pk, ps, pa))
         # the rung that produced this verdict, for utilization
@@ -1174,8 +1244,14 @@ def check_packed_sharded(p: PackedHistory, kernel: KernelSpec,
     fn = _jit_single(_kernel_key(kernel), capacity, window, expand,
                      _unroll_factor(), POOL_AXIS)
     with jax.set_mesh(mesh):
-        done, lossy, wovf, best, levels, pk, ps, pa = fn(
-            *(cols[c] for c in _COLS))
+        shape_key = ("sharded", _kernel_key(kernel), capacity, window,
+                     expand, naxis, cols["f"].shape[0],
+                     cols["cf"].shape[0])
+        outs, _, _ = _timed_call(
+            "sharded", shape_key, fn, [cols[c] for c in _COLS],
+            rung=(capacity, window, expand), axis=naxis)
+        done, lossy, wovf, best, levels, pk, ps, pa = outs
+        _LEVELS_TOTAL.inc(int(levels))
         done, lossy, wovf = bool(done), bool(lossy), bool(wovf)
         pool = (pk, ps, pa)
         if jax.process_count() > 1:
@@ -1235,19 +1311,28 @@ def warm_ladder(p: PackedHistory, kernel: KernelSpec,
     ladder = full[:rungs] if rungs else full
     seg = _segment_config(None)
     for cap, win, exp in ladder:
+        unroll = _unroll_factor()
         if seg:
             # warm the checkpointed-segment executable — the path a
             # default (segmented) check actually runs
             fn = _jit_segment(_kernel_key(kernel), cap, win, exp,
-                              _unroll_factor())
+                              unroll)
             carry = _carry0_host(cap, win, cols["cf"].shape[0],
                                  cols["ini"], 0)
             jax.block_until_ready(
                 fn(*(cols[c] for c in _COLS), np.int32(seg), carry))
+            # the compile phase was just paid here: a later timed call
+            # at this shape is steady-state, and must be labeled so
+            _EXECUTED_SHAPES.add(
+                ("segment", _kernel_key(kernel), cap, win, exp, unroll,
+                 cols["f"].shape[0], cols["cf"].shape[0]))
         else:
             fn = _jit_single(_kernel_key(kernel), cap, win, exp,
-                             _unroll_factor())
+                             unroll)
             jax.block_until_ready(fn(*(cols[c] for c in _COLS)))
+            _EXECUTED_SHAPES.add(
+                ("single", _kernel_key(kernel), cap, win, exp, unroll,
+                 cols["f"].shape[0], cols["cf"].shape[0]))
 
 
 def check_history_tpu(history: History, model: Model,
@@ -1521,7 +1606,15 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
                                     else _UNROLL)
             fn = _jit_batch(_kernel_key(kernel), cap, win, exp,
                             unroll, tiebreak=tb)
-            outs = fn(*arrays)
+            shape_key = ("batch", _kernel_key(kernel), cap, win, exp,
+                         unroll, tb, tuple(arrays[0].shape), crw)
+            _TRANSFER_BYTES.inc(
+                sum(int(getattr(a, "nbytes", 0)) for a in arrays),
+                direction="host-to-device")
+            outs, _, _ = _timed_call(
+                "batch", shape_key, fn, arrays,
+                rung=(cap, win, exp), keys=len(grp),
+                crash_width=crw, tiebreak=tb)
             if multiproc:
                 # Per-key verdict rows live on their owning host; gather
                 # the scalar verdict vectors so every process takes
@@ -1535,6 +1628,9 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
                 scalars = outs[:5]
             done, lossy, wovf, best, levels = (np.asarray(x)
                                                for x in scalars)
+            # a vmapped batch advances every key per program level, so
+            # the device executed the slowest key's level count
+            _LEVELS_TOTAL.inc(int(levels.max(initial=0)))
             # Pool columns ([capacity] rows per key) are only read for
             # clean refutations — don't ship up to 16384 ints/key
             # off-device (and over DCN) for the common all-valid rung.

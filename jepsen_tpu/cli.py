@@ -386,6 +386,21 @@ def recover_cmd() -> dict:
                   f"({s['records']} WAL records, {s['torn']} torn, "
                   f"{s['corrupt']} corrupt, {s['reconciled']} dangling "
                   f"invoke(s) -> info)")
+            # Span-trace recovery summary next to the lint/recovery
+            # lines: trace.jsonl streams during the run exactly like
+            # the WAL, so a killed run's timeline survives too.
+            tpath = _os.path.join(d, "trace.jsonl")
+            if _os.path.exists(tpath):
+                from jepsen_tpu.obs import trace as trace_ns
+                try:
+                    trecs, tstats = trace_ns.read_trace(tpath)
+                    print(f"# trace: {tstats['spans']} span(s) "
+                          f"recovered from trace.jsonl "
+                          f"({tstats['torn']} torn, "
+                          f"{tstats['corrupt']} corrupt)")
+                except OSError as e:
+                    print(f"# trace: unreadable trace.jsonl: {e}",
+                          file=sys.stderr)
             # Structural lint of the reconstructed history, printed
             # alongside the recovery stats; error-severity findings
             # (e.g. a corrupt WAL dropped a completion mid-stream and
@@ -423,6 +438,89 @@ def recover_cmd() -> dict:
         return worst
 
     return {"recover": {"parser": build_parser, "run": run_}}
+
+
+def trace_cmd() -> dict:
+    """The 'trace' subcommand family: read a run's ``trace.jsonl`` span
+    artifact (doc/observability.md).
+
+    * ``trace export --format chrome`` — Chrome trace-event JSON that
+      loads directly in Perfetto (ui.perfetto.dev) or chrome://tracing;
+      ``--format jsonl`` relays the raw records.
+    * ``trace summary`` — per-span-name counts and total/max durations,
+      printed as ``# trace:`` lines.
+
+    Reading is torn-tail tolerant (the run may have been SIGKILLed
+    mid-span, or still be running)."""
+
+    def build_parser():
+        p = Parser(prog="trace",
+                   description="Export or summarize a run's span "
+                               "trace (trace.jsonl).")
+        p.add_argument("action", choices=["export", "summary"],
+                       help="export: write Chrome/Perfetto (or raw "
+                            "jsonl) trace; summary: per-span rollup")
+        p.add_argument("--store", default=None,
+                       help="store directory (default: latest under "
+                            "./store)")
+        p.add_argument("--format", default="chrome",
+                       choices=["chrome", "jsonl"],
+                       help="export format (chrome loads in Perfetto)")
+        p.add_argument("-o", "--output", default=None, metavar="FILE",
+                       help="write the export here (default: stdout)")
+        return p
+
+    def run_(opts) -> int:
+        import json as _json
+        import os as _os
+
+        from jepsen_tpu import store
+        from jepsen_tpu.obs import trace as trace_ns
+
+        d = opts.get("store")
+        if d is None:
+            t = store.latest()
+            d = t.get("store-dir") if t else None
+        if not d or not _os.path.isdir(d):
+            print(f"no such store directory: {d}", file=sys.stderr)
+            return INVALID_ARGS
+        path = _os.path.join(d, trace_ns.TRACE_NAME)
+        if not _os.path.exists(path):
+            print(f"no {trace_ns.TRACE_NAME} in {d} (run predates "
+                  f"tracing, or JTPU_TRACE=0)", file=sys.stderr)
+            return INVALID_ARGS
+        records, stats = trace_ns.read_trace(path)
+        print(f"# trace: {stats['spans']} span(s) in {path} "
+              f"({stats['torn']} torn, {stats['corrupt']} corrupt)",
+              file=sys.stderr)
+
+        if opts["action"] == "summary":
+            rollup = trace_ns.summarize(records)
+            width = max((len(n) for n in rollup), default=4)
+            print(f"# trace: {'name':<{width}}  count  total      max")
+            for name, s in sorted(rollup.items(),
+                                  key=lambda kv: -kv[1]["total-ns"]):
+                print(f"# trace: {name:<{width}}  {s['count']:>5}  "
+                      f"{s['total-ns'] / 1e9:>8.3f}s "
+                      f"{s['max-ns'] / 1e9:>8.3f}s")
+            return OK
+
+        if opts["format"] == "chrome":
+            text = _json.dumps(trace_ns.to_chrome(
+                records, process_name=_os.path.basename(d) or "jtpu"))
+        else:
+            text = "\n".join(_json.dumps(r, default=repr)
+                             for r in records) + "\n"
+        if opts.get("output"):
+            with open(opts["output"], "w") as f:
+                f.write(text)
+            print(f"# trace: wrote {opts['format']} export to "
+                  f"{opts['output']}", file=sys.stderr)
+        else:
+            print(text)
+        return OK
+
+    return {"trace": {"parser": build_parser, "run": run_}}
 
 
 def lint_cmd() -> dict:
@@ -562,9 +660,10 @@ def main(subcommands: Dict[str, dict],
 
 def default_commands() -> dict:
     """The stock subcommand set: runner + analyzer + recovery + linter
-    + server (what ``python -m jepsen_tpu`` dispatches)."""
+    + trace tooling + server (what ``python -m jepsen_tpu``
+    dispatches)."""
     return merge_commands(suite_run_cmd(), analyze_cmd(), recover_cmd(),
-                          lint_cmd(), serve_cmd())
+                          lint_cmd(), trace_cmd(), serve_cmd())
 
 
 if __name__ == "__main__":  # default main

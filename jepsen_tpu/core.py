@@ -35,12 +35,24 @@ from jepsen_tpu import client as client_ns
 from jepsen_tpu import control
 from jepsen_tpu import db as db_ns
 from jepsen_tpu import generator as gen
+from jepsen_tpu import obs
 from jepsen_tpu.checker import check_safe
 from jepsen_tpu.history import History, INFO, NEMESIS, Op
+from jepsen_tpu.obs import metrics as obs_metrics
 from jepsen_tpu.util import (real_pmap, relative_time_nanos, timeout,
                              with_relative_time)
 
 log = logging.getLogger("jepsen")
+
+_OP_TIMEOUTS = obs_metrics.counter(
+    "jtpu_op_timeouts_total",
+    "client ops that exceeded the op-timeout budget and became :info")
+_OP_CRASHES = obs_metrics.counter(
+    "jtpu_op_crashes_total",
+    "client ops that crashed indeterminate (process reincarnated)")
+_NEMESIS_WEDGED = obs_metrics.counter(
+    "jtpu_nemesis_wedged_total",
+    "nemesis threads abandoned at the run's join deadline")
 
 
 class OpTimeout(Exception):
@@ -65,6 +77,7 @@ def with_op_timeout(seconds: float, f, *args):
     no longer stall a whole run."""
     out = timeout(seconds * 1000.0, _OP_TIMED_OUT, f, *args)
     if out is _OP_TIMED_OUT:
+        _OP_TIMEOUTS.inc()
         raise OpTimeout(f"operation exceeded the {seconds}s op-timeout; "
                         f"treating it as indeterminate")
     return out
@@ -159,11 +172,12 @@ class Worker:
         test = self.test
         op_timeout = test.get("op-timeout")
         try:
-            if op_timeout:
-                completion = with_op_timeout(op_timeout, client.invoke,
-                                             test, op)
-            else:
-                completion = client.invoke(test, op)
+            with obs.span("client.invoke", f=op.f, process=op.process):
+                if op_timeout:
+                    completion = with_op_timeout(op_timeout,
+                                                 client.invoke, test, op)
+                else:
+                    completion = client.invoke(test, op)
             if (completion is None
                     or completion.type not in ("ok", "fail", "info")
                     or completion.f != op.f
@@ -178,6 +192,7 @@ class Worker:
         except Exception as e:  # noqa: BLE001
             # indeterminate: we don't know if the op took place
             crashed_err = e
+            _OP_CRASHES.inc(f=str(op.f))
             info = op.replace(type=INFO, time=relative_time_nanos(),
                               error=f"{type(e).__name__}: {e}")
             conj_op(test, info)
@@ -207,7 +222,8 @@ def _probe_heal(test: dict, nemesis, op: Op) -> None:
     if verify is None:
         return
     try:
-        res = verify(test, op)
+        with obs.span("nemesis.heal_probe", f=op.f):
+            res = verify(test, op)
     except Exception as e:  # noqa: BLE001 — a broken probe is a finding
         res = {"verified": False, "error": f"{type(e).__name__}: {e}"}
     if res is None:
@@ -243,11 +259,18 @@ def _nemesis_worker(test: dict, stop: threading.Event):
             op = _fill_op(test, op, NEMESIS).replace(type=INFO)
             conj_op(test, op)
             try:
-                completion = nemesis.invoke(test, op) if nemesis else op
+                with obs.span("nemesis.invoke", f=op.f):
+                    completion = (nemesis.invoke(test, op) if nemesis
+                                  else op)
                 completion = completion.replace(
                     type=INFO, process=NEMESIS, time=relative_time_nanos())
                 conj_op(test, completion)
                 if nemesis is not None:
+                    # fault-active gauge: the nemesis layer decides what
+                    # counts as a heal (heal_fs routing lives there)
+                    note = getattr(nemesis, "note_fault_op", None)
+                    if note is not None:
+                        note(completion)
                     _probe_heal(test, nemesis, completion)
             except Exception as e:  # noqa: BLE001 (core.clj:301-306)
                 conj_op(test, op.replace(
@@ -259,6 +282,11 @@ def _nemesis_worker(test: dict, stop: threading.Event):
 def run_case(test: dict) -> History:
     """Run the workload phase: nemesis + workers over the generator;
     returns the raw history (core.clj:331-365)."""
+    with obs.span("core.run_case", name=str(test.get("name"))):
+        return _run_case(test)
+
+
+def _run_case(test: dict) -> History:
     history = History()
     test.setdefault("_history_lock", threading.Lock())
     test.setdefault("_active_histories", [])
@@ -267,7 +295,8 @@ def run_case(test: dict) -> History:
 
     nemesis_obj = test.get("nemesis")
     if nemesis_obj is not None:
-        nemesis_obj.setup(test)
+        with obs.span("nemesis.setup"):
+            nemesis_obj.setup(test)
     stop = threading.Event()
     nemesis_thread = threading.Thread(
         target=_nemesis_worker, args=(test, stop), daemon=True,
@@ -275,58 +304,65 @@ def run_case(test: dict) -> History:
     nemesis_thread.start()
 
     try:
-        n = test["concurrency"]
-        barrier = threading.Barrier(n)
-        workers = [Worker(test, barrier, i) for i in range(n)]
-        threads = [threading.Thread(target=w.run, daemon=True,
-                                    name=f"jepsen-worker-{i}")
-                   for i, w in enumerate(workers)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        for w in workers:
-            if w.error is not None:
-                raise w.error
+        with obs.span("core.workload",
+                      concurrency=test["concurrency"]):
+            n = test["concurrency"]
+            barrier = threading.Barrier(n)
+            workers = [Worker(test, barrier, i) for i in range(n)]
+            threads = [threading.Thread(target=w.run, daemon=True,
+                                        name=f"jepsen-worker-{i}")
+                       for i, w in enumerate(workers)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            for w in workers:
+                if w.error is not None:
+                    raise w.error
     finally:
         # This block is the run's safety net: it executes whether the
         # main phase finished cleanly or a worker raised above, so
         # nemesis teardown AND network healing always run — a crashed
         # worker must not leave the cluster partitioned.
-        stop.set()
-        join_s = test.get("nemesis-join-timeout", 30)
-        nemesis_thread.join(timeout=join_s)
-        if nemesis_thread.is_alive():
-            # The nemesis missed its join deadline: it is wedged inside
-            # an invocation. Abandon the (daemon) thread but make the
-            # leak VISIBLE — loudly in the log and as an info op in the
-            # history, so checkers and humans can see the fault window
-            # never formally closed.
-            log.error(
-                "Nemesis thread missed its %ss join deadline; recording "
-                ":nemesis-wedged and abandoning the thread", join_s)
-            conj_op(test, Op(type=INFO, f="nemesis-wedged", value=None,
-                             process=NEMESIS, time=relative_time_nanos(),
-                             error=f"nemesis thread still running after "
-                                   f"the {join_s}s join timeout"))
-        if nemesis_obj is not None:
-            try:
-                nemesis_obj.teardown(test)
-            except Exception:  # noqa: BLE001
-                log.warning("Nemesis teardown failed: %s",
-                            traceback.format_exc())
-        net = test.get("net")
-        if net is not None:
-            try:
-                net.heal(test)
-            except Exception:  # noqa: BLE001
-                log.warning("net.heal failed during teardown: %s",
-                            traceback.format_exc())
-        # Under the lock: a wedged nemesis thread abandoned above may
-        # still be appending through conj_op — an unlocked remove races
-        # with its iteration over the active-history list.
-        with test["_history_lock"]:
-            test["_active_histories"].remove(history)
+        with obs.span("core.teardown"):
+            stop.set()
+            join_s = test.get("nemesis-join-timeout", 30)
+            nemesis_thread.join(timeout=join_s)
+            if nemesis_thread.is_alive():
+                # The nemesis missed its join deadline: it is wedged
+                # inside an invocation. Abandon the (daemon) thread but
+                # make the leak VISIBLE — loudly in the log and as an
+                # info op in the history, so checkers and humans can
+                # see the fault window never formally closed.
+                log.error(
+                    "Nemesis thread missed its %ss join deadline; "
+                    "recording :nemesis-wedged and abandoning the "
+                    "thread", join_s)
+                _NEMESIS_WEDGED.inc()
+                conj_op(test, Op(
+                    type=INFO, f="nemesis-wedged", value=None,
+                    process=NEMESIS, time=relative_time_nanos(),
+                    error=f"nemesis thread still running after "
+                          f"the {join_s}s join timeout"))
+            if nemesis_obj is not None:
+                try:
+                    nemesis_obj.teardown(test)
+                except Exception:  # noqa: BLE001
+                    log.warning("Nemesis teardown failed: %s",
+                                traceback.format_exc())
+            net = test.get("net")
+            if net is not None:
+                try:
+                    net.heal(test)
+                except Exception:  # noqa: BLE001
+                    log.warning("net.heal failed during teardown: %s",
+                                traceback.format_exc())
+            # Under the lock: a wedged nemesis thread abandoned above
+            # may still be appending through conj_op — an unlocked
+            # remove races with its iteration over the
+            # active-history list.
+            with test["_history_lock"]:
+                test["_active_histories"].remove(history)
     return history
 
 
@@ -439,33 +475,44 @@ def run(test: dict) -> dict:
             store_ns.write_state(test, "running")
             from jepsen_tpu import journal as journal_ns
             test["_journal"] = journal_ns.open_journal(test["store-dir"])
+            # Telemetry rides alongside the WAL: spans stream to
+            # trace.jsonl as they close, so a killed run's timeline is
+            # recoverable too (doc/observability.md).
+            obs.start_run(test["store-dir"])
         except ImportError:
             store = None
 
     try:
-        with control.session_pool(test):
-            client = test["client"]
-            with with_os(test), with_db(test):
-                with with_relative_time():
-                    client.setup(test)
-                    try:
-                        history = run_case(test)
-                    finally:
-                        client.teardown(test)
-            history.index()
-            test["history"] = history
-            if store:
-                store.save_1(test)
-                store.write_state(test, "analyzing")
-            checker = test.get("checker")
-            if checker is not None:
-                test["results"] = check_safe(checker, test, history)
-            else:
-                test["results"] = {"valid": True}
-            if store:
-                store.save_2(test)
-                store.write_state(test, "done")
-                store.stop_logging(test)
+        with obs.span("core.run", name=str(test.get("name"))):
+            with control.session_pool(test):
+                client = test["client"]
+                with with_os(test), with_db(test):
+                    with with_relative_time():
+                        with obs.span("client.setup"):
+                            client.setup(test)
+                        try:
+                            history = run_case(test)
+                        finally:
+                            with obs.span("client.teardown"):
+                                client.teardown(test)
+                history.index()
+                test["history"] = history
+                if store:
+                    with obs.span("store.save"):
+                        store.save_1(test)
+                    store.write_state(test, "analyzing")
+                checker = test.get("checker")
+                if checker is not None:
+                    with obs.span("checker.check",
+                                  ops=len(history)):
+                        test["results"] = check_safe(checker, test,
+                                                     history)
+                else:
+                    test["results"] = {"valid": True}
+                if store:
+                    store.save_2(test)
+                    store.write_state(test, "done")
+                    store.stop_logging(test)
     finally:
         # The WAL survives on disk either way; close() just fsyncs the
         # tail. On a crash path run.state stays 'running', which is
@@ -473,6 +520,17 @@ def run(test: dict) -> dict:
         journal = test.pop("_journal", None)
         if journal is not None:
             journal.close()
+        # metrics.json after the run span closed (so the snapshot sees
+        # it); the trace sink detaches last. Both are gated on the same
+        # JTPU_TRACE switch: with it off, neither artifact exists.
+        if store and obs.enabled():
+            import os as _os
+            try:
+                obs_metrics.write_snapshot(
+                    _os.path.join(test["store-dir"], "metrics.json"))
+            except OSError as e:
+                log.warning("couldn't write metrics.json: %s", e)
+        obs.finish_run()
     log.info("Test %s: valid=%s", test.get("name"),
              test["results"].get("valid"))
     return test

@@ -46,8 +46,19 @@ import zlib
 from typing import Optional, Tuple
 
 from jepsen_tpu.history import History, INFO, Op
+from jepsen_tpu.obs import metrics as obs_metrics
 
 log = logging.getLogger("jepsen.journal")
+
+_FSYNC_SECONDS = obs_metrics.histogram(
+    "jtpu_wal_fsync_seconds",
+    "WAL fsync latency per sync (labeled by the sync policy)")
+_BATCH_RECORDS = obs_metrics.histogram(
+    "jtpu_wal_batch_records",
+    "records accumulated between WAL fsyncs (batch sizes)",
+    buckets=(1, 2, 5, 10, 25, 50, 100, 250, 500, 1000))
+_WAL_RECORDS = obs_metrics.counter(
+    "jtpu_wal_records_total", "ops teed into the write-ahead journal")
 
 #: The journal's filename inside a run's store directory.
 WAL_NAME = "history.wal"
@@ -142,6 +153,7 @@ class Journal:
         self.failed: Optional[str] = None
         self._lock = threading.Lock()
         self._dirty = False
+        self._pending = 0  # records since the last fsync (batch size)
         self._last_sync = time.monotonic()
         self._f = open(path, "ab", buffering=0)
 
@@ -152,9 +164,14 @@ class Journal:
                 f"records={self.records} syncs={self.syncs} {state}>")
 
     def _fsync(self) -> None:
+        t0 = time.monotonic()
         os.fsync(self._f.fileno())
+        _FSYNC_SECONDS.observe(time.monotonic() - t0, sync=self.sync)
+        if self._pending:
+            _BATCH_RECORDS.observe(self._pending)
         self.syncs += 1
         self._dirty = False
+        self._pending = 0
         self._last_sync = time.monotonic()
 
     def append(self, op: Op) -> None:
@@ -167,6 +184,8 @@ class Journal:
             try:
                 self._f.write(line)
                 self.records += 1
+                self._pending += 1
+                _WAL_RECORDS.inc()
                 self._dirty = True
                 if self.sync == SYNC_OP:
                     self._fsync()

@@ -20,7 +20,17 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
 
 from jepsen_tpu import control
 from jepsen_tpu.history import Op
+from jepsen_tpu.obs import metrics as obs_metrics
 from jepsen_tpu.util import majority
+
+#: 1 while a nemesis-injected fault window is open (a non-heal op
+#: completed and no heal-class op has since), 0 otherwise — lets a
+#: dashboard overlay fault windows on latency/throughput series.
+_FAULT_ACTIVE = obs_metrics.gauge(
+    "jtpu_fault_active",
+    "1 while a nemesis fault window is open, 0 after a heal-class op")
+_FAULT_OPS = obs_metrics.counter(
+    "jtpu_nemesis_ops_total", "nemesis ops completed, labeled by f")
 
 # ---------------------------------------------------------------------------
 # Protocol
@@ -57,6 +67,16 @@ class Nemesis:
 
     def teardown(self, test: dict) -> None:
         pass
+
+    def note_fault_op(self, op: Op) -> None:
+        """Telemetry hook (called by the nemesis worker after every
+        completed nemesis op): flips the fault-active gauge — this layer
+        owns the heal-classification (``heal_fs``), so it decides when a
+        fault window opens and closes."""
+        if op.f is None:
+            return
+        _FAULT_OPS.inc(f=str(op.f))
+        _FAULT_ACTIVE.set(0.0 if op.f in (self.heal_fs or ()) else 1.0)
 
     def verify_heal(self, test: dict, op: Op) -> Optional[dict]:
         """Run the heal probe for a completed nemesis op, or None when

@@ -1,0 +1,45 @@
+"""Observability: end-to-end spans and a metrics registry.
+
+Until this package, the only windows into a run were post-hoc history
+latencies (:mod:`jepsen_tpu.checker.perf`) and the resilience layer's
+terse ``attempts`` trail — when a 1M-op device search stalls or a
+nemesis wedge eats a run, nobody can see *where* the time went.
+P-compositionality work (Horn & Kroening, arXiv:1504.00204) shows
+linearizability-check cost is dominated by a few pathological frontier
+expansions; exploiting that requires per-level / per-segment telemetry,
+and this package is that substrate. Two halves:
+
+* :mod:`jepsen_tpu.obs.trace` — a zero-dependency, thread-safe span
+  tracer. ``with span("checker.segment", level=...)`` records a
+  monotonic-clock span into an in-memory ring and (during a stored run)
+  a per-run ``trace.jsonl`` artifact, exportable as Chrome trace-event
+  JSON that loads directly in Perfetto (``jtpu trace export``).
+* :mod:`jepsen_tpu.obs.metrics` — counters, gauges, and fixed-bucket
+  histograms with label support, snapshotted to ``metrics.json`` at run
+  end and served as Prometheus text exposition at ``/metrics`` by
+  :mod:`jepsen_tpu.web`.
+
+Every layer is instrumented: ``core.run_case`` (setup / client-invoke /
+nemesis / teardown spans, op-timeout and wedge counters), the WAL
+(fsync latency, batch sizes), the resilience supervisor (segment spans,
+OOM/backoff counters), the nemesis layer (fault-active gauge,
+heal-probe durations), and the device search itself (compile vs execute
+time, per-segment level counts, frontier-width high-water marks,
+transfer bytes).
+
+Kill switch: ``JTPU_TRACE=0`` disables the whole package — spans become
+no-ops, no ``trace.jsonl`` / ``metrics.json`` artifacts are written,
+and a run's verdicts and ``history.jsonl`` are byte-identical to the
+pre-observability behavior. Timing must never come from inside a traced
+JAX body (the ``JAX-TRACE-IN-JIT`` lint rule enforces this): device
+phases are measured on the host around ``block_until_ready``.
+
+See doc/observability.md for the span/metric catalog.
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu.obs.trace import (  # noqa: F401
+    TRACE_NAME, Tracer, enabled, event, finish_run, read_trace, span,
+    start_run, to_chrome, tracer)
+from jepsen_tpu.obs import metrics  # noqa: F401

@@ -1,0 +1,354 @@
+"""Span tracer: where did the wall-clock go?
+
+A *span* is a named, attributed interval measured on the monotonic
+clock (``time.monotonic_ns`` — wall-clock steps from a misbehaving NTP
+daemon or a clock-scrambling nemesis must not corrupt the timeline the
+tracer exists to explain). Spans nest per thread via a thread-local
+stack, so a worker's ``client.invoke`` span is parented under
+``core.run_case`` automatically.
+
+Recording is two-tier, mirroring the WAL's philosophy
+(:mod:`jepsen_tpu.journal`):
+
+* an **in-memory ring** (bounded deque, ``JTPU_TRACE_RING`` entries,
+  default 8192) always holds the most recent spans for in-process
+  consumers (tests, the resilience supervisor's diagnostics);
+* during a stored run, every finished span is also appended as one
+  JSON line to ``trace.jsonl`` in the run directory — written with a
+  single unbuffered write per span, so a SIGKILL loses at most the
+  in-flight line and :func:`read_trace` tolerates the torn tail
+  exactly like the WAL reader.
+
+A record is ``{"name", "ts", "dur", "tid", "sid", "pid", ...attrs}``
+with ``ts``/``dur`` in nanoseconds relative to the tracer's epoch.
+:func:`to_chrome` converts a record list to Chrome trace-event JSON
+(the ``traceEvents`` array form), which Perfetto and ``chrome://
+tracing`` load directly; the CLI surface is ``jtpu trace export``.
+
+Kill switch: ``JTPU_TRACE=0`` makes :func:`span`/:func:`event` return
+shared no-op objects — no ring append, no file, no measurable work.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+log = logging.getLogger("jepsen.obs")
+
+#: The trace artifact's filename inside a run's store directory.
+TRACE_NAME = "trace.jsonl"
+
+DEFAULT_RING = 8192
+
+
+def enabled() -> bool:
+    """Whether observability is on at all (JTPU_TRACE, default on).
+    Shared by the tracer and the metrics artifacts: with it off, a run
+    writes no trace.jsonl / metrics.json and behaves byte-for-byte like
+    the pre-observability tree."""
+    return os.environ.get("JTPU_TRACE", "1").lower() not in (
+        "0", "false", "no", "off")
+
+
+def ring_size() -> int:
+    v = os.environ.get("JTPU_TRACE_RING")
+    if not v:
+        return DEFAULT_RING
+    try:
+        return max(16, int(v))
+    except ValueError:
+        log.warning("JTPU_TRACE_RING=%r is not an integer; using %s",
+                    v, DEFAULT_RING)
+        return DEFAULT_RING
+
+
+class _NoopSpan:
+    """The disabled-path span: a shared, attribute-dropping context
+    manager so instrumented call sites cost a dict construction and
+    nothing else when JTPU_TRACE=0."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs):
+        return self
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class _Span:
+    """One live span. Use as a context manager; ``set(**attrs)`` adds
+    attributes any time before exit (e.g. a result computed inside the
+    block). An exception exiting the block is recorded as an ``error``
+    attribute — the span still closes, so a crashed phase is visible in
+    the waterfall instead of vanishing."""
+
+    __slots__ = ("tracer", "name", "attrs", "sid", "pid", "tid", "t0")
+
+    def __init__(self, tracer: "Tracer", name: str, attrs: dict):
+        self.tracer = tracer
+        self.name = name
+        self.attrs = attrs
+
+    def set(self, **attrs) -> "_Span":
+        self.attrs.update(attrs)
+        return self
+
+    def __enter__(self) -> "_Span":
+        tr = self.tracer
+        self.sid = next(tr._ids)
+        self.tid = threading.get_ident()
+        stack = tr._stack()
+        self.pid = stack[-1] if stack else 0
+        stack.append(self.sid)
+        self.t0 = time.monotonic_ns()
+        return self
+
+    def __exit__(self, etype, evalue, tb):
+        dur = time.monotonic_ns() - self.t0
+        stack = self.tracer._stack()
+        if stack and stack[-1] == self.sid:
+            stack.pop()
+        if etype is not None:
+            self.attrs["error"] = f"{etype.__name__}: {evalue}"
+        rec = {"name": self.name,
+               "ts": self.t0 - self.tracer.epoch_ns,
+               "dur": dur, "tid": self.tid, "sid": self.sid}
+        if self.pid:
+            rec["pid"] = self.pid
+        if self.attrs:
+            rec.update({k: v for k, v in self.attrs.items()
+                        if k not in rec})
+        self.tracer._record(rec)
+        return False
+
+
+class Tracer:
+    """Thread-safe span recorder: bounded ring plus an optional
+    ``trace.jsonl`` sink. A sink write failure disables the sink (a run
+    must never die because its telemetry file did) — visible via
+    :attr:`failed` and a log line, like the WAL's contract."""
+
+    def __init__(self, path: Optional[str] = None,
+                 ring: Optional[int] = None):
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=ring or ring_size())
+        self._local = threading.local()
+        self._ids = itertools.count(1)
+        self.epoch_ns = time.monotonic_ns()
+        self.recorded = 0
+        self.failed: Optional[str] = None
+        self._f = None
+        self.path: Optional[str] = None
+        if path:
+            self.attach(path)
+
+    def _stack(self) -> list:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = []
+            self._local.stack = st
+        return st
+
+    # -- recording ----------------------------------------------------------
+
+    def span(self, name: str, /, **attrs) -> _Span:
+        return _Span(self, name, attrs)
+
+    def event(self, name: str, /, **attrs) -> None:
+        """A zero-duration instant record (Chrome ``ph: "i"``)."""
+        rec = {"name": name,
+               "ts": time.monotonic_ns() - self.epoch_ns,
+               "dur": 0, "tid": threading.get_ident(),
+               "sid": next(self._ids)}
+        stack = self._stack()
+        if stack:
+            rec["pid"] = stack[-1]
+        if attrs:
+            rec.update({k: v for k, v in attrs.items() if k not in rec})
+        self._record(rec)
+
+    def _record(self, rec: dict) -> None:
+        line = None
+        if self._f is not None and self.failed is None:
+            line = json.dumps(rec, separators=(",", ":"),
+                              default=repr).encode("utf-8") + b"\n"
+        with self._lock:
+            self._ring.append(rec)
+            self.recorded += 1
+            if line is not None and self._f is not None \
+                    and self.failed is None:
+                try:
+                    # one unbuffered write per span: the kernel has the
+                    # whole line, so a SIGKILL loses at most the span
+                    # being written (read_trace drops the torn tail)
+                    self._f.write(line)
+                except OSError as e:
+                    self.failed = f"{type(e).__name__}: {e}"
+                    log.warning(
+                        "trace sink %s failed (%s); tracing continues "
+                        "in-memory only", self.path, self.failed)
+
+    # -- sink lifecycle -----------------------------------------------------
+
+    def attach(self, path: str) -> None:
+        """Open (append) a trace.jsonl sink; replaces any current one."""
+        with self._lock:
+            self._detach_locked()
+            try:
+                self._f = open(path, "ab", buffering=0)
+                self.path = path
+                self.failed = None
+            except OSError as e:
+                log.warning("couldn't open trace sink %s: %s", path, e)
+                self._f, self.path = None, None
+
+    def _detach_locked(self) -> None:
+        if self._f is not None:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+        self._f, self.path = None, None
+
+    def detach(self) -> None:
+        with self._lock:
+            self._detach_locked()
+
+    # -- reading ------------------------------------------------------------
+
+    def spans(self) -> List[dict]:
+        """A snapshot of the ring, oldest first."""
+        with self._lock:
+            return list(self._ring)
+
+
+# ---------------------------------------------------------------------------
+# The process-global tracer (what the instrumentation uses)
+# ---------------------------------------------------------------------------
+
+_GLOBAL = Tracer()
+
+
+def tracer() -> Tracer:
+    return _GLOBAL
+
+
+def span(name: str, /, **attrs):
+    """``with span("checker.segment", level=...):`` — records into the
+    global tracer, or a shared no-op when JTPU_TRACE=0."""
+    if not enabled():
+        return NOOP_SPAN
+    return _GLOBAL.span(name, **attrs)
+
+
+def event(name: str, /, **attrs) -> None:
+    if enabled():
+        _GLOBAL.event(name, **attrs)
+
+
+def start_run(store_dir: Optional[str]) -> None:
+    """Attach the global tracer's file sink to a run's store directory
+    (``core.run`` calls this once the directory exists). No-op when
+    disabled or dir-less — the ring keeps working either way."""
+    if not store_dir or not enabled():
+        return
+    _GLOBAL.attach(os.path.join(store_dir, TRACE_NAME))
+
+
+def finish_run() -> None:
+    """Close the file sink (the ring survives for in-process readers)."""
+    _GLOBAL.detach()
+
+
+# ---------------------------------------------------------------------------
+# Artifact reading + export
+# ---------------------------------------------------------------------------
+
+
+def read_trace(path: str) -> Tuple[List[dict], Dict[str, int]]:
+    """Torn-tail-tolerant trace.jsonl reader (the WAL reader's contract:
+    a run SIGKILLed mid-span-write leaves at most one partial final
+    line, dropped silently as ``torn``; an undecodable *earlier* line is
+    real corruption — skipped, counted, warned about)."""
+    stats = {"spans": 0, "torn": 0, "corrupt": 0}
+    with open(path, "rb") as f:
+        data = f.read()
+    lines = data.split(b"\n")
+    terminated = data.endswith(b"\n")
+    if lines and lines[-1] == b"":
+        lines.pop()
+    out: List[dict] = []
+    for i, line in enumerate(lines):
+        try:
+            rec = json.loads(line)
+            if not isinstance(rec, dict) or "name" not in rec \
+                    or "ts" not in rec:
+                raise ValueError("not a span record")
+            out.append(rec)
+            stats["spans"] += 1
+        except (ValueError, TypeError):
+            if i == len(lines) - 1 and not terminated:
+                stats["torn"] += 1
+            else:
+                stats["corrupt"] += 1
+                log.warning("trace %s: dropping corrupt record at "
+                            "line %d", path, i + 1)
+    return out, stats
+
+
+#: Chrome trace-event metadata keys a span record maps onto directly;
+#: everything else rides in ``args``.
+_RESERVED = ("name", "ts", "dur", "tid", "sid", "pid")
+
+
+def to_chrome(records: List[dict], process_name: str = "jtpu") -> dict:
+    """Records -> Chrome trace-event JSON (object form). Loads in
+    Perfetto (ui.perfetto.dev) and chrome://tracing. Complete events
+    (``ph: "X"``) for spans, instants (``ph: "i"``) for zero-duration
+    events; timestamps are microseconds as the format requires."""
+    events: List[dict] = [{
+        "name": "process_name", "ph": "M", "pid": 1, "tid": 0,
+        "args": {"name": process_name}}]
+    for r in records:
+        args = {k: v for k, v in r.items() if k not in _RESERVED}
+        if "pid" in r:
+            args["parent"] = r["pid"]
+        ev = {"name": str(r.get("name", "?")), "cat": "jtpu",
+              "pid": 1, "tid": int(r.get("tid", 0)),
+              "ts": r.get("ts", 0) / 1e3, "args": args}
+        if r.get("dur", 0) > 0:
+            ev["ph"] = "X"
+            ev["dur"] = r["dur"] / 1e3
+        else:
+            ev["ph"] = "i"
+            ev["s"] = "t"
+        events.append(ev)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def summarize(records: List[dict]) -> Dict[str, Dict[str, Any]]:
+    """Per-name rollup: count, total/max duration (ns) — the payload of
+    ``jtpu trace summary`` and the ``# trace:`` recovery line."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for r in records:
+        s = out.setdefault(str(r.get("name", "?")),
+                           {"count": 0, "total-ns": 0, "max-ns": 0})
+        s["count"] += 1
+        d = int(r.get("dur", 0) or 0)
+        s["total-ns"] += d
+        s["max-ns"] = max(s["max-ns"], d)
+    return dict(sorted(out.items()))

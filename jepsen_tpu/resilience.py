@@ -51,13 +51,27 @@ from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
-from jepsen_tpu import accel
+from jepsen_tpu import accel, obs
 from jepsen_tpu.checker import UNKNOWN
 from jepsen_tpu.checker import tpu as T
 from jepsen_tpu.models.core import KernelSpec, Model
+from jepsen_tpu.obs import metrics as obs_metrics
 from jepsen_tpu.ops.encode import PackedHistory, pack_with_init
 
 log = logging.getLogger("jepsen.resilience")
+
+_OOM_TOTAL = obs_metrics.counter(
+    "jtpu_search_oom_total",
+    "device OOMs answered by pool-halving during supervised searches")
+_WEDGE_TOTAL = obs_metrics.counter(
+    "jtpu_search_wedge_total",
+    "device segments abandoned by the wedge watchdog")
+_TRANSIENT_TOTAL = obs_metrics.counter(
+    "jtpu_search_transient_retries_total",
+    "transient device failures retried from their checkpoint")
+_BACKOFF_SECONDS = obs_metrics.counter(
+    "jtpu_search_backoff_seconds_total",
+    "seconds slept in supervised-search retry backoff")
 
 # ---------------------------------------------------------------------------
 # Failure taxonomy
@@ -389,6 +403,16 @@ def supervised_check_packed(p: PackedHistory, kernel: KernelSpec,
     trail: list = []
     work: list = []
     out: Dict[str, Any] = {}
+    # Search telemetry accumulated across rungs and surfaced in the
+    # result (doc/observability.md): compile/execute wall split,
+    # per-segment level advances, frontier-width high-water mark, and
+    # transfer-byte accounting — what lets bench.py and the `# search:`
+    # summary attribute wall-clock to compile/device/host phases.
+    device_s = {"compile": 0.0, "execute": 0.0}
+    seg_levels: list = []
+    frontier_hwm = 0
+    transfer_bytes = 0
+    cols_b = T._cols_nbytes(cols)
     if resume is not None:
         idx = next((i for i, r in enumerate(ladder)
                     if tuple(r) == tuple(resume.rung)), None)
@@ -411,13 +435,22 @@ def supervised_check_packed(p: PackedHistory, kernel: KernelSpec,
         transients = ooms = 0
         abort: Optional[str] = None
         while T._carry_active(carry, lmax):
+            unroll = T._unroll_factor()
             fn = T._jit_segment(T._kernel_key(kernel), cap_eff, win,
-                                exp_eff, T._unroll_factor())
+                                exp_eff, unroll)
             ctx = {"rung": (cap, win, exp),
                    "effective": (cap_eff, win, exp_eff),
                    "segment": seg_idx, "level": int(carry[8]),
                    "backend": ("cpu-fallback" if fallback is not None
                                else "default")}
+            shape_key = ("segment", T._kernel_key(kernel), cap_eff, win,
+                         exp_eff, unroll, cols["f"].shape[0],
+                         cols["cf"].shape[0])
+            # phase decided up front, marked executed only on success: a
+            # segment that dies mid-compile pays compile again on retry
+            phase = ("compile" if shape_key not in T._EXECUTED_SHAPES
+                     else "execute")
+            lvl0 = int(carry[8])
             try:
                 if _inject_fault is not None:
                     _inject_fault(dict(ctx))
@@ -425,12 +458,19 @@ def supervised_check_packed(p: PackedHistory, kernel: KernelSpec,
                 # (fallback) execution is trusted the same way accel
                 # trusts CPU init — and its first segment legitimately
                 # spends deadline-sized time compiling.
-                carry = _call_segment(fn, cols, carry, seg,
-                                      device=fallback,
-                                      deadline_s=(None if fallback
-                                                  is not None
-                                                  else deadline_s))
+                with obs.span("checker.segment", phase=phase,
+                              segment=seg_idx, level=lvl0,
+                              backend=ctx["backend"]) as sp:
+                    t0 = time.perf_counter()
+                    carry = _call_segment(fn, cols, carry, seg,
+                                          device=fallback,
+                                          deadline_s=(None if fallback
+                                                      is not None
+                                                      else deadline_s))
+                    seg_s = time.perf_counter() - t0
+                    sp.set(level_end=int(carry[8]))
             except WedgeError as e:
+                _WEDGE_TOTAL.inc()
                 if fallback is not None:
                     trail.append({**ctx, "event": WEDGE,
                                   "outcome": "gave-up",
@@ -460,6 +500,7 @@ def supervised_check_packed(p: PackedHistory, kernel: KernelSpec,
                 cls = classify_failure(e)
                 if cls == OOM:
                     ooms += 1
+                    _OOM_TOTAL.inc()
                     new_cap = cap_eff // 2
                     if new_cap < policy.min_capacity:
                         trail.append({**ctx, "event": OOM,
@@ -482,9 +523,11 @@ def supervised_check_packed(p: PackedHistory, kernel: KernelSpec,
                         "device OOM at level %s; halving the pool to %s "
                         "rows and resuming the checkpoint (backoff "
                         "%.2fs)", int(carry[8]), cap_eff, delay)
+                    _BACKOFF_SECONDS.inc(delay)
                     time.sleep(delay)
                 elif cls == TRANSIENT:
                     transients += 1
+                    _TRANSIENT_TOTAL.inc()
                     if transients > policy.max_retries:
                         trail.append({**ctx, "event": TRANSIENT,
                                       "outcome": "retries-exhausted",
@@ -503,6 +546,7 @@ def supervised_check_packed(p: PackedHistory, kernel: KernelSpec,
                         "transient device failure (%s); retrying the "
                         "segment from its checkpoint in %.2fs",
                         _errstr(e), delay)
+                    _BACKOFF_SECONDS.inc(delay)
                     time.sleep(delay)
                 else:
                     trail.append({**ctx, "event": FATAL,
@@ -516,6 +560,26 @@ def supervised_check_packed(p: PackedHistory, kernel: KernelSpec,
             else:
                 seg_idx += 1
                 transients = 0
+                # success: mark the shape compiled, account the segment
+                T._EXECUTED_SHAPES.add(shape_key)
+                device_s[phase] += seg_s
+                T._DEVICE_SECONDS.observe(seg_s, kind="segment",
+                                          phase=phase)
+                lvl1 = int(carry[8])
+                seg_levels.append(lvl1 - lvl0)
+                alive = int(np.count_nonzero(np.asarray(carry[4])))
+                frontier_hwm = max(frontier_hwm, alive)
+                T._LEVELS_TOTAL.inc(lvl1 - lvl0)
+                T._SEGMENTS_TOTAL.inc()
+                T._FRONTIER_HWM.set_max(alive)
+                carry_b = sum(int(np.asarray(x).nbytes) for x in carry)
+                # each segment re-ships the packed columns and the carry
+                # to the device and snapshots the carry back to host
+                T._TRANSFER_BYTES.inc(cols_b + carry_b,
+                                      direction="host-to-device")
+                T._TRANSFER_BYTES.inc(carry_b,
+                                      direction="device-to-host")
+                transfer_bytes += cols_b + 2 * carry_b
                 if checkpoint_path or on_checkpoint is not None:
                     cp = Checkpoint(carry=carry, rung=(cap, win, exp),
                                     window=win, expand_eff=exp_eff,
@@ -547,6 +611,15 @@ def supervised_check_packed(p: PackedHistory, kernel: KernelSpec,
         out["segments"] = seg_idx
         out["segment-iters"] = seg
         out["attempts"] = list(trail)
+        # Telemetry (doc/observability.md): the compile/execute wall
+        # split (host-measured around block_until_ready), per-segment
+        # level advances, the frontier-width high-water mark, and
+        # bytes shipped to/from the device — what `# search:` summaries
+        # and bench.py read to attribute wall-clock.
+        out["device-s"] = {k: round(v, 6) for k, v in device_s.items()}
+        out["segment-levels"] = list(seg_levels)
+        out["frontier-hwm"] = frontier_hwm
+        out["transfer-bytes"] = transfer_bytes
         if fallback is not None:
             out["backend-fallback"] = "cpu"
         if out["valid"] is not UNKNOWN:

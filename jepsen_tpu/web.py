@@ -5,6 +5,15 @@ server: a test table with validity color-coding ('/'), a file/directory
 browser with text and image previews ('/files/...'), streaming zip
 downloads of run directories ('?zip'), and the same path-traversal guard
 the reference enforces (web.clj:273-278 assert-file-in-scope!).
+
+Observability surfaces (doc/observability.md):
+
+* ``/metrics`` — this process's metrics registry in Prometheus text
+  exposition format, scrapeable like any other production workload;
+* ``/trace/<test>/<timestamp>`` — a span-waterfall rendering of a run's
+  ``trace.jsonl`` (the home table links it, alongside the per-run
+  ``trace.jsonl``/``metrics.json`` artifacts in the file browser and
+  zip export).
 """
 
 from __future__ import annotations
@@ -159,6 +168,10 @@ class Handler(BaseHTTPRequestHandler):
         try:
             if path == "/":
                 return self.home()
+            if path == "/metrics":
+                return self.metrics()
+            if path.startswith("/trace/"):
+                return self.trace(path[len("/trace/"):])
             if path.startswith("/files/"):
                 return self.files(path[len("/files/"):],
                                   zip_requested=url.query == "zip")
@@ -178,7 +191,8 @@ class Handler(BaseHTTPRequestHandler):
 
     def home(self):
         """Test table with validity colors (web.clj:116-128); crashed
-        and recovered runs carry a status badge."""
+        and recovered runs carry a status badge, traced runs a span-
+        waterfall link."""
         rows = []
         for name, ts, valid, status in run_rows(self.root):
             color = VALID_COLORS.get(valid, "#ffffff")
@@ -186,18 +200,64 @@ class Handler(BaseHTTPRequestHandler):
                 color = VALID_COLORS["unknown"]
             link = f"/files/{quote(name)}/{quote(ts)}/"
             badge = self.STATUS_LABELS.get(status, "")
+            trace_cell = ""
+            if os.path.exists(os.path.join(self.root, name, ts,
+                                           "trace.jsonl")):
+                trace_cell = (f"<a href='/trace/{quote(name)}/"
+                              f"{quote(ts)}'>trace</a>")
             rows.append(
                 f"<tr style='background:{color}'>"
                 f"<td class=valid>{html.escape(str(valid))}</td>"
                 f"<td><a href='{link}'>{html.escape(name)}</a></td>"
                 f"<td><a href='{link}'>{html.escape(ts)}</a></td>"
                 f"<td>{html.escape(badge)}</td>"
+                f"<td>{trace_cell}</td>"
                 f"<td><a href='{link[:-1]}?zip'>zip</a></td></tr>")
         body = ("<table><tr><th>valid</th><th>test</th><th>time</th>"
-                "<th>state</th><th></th></tr>" + "".join(rows) +
-                "</table>"
+                "<th>state</th><th>trace</th><th></th></tr>"
+                + "".join(rows) + "</table>"
                 if rows else "<p>No tests run yet.</p>")
+        body += ("<p><a href='/metrics'>/metrics</a> — Prometheus "
+                 "exposition for this process</p>")
         self._page("Jepsen-TPU results", body)
+
+    def metrics(self):
+        """Prometheus text exposition of this process's registry —
+        the scrape target a production deployment points its collector
+        at (doc/observability.md has the metric catalog)."""
+        # Importing the (jax-free) instrumented layers registers their
+        # metric catalog, so a fresh `serve` process exposes the stable
+        # series names instead of an empty page; the checker-stack
+        # metrics appear once a check runs in this process.
+        from jepsen_tpu import core as _core  # noqa: F401
+        from jepsen_tpu import journal as _journal  # noqa: F401
+        from jepsen_tpu import nemesis as _nemesis  # noqa: F401
+        from jepsen_tpu.obs import metrics as obs_metrics
+        self._send(200, obs_metrics.REGISTRY.to_prometheus().encode(),
+                   ctype=obs_metrics.PROMETHEUS_CTYPE)
+
+    #: Spans rendered per waterfall page (deepest-first file order);
+    #: beyond this the page says how many were elided.
+    TRACE_ROW_CAP = 2000
+
+    def trace(self, rel: str):
+        """Span waterfall for one run's trace.jsonl: each span is a bar
+        positioned/sized by its ts/dur on a common timeline, grouped by
+        thread, colored by span name — the 'where did the wall-clock
+        go' page. Tolerates torn tails (the run may have been killed
+        mid-write, or still be running)."""
+        run_dir = os.path.join(self.root, rel.strip("/"))
+        if not _within(self.root, run_dir):
+            return self._page("403", "<p>Forbidden.</p>", code=403)
+        path = os.path.join(run_dir, "trace.jsonl")
+        if not os.path.exists(path):
+            return self._page("404", "<p>No trace.jsonl for this run "
+                                     "(JTPU_TRACE=0?).</p>", code=404)
+        from jepsen_tpu.obs import trace as trace_ns
+        records, stats = trace_ns.read_trace(path)
+        self._page(f"trace {rel}",
+                   _waterfall_html(records, stats,
+                                   cap=self.TRACE_ROW_CAP))
 
     def files(self, rel: str, zip_requested: bool = False):
         """Static file / dir browser / zip download (web.clj:194-271)."""
@@ -293,6 +353,73 @@ class Handler(BaseHTTPRequestHandler):
             # re-raising would let do_GET's generic 500 page inject
             # status-line bytes into the middle of the body framing.
             self.close_connection = True
+
+
+#: Categorical bar palette for the waterfall (cycled by span-name hash).
+_TRACE_COLORS = ("#4E79A7", "#F28E2B", "#59A14F", "#E15759", "#B07AA1",
+                 "#76B7B2", "#EDC948", "#9C755F")
+
+
+def _fmt_ns(ns: float) -> str:
+    if ns >= 1e9:
+        return f"{ns / 1e9:.2f}s"
+    if ns >= 1e6:
+        return f"{ns / 1e6:.1f}ms"
+    return f"{ns / 1e3:.0f}us"
+
+
+def _waterfall_html(records, stats, cap: int = 2000) -> str:
+    """Span records -> one self-contained HTML waterfall (no JS): bars
+    positioned by percentage offsets on the run's timeline, grouped by
+    thread, durations inline. Links the raw artifact for Perfetto-level
+    digging (`jtpu trace export --format chrome`)."""
+    spans = [r for r in records if r.get("dur", 0) > 0]
+    if not spans:
+        return (f"<p>No spans ({stats['torn']} torn, "
+                f"{stats['corrupt']} corrupt line(s)).</p>")
+    t0 = min(r["ts"] for r in spans)
+    t1 = max(r["ts"] + r["dur"] for r in spans)
+    total = max(t1 - t0, 1)
+    by_tid = {}
+    for r in spans:
+        by_tid.setdefault(r.get("tid", 0), []).append(r)
+    names = sorted({str(r["name"]) for r in spans})
+    color = {n: _TRACE_COLORS[i % len(_TRACE_COLORS)]
+             for i, n in enumerate(names)}
+    parts = [f"<p>{len(spans)} span(s) over {_fmt_ns(total)}; "
+             f"{stats['torn']} torn, {stats['corrupt']} corrupt. "
+             f"Full fidelity: <code>jtpu trace export --format chrome"
+             f"</code> &rarr; ui.perfetto.dev</p>",
+             "<div style='font-size:11px'>"]
+    shown = 0
+    for tid in sorted(by_tid):
+        rows = sorted(by_tid[tid], key=lambda r: r["ts"])
+        parts.append(f"<h3>thread {tid}</h3>")
+        for r in rows:
+            if shown >= cap:
+                break
+            shown += 1
+            left = 100.0 * (r["ts"] - t0) / total
+            width = max(100.0 * r["dur"] / total, 0.1)
+            label = html.escape(f"{r['name']} ({_fmt_ns(r['dur'])})")
+            attrs = {k: v for k, v in r.items()
+                     if k not in ("name", "ts", "dur", "tid", "sid",
+                                  "pid")}
+            tip = html.escape(json.dumps(attrs, default=repr)) \
+                if attrs else ""
+            parts.append(
+                "<div style='position:relative;height:15px;"
+                "margin:1px 0;background:#f5f5f5'>"
+                f"<div title='{tip}' style='position:absolute;"
+                f"left:{left:.3f}%;width:{width:.3f}%;height:100%;"
+                f"background:{color[str(r['name'])]}'></div>"
+                f"<span style='position:relative;padding-left:4px'>"
+                f"{label}</span></div>")
+    parts.append("</div>")
+    if shown < len(spans):
+        parts.append(f"<p>{len(spans) - shown} span(s) elided "
+                     f"(cap {cap}).</p>")
+    return "".join(parts)
 
 
 def serve(host: str = "127.0.0.1", port: int = 8080,

@@ -35,6 +35,11 @@ def pytest_configure(config):
         "markers", "lint: static-analysis subsystem tests "
         "(tests/test_lint.py): per-pass fixtures, the pre-search "
         "history gate, and the repo self-lint against lint.baseline")
+    config.addinivalue_line(
+        "markers", "obs: observability subsystem tests "
+        "(tests/test_obs.py): span tracer, metrics registry, "
+        "Prometheus/Chrome exports, run artifacts, and the "
+        "JTPU_TRACE kill switch")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,497 @@
+"""Observability subsystem (jepsen_tpu.obs): span tracer semantics,
+metrics registry math, export formats, run artifacts, the JTPU_TRACE
+kill switch, and the /metrics endpoint. Tier-1 under the ``obs``
+marker (doc/observability.md is the operator view)."""
+
+import json
+import os
+import threading
+import urllib.request
+
+import pytest
+
+from jepsen_tpu import obs
+from jepsen_tpu.obs import metrics as obs_metrics
+from jepsen_tpu.obs import trace as obs_trace
+
+pytestmark = pytest.mark.obs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Tracer
+# ---------------------------------------------------------------------------
+
+
+class TestSpans:
+    def test_nesting_parents_and_order(self):
+        tr = obs_trace.Tracer()
+        with tr.span("outer", layer="core"):
+            with tr.span("inner"):
+                pass
+            with tr.span("inner2"):
+                pass
+        recs = tr.spans()
+        assert [r["name"] for r in recs] == ["inner", "inner2", "outer"]
+        outer = recs[2]
+        assert "pid" not in outer and outer["layer"] == "core"
+        assert recs[0]["pid"] == outer["sid"]
+        assert recs[1]["pid"] == outer["sid"]
+        assert all(r["dur"] >= 0 and r["ts"] >= 0 for r in recs)
+
+    def test_name_attr_does_not_collide(self):
+        # span("x", name=...) must record name as an attribute, not
+        # clobber the span's own name (positional-only parameter)
+        tr = obs_trace.Tracer()
+        with tr.span("core.run", name="etcd-cas"):
+            pass
+        (r,) = tr.spans()
+        assert r["name"] == "core.run"
+
+    def test_exception_recorded_and_propagated(self):
+        tr = obs_trace.Tracer()
+        with pytest.raises(ValueError):
+            with tr.span("boom"):
+                raise ValueError("kaput")
+        (r,) = tr.spans()
+        assert r["error"] == "ValueError: kaput"
+
+    def test_threads_do_not_cross_parent(self):
+        tr = obs_trace.Tracer()
+        done = threading.Event()
+
+        def child():
+            with tr.span("child-span"):
+                pass
+            done.set()
+
+        with tr.span("parent-span"):
+            t = threading.Thread(target=child)
+            t.start()
+            t.join()
+        assert done.is_set()
+        by_name = {r["name"]: r for r in tr.spans()}
+        # the other thread's span is a root: no cross-thread parent
+        assert "pid" not in by_name["child-span"]
+        assert by_name["child-span"]["tid"] != \
+            by_name["parent-span"]["tid"]
+
+    def test_ring_is_bounded(self):
+        tr = obs_trace.Tracer(ring=16)
+        for i in range(100):
+            with tr.span(f"s{i}"):
+                pass
+        recs = tr.spans()
+        assert len(recs) == 16
+        assert recs[-1]["name"] == "s99"
+        assert tr.recorded == 100
+
+    def test_event_is_instant(self):
+        tr = obs_trace.Tracer()
+        tr.event("search.oom", outcome="pool-halved")
+        (r,) = tr.spans()
+        assert r["dur"] == 0 and r["outcome"] == "pool-halved"
+
+    def test_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("JTPU_TRACE", "0")
+        before = obs.tracer().recorded
+        sp = obs.span("nope", x=1)
+        assert sp is obs_trace.NOOP_SPAN
+        with sp:
+            sp.set(y=2)
+        obs.event("nope-either")
+        assert obs.tracer().recorded == before
+        monkeypatch.setenv("JTPU_TRACE", "1")
+        with obs.span("yes"):
+            pass
+        assert obs.tracer().recorded == before + 1
+
+
+class TestTraceArtifact:
+    def test_sink_and_tail_tolerant_read(self, tmp_path):
+        p = str(tmp_path / "trace.jsonl")
+        tr = obs_trace.Tracer(path=p)
+        with tr.span("a"):
+            with tr.span("b"):
+                pass
+        tr.detach()
+        recs, stats = obs_trace.read_trace(p)
+        assert stats == {"spans": 2, "torn": 0, "corrupt": 0}
+        assert [r["name"] for r in recs] == ["b", "a"]
+        # a SIGKILL mid-write leaves a torn, unterminated tail: dropped
+        # silently, earlier records intact
+        with open(p, "ab") as f:
+            f.write(b'{"name": "torn", "ts": 12')
+        recs, stats = obs_trace.read_trace(p)
+        assert stats == {"spans": 2, "torn": 1, "corrupt": 0}
+        # a corrupt MIDDLE line (terminated) counts as corruption
+        with open(p, "ab") as f:
+            f.write(b'3, "dur": 0}garbage\n')
+        with tr.span("c"):
+            pass  # ring only; sink detached
+        recs, stats = obs_trace.read_trace(p)
+        assert stats["corrupt"] == 1 and stats["spans"] == 2
+
+    def test_chrome_export_matches_golden(self):
+        records = [
+            {"name": "core.run", "ts": 1000, "dur": 9000, "tid": 7,
+             "sid": 1, "name_attr": "demo"},
+            {"name": "checker.segment", "ts": 2000, "dur": 3000,
+             "tid": 7, "sid": 2, "pid": 1, "phase": "compile",
+             "level": 0},
+            {"name": "search.oom", "ts": 6000, "dur": 0, "tid": 7,
+             "sid": 3, "pid": 1, "outcome": "pool-halved-to-64"},
+        ]
+        golden_path = os.path.join(REPO, "tests", "fixtures", "obs",
+                                   "chrome_golden.json")
+        with open(golden_path) as f:
+            golden = json.load(f)
+        assert obs_trace.to_chrome(records,
+                                   process_name="golden") == golden
+        # structural invariants Perfetto relies on: complete events
+        # carry dur, instants carry a scope, ts is microseconds
+        evs = golden["traceEvents"]
+        assert evs[1]["ph"] == "X" and evs[1]["ts"] == 1.0
+        assert evs[3]["ph"] == "i" and evs[3]["s"] == "t"
+
+    def test_summarize(self):
+        recs = [{"name": "a", "ts": 0, "dur": 5},
+                {"name": "a", "ts": 1, "dur": 7},
+                {"name": "b", "ts": 2, "dur": 1}]
+        s = obs_trace.summarize(recs)
+        assert s["a"] == {"count": 2, "total-ns": 12, "max-ns": 7}
+        assert list(s) == ["a", "b"]
+
+
+# ---------------------------------------------------------------------------
+# Metrics
+# ---------------------------------------------------------------------------
+
+
+class TestMetrics:
+    def _registry(self):
+        return obs_metrics.Registry()
+
+    def test_counter_labels(self):
+        reg = self._registry()
+        c = reg.counter("jtpu_x_total", "things")
+        c.inc()
+        c.inc(2, f="read")
+        c.inc(3, f="read")
+        assert c.value() == 1
+        assert c.value(f="read") == 5
+
+    def test_gauge_set_max(self):
+        reg = self._registry()
+        g = reg.gauge("jtpu_hwm")
+        g.set_max(4)
+        g.set_max(2)
+        assert g.value() == 4
+        g.set(1)
+        assert g.value() == 1
+
+    def test_histogram_bucket_math(self):
+        reg = self._registry()
+        h = reg.histogram("jtpu_lat_seconds", "l",
+                          buckets=(0.01, 0.1, 1.0))
+        for v in (0.005, 0.05, 0.05, 0.5, 50.0):
+            h.observe(v)
+        s = h.series()
+        # non-cumulative internal tallies: <=0.01, <=0.1, <=1.0, +Inf
+        assert s["buckets"] == [1, 2, 1, 1]
+        assert s["count"] == 5
+        assert abs(s["sum"] - 50.605) < 1e-9
+        # exposition is cumulative
+        text = "\n".join(h.expose())
+        assert 'le="0.01"} 1' in text
+        assert 'le="0.1"} 3' in text
+        assert 'le="1"} 4' in text
+        assert 'le="+Inf"} 5' in text
+        assert "jtpu_lat_seconds_count 5" in text
+
+    def test_prometheus_exposition_format(self):
+        reg = self._registry()
+        reg.counter("jtpu_a_total", "a help").inc(2, f='with"quote',
+                                                  g="line\nbreak")
+        reg.gauge("jtpu_b", "b help").set(1.5)
+        text = reg.to_prometheus()
+        assert text.endswith("\n")
+        assert "# HELP jtpu_a_total a help" in text
+        assert "# TYPE jtpu_a_total counter" in text
+        assert "# TYPE jtpu_b gauge" in text
+        # label escaping per the exposition spec
+        assert 'f="with\\"quote"' in text
+        assert 'g="line\\nbreak"' in text
+        assert "jtpu_b 1.5" in text
+
+    def test_type_conflict_raises(self):
+        reg = self._registry()
+        reg.counter("jtpu_dup")
+        with pytest.raises(TypeError):
+            reg.gauge("jtpu_dup")
+
+    def test_snapshot_roundtrips_as_json(self, tmp_path):
+        reg = self._registry()
+        reg.counter("jtpu_c_total").inc(4)
+        reg.histogram("jtpu_h_seconds", buckets=(1.0,)).observe(0.5)
+        doc = json.loads(json.dumps(reg.snapshot()))
+        assert doc["jtpu_c_total"]["series"][""] == 4
+        assert doc["jtpu_h_seconds"]["series"][""]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Instrumented layers
+# ---------------------------------------------------------------------------
+
+
+class TestInstrumentation:
+    def test_wal_fsync_histogram(self, tmp_path):
+        from jepsen_tpu import journal
+        from jepsen_tpu.history import Op
+        h = obs_metrics.REGISTRY.histogram("jtpu_wal_fsync_seconds")
+        before = (h.series(sync="op") or {"count": 0})["count"]
+        j = journal.Journal(str(tmp_path / "history.wal"), sync="op")
+        for i in range(3):
+            j.append(Op(type="invoke", f="read", process=i))
+        j.close()
+        after = h.series(sync="op")["count"]
+        assert after - before == 3
+        b = obs_metrics.REGISTRY.histogram("jtpu_wal_batch_records")
+        assert b.series() and b.series()["count"] > 0
+
+    def test_supervised_search_surfaces_telemetry(self):
+        from jepsen_tpu.models import CASRegister
+        from jepsen_tpu.ops.encode import pack_with_init
+        from jepsen_tpu.resilience import supervised_check_packed
+        from jepsen_tpu.testing import simulate_register_history
+        h = simulate_register_history(150, n_procs=5, n_vals=4, seed=3)
+        p, kernel = pack_with_init(h, CASRegister())
+        before = obs.tracer().recorded
+        r = supervised_check_packed(p, kernel, capacity=64, expand=8,
+                                    segment_iters=8)
+        assert r["valid"] is True
+        assert r["segments"] >= 1
+        assert set(r["device-s"]) == {"compile", "execute"}
+        assert len(r["segment-levels"]) == r["segments"]
+        assert sum(r["segment-levels"]) == r["levels"]
+        assert r["frontier-hwm"] >= 1
+        assert r["transfer-bytes"] > 0
+        seg_spans = [s for s in obs.tracer().spans()
+                     if s["name"] == "checker.segment"]
+        assert obs.tracer().recorded > before
+        assert seg_spans and {s["phase"] for s in seg_spans} <= \
+            {"compile", "execute"}
+        assert seg_spans[-1]["level_end"] == r["levels"]
+
+    def test_traced_and_untraced_verdicts_match(self, monkeypatch):
+        # the kill-switch acceptance bar: JTPU_TRACE=0 changes nothing
+        # about verdicts or level counts
+        from jepsen_tpu.checker.tpu import check_history_tpu
+        from jepsen_tpu.models import CASRegister
+        from jepsen_tpu.testing import simulate_register_history
+        h = simulate_register_history(400, n_procs=5, n_vals=8, seed=9,
+                                      crash_p=0.01)
+        monkeypatch.setenv("JTPU_TRACE", "1")
+        r1 = check_history_tpu(h, CASRegister(), segment_iters=64)
+        monkeypatch.setenv("JTPU_TRACE", "0")
+        r0 = check_history_tpu(h, CASRegister(), segment_iters=64)
+        assert r1["valid"] == r0["valid"]
+        assert r1["levels"] == r0["levels"]
+        assert r1["segment-levels"] == r0["segment-levels"]
+
+    def test_run_artifacts_and_kill_switch(self, tmp_path, monkeypatch):
+        from jepsen_tpu import core, generator as gen
+        from jepsen_tpu.testing import atom_test
+
+        from jepsen_tpu.checker import noop_checker
+
+        def one_run(root):
+            t = atom_test(**{"store-root": str(root),
+                             "concurrency": 2, "nodes": ["a", "b"]})
+            t["generator"] = gen.clients(gen.limit(
+                10, lambda test, p: {"f": "read", "value": None}))
+            t["checker"] = noop_checker()
+            return core.run(t)
+
+        monkeypatch.setenv("JTPU_TRACE", "1")
+        t = one_run(tmp_path / "on")
+        d = t["store-dir"]
+        arts = sorted(os.listdir(d))
+        assert "trace.jsonl" in arts and "metrics.json" in arts
+        recs, stats = obs_trace.read_trace(
+            os.path.join(d, "trace.jsonl"))
+        names = {r["name"] for r in recs}
+        assert {"core.run", "core.run_case", "client.invoke",
+                "checker.check"} <= names
+        snap = json.load(open(os.path.join(d, "metrics.json")))
+        assert "jtpu_op_timeouts_total" in snap
+        assert "jtpu_wal_fsync_seconds" in snap
+
+        monkeypatch.setenv("JTPU_TRACE", "0")
+        t = one_run(tmp_path / "off")
+        arts = sorted(os.listdir(t["store-dir"]))
+        assert "trace.jsonl" not in arts and "metrics.json" not in arts
+        assert t["results"]["valid"] is True
+
+
+# ---------------------------------------------------------------------------
+# Surfaces: web + CLI
+# ---------------------------------------------------------------------------
+
+
+class TestWebMetrics:
+    def test_metrics_roundtrip_and_waterfall(self, tmp_path):
+        import urllib.error
+        from jepsen_tpu import web
+        run = tmp_path / "t" / "20260804T000000.000"
+        run.mkdir(parents=True)
+        (run / "results.json").write_text('{"valid": true}')
+        tr = obs_trace.Tracer(path=str(run / "trace.jsonl"))
+        with tr.span("core.run"):
+            with tr.span("checker.check"):
+                pass
+        tr.detach()
+        obs_metrics.counter("jtpu_web_roundtrip_total",
+                            "test series").inc(7, who="roundtrip")
+        server = web.serve_background(root=str(tmp_path))
+        base = f"http://127.0.0.1:{server.server_port}"
+        try:
+            with urllib.request.urlopen(base + "/metrics") as r:
+                assert r.status == 200
+                assert r.headers["Content-Type"].startswith(
+                    "text/plain; version=0.0.4")
+                body = r.read().decode()
+            assert "# TYPE jtpu_web_roundtrip_total counter" in body
+            assert 'jtpu_web_roundtrip_total{who="roundtrip"} 7' in body
+            home = urllib.request.urlopen(base + "/").read().decode()
+            assert "/trace/t/20260804T000000.000" in home
+            wf = urllib.request.urlopen(
+                base + "/trace/t/20260804T000000.000").read().decode()
+            assert "core.run" in wf and "span(s) over" in wf
+            # a run without a trace 404s rather than erroring
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(base + "/trace/t/nope")
+            assert ei.value.code == 404
+        finally:
+            server.shutdown()
+
+
+class TestTraceCLI:
+    def _store_with_trace(self, tmp_path):
+        d = tmp_path / "run"
+        d.mkdir()
+        tr = obs_trace.Tracer(path=str(d / "trace.jsonl"))
+        with tr.span("core.run"):
+            with tr.span("checker.segment", phase="execute"):
+                pass
+        tr.detach()
+        return str(d)
+
+    def test_export_chrome(self, tmp_path, capsys):
+        from jepsen_tpu import cli
+        d = self._store_with_trace(tmp_path)
+        out = str(tmp_path / "chrome.json")
+        rc = cli.run(cli.default_commands(),
+                     ["trace", "export", "--store", d, "-o", out])
+        assert rc == cli.OK
+        doc = json.load(open(out))
+        names = {e["name"] for e in doc["traceEvents"]}
+        assert {"core.run", "checker.segment"} <= names
+
+    def test_summary_and_missing_store(self, tmp_path, capsys):
+        from jepsen_tpu import cli
+        d = self._store_with_trace(tmp_path)
+        rc = cli.run(cli.default_commands(),
+                     ["trace", "summary", "--store", d])
+        assert rc == cli.OK
+        out = capsys.readouterr().out
+        assert "# trace:" in out and "checker.segment" in out
+        rc = cli.run(cli.default_commands(),
+                     ["trace", "summary", "--store",
+                      str(tmp_path / "nope")])
+        assert rc == cli.INVALID_ARGS
+
+    def test_recover_emits_trace_summary(self, tmp_path, capsys):
+        # a dead run with a WAL and a trace: recover prints the
+        # `# trace:` span-count line next to `# recovery:`/`# lint:`
+        from jepsen_tpu import cli, journal, store
+        from jepsen_tpu.history import Op
+        d = tmp_path / "kv" / "r1"
+        d.mkdir(parents=True)
+        j = journal.Journal(str(d / "history.wal"))
+        j.append(Op(type="invoke", f="read", process=0, time=1))
+        j.append(Op(type="ok", f="read", value=1, process=0, time=2))
+        j.close()
+        tr = obs_trace.Tracer(path=str(d / "trace.jsonl"))
+        with tr.span("client.invoke", f="read"):
+            pass
+        tr.detach()
+        store.write_state(str(d), "running")
+        st = json.load(open(d / "run.state"))
+        st["pid"] = 2 ** 22 + 1  # beyond pid_max: reads as dead
+        (d / "run.state").write_text(json.dumps(st))
+        assert store.run_status(str(d)) == "dead"
+        rc = cli.run(cli.default_commands(),
+                     ["recover", "--store", str(d), "--no-analyze"])
+        assert rc == cli.OK
+        out = capsys.readouterr().out
+        assert "# recovery:" in out and "# lint:" in out
+        assert "# trace: 1 span(s) recovered from trace.jsonl" in out
+
+
+# ---------------------------------------------------------------------------
+# The lint rule guarding the discipline
+# ---------------------------------------------------------------------------
+
+
+class TestTraceInJitLint:
+    def _lint(self, tmp_path, body):
+        from jepsen_tpu.analysis import jax_lint
+        p = tmp_path / "mod.py"
+        p.write_text(body)
+        return jax_lint.lint_file(str(p), root=str(tmp_path))
+
+    def test_flags_clock_and_span_in_traced_body(self, tmp_path):
+        findings = self._lint(tmp_path, (
+            "import time\n"
+            "from jepsen_tpu import obs\n"
+            "from jax import lax\n"
+            "def search(x):\n"
+            "    def body(c):\n"
+            "        t0 = time.monotonic()\n"
+            "        with obs.span('level'):\n"
+            "            c = c + 1\n"
+            "        return c\n"
+            "    return lax.while_loop(lambda c: c < x, body, 0)\n"))
+        rules = [f.rule for f in findings]
+        assert rules.count("JAX-TRACE-IN-JIT") == 2
+        assert all(f.severity == "error" for f in findings
+                   if f.rule == "JAX-TRACE-IN-JIT")
+
+    def test_host_side_timing_is_clean(self, tmp_path):
+        # the sanctioned pattern: clock + span around the device call,
+        # outside any traced body
+        findings = self._lint(tmp_path, (
+            "import time\n"
+            "import jax\n"
+            "from jepsen_tpu import obs\n"
+            "def timed(fn, *args):\n"
+            "    with obs.span('checker.device'):\n"
+            "        t0 = time.perf_counter()\n"
+            "        out = jax.block_until_ready(fn(*args))\n"
+            "        dt = time.perf_counter() - t0\n"
+            "    return out, dt\n"))
+        assert not [f for f in findings
+                    if f.rule == "JAX-TRACE-IN-JIT"]
+
+    def test_repo_checker_stack_obeys_the_rule(self):
+        # the instrumented production files themselves must be clean
+        from jepsen_tpu.analysis import jax_lint
+        for rel in ("jepsen_tpu/checker/tpu.py",
+                    "jepsen_tpu/resilience.py",
+                    "jepsen_tpu/obs/trace.py"):
+            findings = jax_lint.lint_file(os.path.join(REPO, rel),
+                                          root=REPO)
+            assert not [f for f in findings
+                        if f.rule == "JAX-TRACE-IN-JIT"], rel

@@ -23,6 +23,10 @@ qualify a new accelerator image before trusting it with long runs):
                    pre-search lint gate rejects each with the right
                    rule id BEFORE any jit compilation; the clean
                    history still checks valid
+  trace-integrity  SIGKILL a TRACED localkv run mid-workload: the
+                   streamed trace.jsonl survives (tail-tolerant read),
+                   and `recover` prints a `# trace:` span-count
+                   summary next to its `# lint:`/`# recovery:` lines
 
 Usage: python tools/chaos_matrix.py [--seed N] [--only NAME ...]
 Exit code 0 iff every selected scenario passes — nonzero on any
@@ -402,6 +406,87 @@ def scenario_malformed_history(seed):
                 + f"; clean run valid over {len(h)} ops")
 
 
+def scenario_trace_integrity(seed):
+    """SIGKILL a TRACED localkv run mid-workload; assert the streamed
+    span trace survives the crash: trace.jsonl reads tail-tolerantly
+    (at most the in-flight line is torn), and `recover` emits a
+    `# trace:` span-count summary next to `# lint:`/`# recovery:`."""
+    import contextlib
+    import io
+    import tempfile
+
+    from jepsen_tpu import cli
+    from jepsen_tpu.obs import trace as trace_ns
+
+    root = tempfile.mkdtemp(prefix="jepsen-chaos-traceint-")
+    run_dir = os.path.join(root, "local-kv", "run")
+    ports_file = os.path.join(root, "ports.json")
+    child_src = (
+        "import json, sys\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "from jepsen_tpu import core\n"
+        "from jepsen_tpu.suites.localkv import localkv_test\n"
+        "test = localkv_test({'time-limit': 60, 'nemesis-period': 3})\n"
+        f"test['store-dir'] = {run_dir!r}\n"
+        f"json.dump(test['localkv-ports'], open({ports_file!r}, 'w'))\n"
+        "core.run(test)\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", JTPU_TRACE="1")
+    proc = subprocess.Popen([sys.executable, "-c", child_src], env=env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    trace_path = os.path.join(run_dir, trace_ns.TRACE_NAME)
+    wal = os.path.join(run_dir, "history.wal")
+    deadline = time.time() + 90
+    spans = wal_lines = 0
+    try:
+        # wait for a mid-workload state: ops in the WAL AND spans in
+        # the trace (both stream as they happen)
+        while time.time() < deadline:
+            if os.path.exists(wal) and os.path.exists(trace_path):
+                with open(wal, "rb") as f:
+                    wal_lines = sum(1 for _ in f)
+                with open(trace_path, "rb") as f:
+                    spans = sum(1 for _ in f)
+                if wal_lines >= 40 and spans >= 10:
+                    break
+            if proc.poll() is not None:
+                return False, (f"child exited rc={proc.returncode} "
+                               f"before the kill (wal={wal_lines}, "
+                               f"spans={spans})")
+            time.sleep(0.2)
+        else:
+            return False, (f"workload never produced enough telemetry "
+                           f"(wal={wal_lines}, spans={spans})")
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()
+    finally:
+        try:
+            with open(ports_file) as f:
+                _kill_kvnodes(json.load(f))
+        except OSError:
+            pass
+
+    # tail-tolerant read of the crashed run's trace: must not raise,
+    # and at most one torn line (the span in flight at the kill)
+    records, stats = trace_ns.read_trace(trace_path)
+    if not records or stats["corrupt"] or stats["torn"] > 1:
+        return False, f"trace read after SIGKILL: {stats}"
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli.run(cli.default_commands(),
+                     ["recover", "--store-root", root, "--no-analyze"])
+    out = buf.getvalue()
+    has_recovery = "# recovery:" in out
+    has_lint = "# lint:" in out
+    trace_lines = [ln for ln in out.splitlines()
+                   if ln.startswith("# trace:")]
+    ok = (rc == 0 and has_recovery and has_lint and bool(trace_lines)
+          and f"{stats['spans']} span(s)" in trace_lines[0])
+    return ok, (f"rc={rc} {stats['spans']} span(s) "
+                f"({stats['torn']} torn) survived the SIGKILL; "
+                f"recover said: {trace_lines[:1]!r}")
+
+
 SCENARIOS = (
     ("oom", scenario_oom),
     ("wedge", scenario_wedge),
@@ -410,6 +495,7 @@ SCENARIOS = (
     ("hung-client", scenario_hung_client),
     ("kill9-recover", scenario_kill9_recover),
     ("malformed-history", scenario_malformed_history),
+    ("trace-integrity", scenario_trace_integrity),
 )
 
 
