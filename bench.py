@@ -142,8 +142,6 @@ def child_main(platform: str) -> int:
     print(json.dumps(rec))
     sys.stdout.flush()
     _search_line("10k headline", result2, warm)
-    # util AFTER the contract line: the roofline compiles+runs device
-    # code and must not be able to starve the headline of stdout.
     _util_line("headline", warm, [result2])
 
     if not os.environ.get("JEPSEN_BENCH_SKIP_SECONDARY"):
@@ -227,89 +225,30 @@ def _search_line(label, result, wall_s):
         if result.get("transfer-bytes"):
             line += (f", {result['transfer-bytes'] / 1e6:.1f} MB "
                      f"transferred")
+        bal = result.get("shard-balance")
+        if bal:
+            line += (f", shard-imbalance={bal['imbalance-ratio']}x "
+                     f"over {bal['devices']} device(s)")
         print(line, file=sys.stderr)
     except Exception as e:  # noqa: BLE001
         print(f"# search {label}: accounting failed: {e!r}",
               file=sys.stderr)
 
 
-def _level_work(rung, crash_width, tiebreak="lex", batch=1):
-    """Analytic per-level work of one search step at a given rung:
-    (candidate expansions, merge-sorted rows, bytes through the sort,
-    sort operand count). Mirrors _search_fn's shapes: the [E, W]
-    required grid + the [E, CR] crashed grid + E closure rows + the
-    (C - E) pool remainder. The lex tie-break sorts the full config
-    columns (key1, k, mask words, state [, popcount, cmask words]); the
-    hash tie-break sorts only (key1, h [, popcount, cmask words]) plus
-    an index payload and gathers the rest."""
-    cap, win, exp = rung
-    e = min(exp or cap, cap)
-    cr = crash_width or 0
-    expansions = e * (win + cr) + e          # grids + closure successor
-    rows = e * win + e + e * cr + (cap - e)  # merge-sort operand length
-    mw = (win + 31) // 32
-    mc = (cr + 31) // 32
-    if tiebreak == "hash":
-        operands = 2 + (1 + mc if cr else 0) + 1   # key1,h[,pc,cm],iota
-    else:
-        operands = 2 + mw + 1 + (1 + mc if cr else 0)
-    return (batch * expansions, batch * rows,
-            batch * rows * operands * 4, operands)
-
-
-def _sort_roofline(rows, operands, batch, iters=50):
-    """Measured pure-sort ceiling for this backend at the search's merge
-    shape: levels/s achievable if each level were ONLY its lax.sort.
-    The search's achieved levels/s divided by this is an honest
-    utilization number (how much of the per-level budget the
-    surrounding step math, dedup and control flow eat).
-
-    The sorts run CHAINED INSIDE one jitted fori_loop — timing separate
-    jitted calls would measure per-call dispatch latency, not sort
-    throughput, exactly the overhead the real search's fused level loop
-    does NOT pay. Each iteration derives fresh pseudo-random operands
-    from the previous iteration's output (data dependency — no DCE or
-    hoisting), with the primary key drawn from just 8 distinct values
-    so the comparator falls through to later operands and rows really
-    move, like the real merge's heavily-duplicated depth key."""
-    import time as _t
-
-    import jax
-    import jax.numpy as jnp
-    from jax import lax
-
-    shape = (batch, rows) if batch > 1 else (rows,)
-    idx = jnp.arange(batch * rows if batch > 1 else rows,
-                     dtype=jnp.uint32).reshape(shape)
-
-    @jax.jit
-    def run(seed):
-        def body(_, s):
-            r = s + idx * jnp.uint32(2654435761)
-            ops = [((r >> jnp.uint32(7)) & jnp.uint32(7))
-                   .astype(jnp.int32)]        # key1: 8 distinct values
-            for _k in range(1, operands):
-                r = r * jnp.uint32(1664525) + jnp.uint32(1013904223)
-                ops.append(r.astype(jnp.int32))
-            out = lax.sort(tuple(ops), num_keys=operands,
-                           dimension=len(shape) - 1)
-            flat = out[-1].reshape(-1)
-            return s * jnp.uint32(1664525) + flat[0].astype(jnp.uint32)
-
-        return lax.fori_loop(0, iters, body, seed)
-
-    jax.block_until_ready(run(jnp.uint32(1)))
-    t0 = _t.time()
-    jax.block_until_ready(run(jnp.uint32(2)))
-    return iters / (_t.time() - t0)
-
-
 def _util_line(label, seconds, results):
-    """One '# util:' stderr line: candidate expansions/s, merge rows/s,
-    sort-bytes/s, and achieved-vs-sort-roofline. ``results`` is a list
-    of per-search result dicts carrying levels + rung + crash-width.
-    Diagnostics only — never raises (it must not be able to destroy the
-    measurements it annotates)."""
+    """One '# util:' stderr line from the XLA cost-model accounting the
+    checkers attach to their results (doc/observability.md): model
+    FLOP/s and bytes-accessed/s achieved over the measured wall, plus
+    the device-busy fraction where the result carries the device-s
+    split. Replaces the old hand-rolled roofline estimate (analytic
+    per-level work + a measured synthetic-sort ceiling): the compiler's
+    own cost model prices the executables that actually ran, escalation
+    rungs and crash grids included, with no shape bookkeeping to drift
+    out of sync. ``results`` is a list of checker result dicts (for
+    keyed checks, the TOP-level dict — per-key results deliberately
+    carry no cost, see check_keyed_tpu). Diagnostics only — never
+    raises (it must not be able to destroy the measurements it
+    annotates)."""
     try:
         _util_line_inner(label, seconds, results)
     except Exception as e:  # noqa: BLE001
@@ -318,59 +257,29 @@ def _util_line(label, seconds, results):
 
 
 def _util_line_inner(label, seconds, results):
-    # each result carries "work": [(rung, crash_width, tiebreak,
-    # levels), ...] across EVERY rung the search burned levels on —
-    # escalated searches must not hide their early-rung spend
-    def entries(r):
-        w = r.get("work")
-        if w:
-            return w
-        if r.get("rung") and r.get("levels"):
-            return [(tuple(r["rung"]), r.get("crash-width", 0),
-                     r.get("tiebreak", "lex"), r["levels"])]
-        return []
-
-    tot_exp = tot_rows = tot_bytes = 0
-    levels_by_shape = {}
+    # each cost entry is one executable shape: flops / bytes-accessed
+    # are per while-iteration (the HLO analysis counts a loop body
+    # once), "levels" the iterations it ran, "unroll" the search steps
+    # folded into each iteration
+    tot_flops = tot_bytes = 0.0
+    entries = 0
+    busy = 0.0
     for r in results:
-        for rung, crw, tb, lev in entries(r):
-            key = (tuple(rung), crw, tb)
-            e, rws, byts, _ = _level_work(*key)
-            tot_exp += e * lev
-            tot_rows += rws * lev
-            tot_bytes += byts * lev
-            levels_by_shape[key] = levels_by_shape.get(key, 0) + lev
-    if not levels_by_shape or seconds <= 0:
-        return
-    # roofline at the dominant shape (most levels spent there)
-    dom_key, _ = max(levels_by_shape.items(), key=lambda kv: kv[1])
-    _, rows, _, operands = _level_work(*dom_key)
-    per_result = []
-    for r in results:
-        lv = sum(lev for rung, crw, tb, lev in entries(r)
-                 if (tuple(rung), crw, tb) == dom_key)
-        if lv:
-            per_result.append(lv)
-    batch = len(per_result)
-    try:
-        peak = _sort_roofline(rows, operands, batch)
-    except Exception:  # noqa: BLE001 — roofline is best-effort
-        peak = None
-    # a vmapped batch advances every key per program level, so the
-    # program's level count is the slowest key's
-    ach_levels = max(per_result)
-    line = (f"# util {label}: {tot_exp / seconds:,.0f} expansions/s, "
-            f"{tot_rows / seconds:,.0f} sorted rows/s, "
-            f"{tot_bytes / seconds / 1e6:,.0f} MB/s through the sort")
-    if peak:
-        ach = ach_levels / seconds
-        # >100% is possible and meaningful: the roofline sorts
-        # randomized rows, while the real merge is partially sorted
-        # (pool remainder ordered, invalid rows uniform), so >=100%
-        # reads as "fully sort-dominated".
-        line += (f", {ach:,.0f} levels/s vs {peak:,.0f} randomized-"
-                 f"sort levels/s = {100 * ach / peak:.0f}% of sort "
-                 f"roofline")
+        for e in (r.get("cost") or []):
+            iters = e.get("levels", 0) / max(e.get("unroll", 1), 1)
+            tot_flops += e.get("flops", 0.0) * iters
+            tot_bytes += e.get("bytes-accessed", 0.0) * iters
+            entries += 1
+        dev = r.get("device-s") or {}
+        busy += float(dev.get("compile", 0.0)) \
+            + float(dev.get("execute", 0.0))
+    if not entries or seconds <= 0:
+        return  # cost accounting off (JTPU_TRACE=0) or unavailable
+    line = (f"# util {label}: {tot_flops / seconds / 1e9:.2f} GFLOP/s, "
+            f"{tot_bytes / seconds / 1e6:,.0f} MB/s accessed "
+            f"(XLA cost model, {entries} executable(s))")
+    if busy:
+        line += f", device busy {100 * busy / seconds:.0f}% of wall"
     print(line, file=sys.stderr)
 
 
@@ -630,8 +539,7 @@ def _keyed_batch_comparison(platform: str):
         line = (f"# keyed-batch {n_keys}x{n_ops} {label}: device "
                 f"warm={warm:.2f}s cold={cold:.2f}s ({ok}/{n_keys} "
                 f"valid)")
-        _util_line(f"keyed-{label}", warm,
-                   list(out["results"].values()))
+        _util_line(f"keyed-{label}", warm, [out])
         if available():
             t0 = _t.time()
             rn = check_keyed_native(keyed, CASRegister())

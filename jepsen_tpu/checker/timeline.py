@@ -3,7 +3,13 @@
 Rebuild of jepsen.checker.timeline (jepsen/src/jepsen/checker/timeline.clj):
 one column per process, one box per invoke..complete pair (info ops extend
 to the end of the history), color by completion type, hover titles with
-op details, written to timeline.html in the store (timeline.clj:159-179)."""
+op details, written to timeline.html in the store (timeline.clj:159-179).
+
+Nemesis fault-active windows (the ``jtpu_fault_active`` gauge's
+transitions, doc/observability.md) are shaded as background bands
+behind the op boxes, so a burst of slow/failed client ops visually
+lines up with the fault that caused it instead of demanding a
+cross-reference against the nemesis rows."""
 
 from __future__ import annotations
 
@@ -12,7 +18,7 @@ import os
 from typing import Any, Dict, List, Optional, Tuple
 
 from jepsen_tpu.checker import Checker
-from jepsen_tpu.history import History, Op
+from jepsen_tpu.history import History, NEMESIS, Op
 
 STYLESHEET = """
 body { font-family: sans-serif; }
@@ -22,7 +28,18 @@ body { font-family: sans-serif; }
 .op.ok   { background: #6DB6FE; }
 .op.info { background: #FEFF7F; }
 .op.fail { background: #FEA786; }
+.fault { position: absolute; background: rgba(254, 167, 134, 0.25);
+         border-left: 3px solid rgba(225, 87, 89, 0.6); }
 """
+
+#: Nemesis f values whose completion closes a fault window (mirrors
+#: jepsen_tpu.nemesis.HEAL_FS without importing the nemesis layer —
+#: the checker package must stay importable standalone).
+HEAL_FS = frozenset({"stop", "heal"})
+
+#: Nemesis info ops that are annotations, not invoke/complete pairs.
+_NEMESIS_SINGLETONS = frozenset({"heal-verified", "heal-failed",
+                                 "nemesis-wedged"})
 
 COL_WIDTH = 100
 GUTTER = 106
@@ -51,6 +68,41 @@ def pairs(history: History) -> List[Tuple[Op, Optional[Op]]]:
     for si, inv in open_ops.values():
         out.append((si, inv, None, None))
     out.sort(key=lambda r: r[0])
+    return out
+
+
+def fault_windows(history: History,
+                  heal_fs=HEAL_FS) -> List[Tuple[int, int, str]]:
+    """Nemesis fault-active windows as ``(start_index, end_index, f)``
+    history-index ranges — the same transitions that drive the
+    ``jtpu_fault_active`` gauge (``Nemesis.note_fault_op``): a window
+    opens at the COMPLETION of a non-heal nemesis op and closes at the
+    completion of a heal-class one; a window still open at the end of
+    the history extends to it (the fault never formally closed).
+
+    The single nemesis thread records strict invoke/completion pairs,
+    so parity tracking suffices; probe annotations (``heal-verified`` /
+    ``nemesis-wedged``) ride outside the pairing and are skipped."""
+    out: List[Tuple[int, int, str]] = []
+    open_at: Optional[Tuple[int, str]] = None
+    pending: Optional[str] = None
+    n = 0
+    for i, o in enumerate(history):
+        n = i + 1
+        if o.process != NEMESIS or o.f in _NEMESIS_SINGLETONS:
+            continue
+        if pending is None or o.f != pending:
+            pending = o.f          # an invocation (or a renamed pair)
+            continue
+        pending = None             # its completion
+        if o.f in heal_fs:
+            if open_at is not None:
+                out.append((open_at[0], i, open_at[1]))
+                open_at = None
+        elif open_at is None:
+            open_at = (i, str(o.f))
+    if open_at is not None:
+        out.append((open_at[0], n, open_at[1]))
     return out
 
 
@@ -83,6 +135,16 @@ class HTMLTimeline(Checker):
         cols = process_index(history)
         n = len(history)
         divs = []
+        # fault bands first: background layer behind the op boxes
+        band_w = GUTTER * max(len(cols), 1)
+        for si, ei, f in fault_windows(history):
+            title = _html.escape(f"nemesis fault window: {f} "
+                                 f"(ops {si}..{ei})")
+            divs.append(
+                f'<div class="fault" title="{title}" '
+                f'style="left:0;top:{HEIGHT * si}px;'
+                f'width:{band_w}px;'
+                f'height:{max(HEIGHT * (ei - si), HEIGHT)}px"></div>')
         for si, inv, ei, comp in pairs(history):
             typ = comp.type if comp is not None else "info"
             top = HEIGHT * si

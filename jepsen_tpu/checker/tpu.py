@@ -686,9 +686,53 @@ _TRANSFER_BYTES = obs_metrics.counter(
     "jtpu_search_transfer_bytes_total",
     "packed-history and checkpoint bytes moved, labeled by direction")
 
+_SHARD_IMBALANCE = obs_metrics.gauge(
+    "jtpu_shard_imbalance_ratio",
+    "pool-sharded search straggler imbalance: max over shards of live "
+    "frontier rows divided by the mean (1.0 = perfectly balanced)")
+
 #: Executable shapes (cache key + padded input shape) that have already
 #: run once in this process — the compile/execute phase separator.
 _EXECUTED_SHAPES: set = set()
+
+#: Shape key -> XLA cost-model dict (or None when unavailable): the
+#: per-executable flops / bytes-accessed accounting. Memoized per
+#: process — the cost comes from LOWERING only (no second XLA compile),
+#: and the HLO analysis counts a while body once, so for the search
+#: executables the numbers read as per-LEVEL model cost.
+_COST_BY_SHAPE: Dict[tuple, Optional[Dict[str, float]]] = {}
+
+
+def _cost_analysis(fn, args) -> Optional[Dict[str, float]]:
+    """``fn.lower(*args).cost_analysis()`` normalized to
+    ``{"flops", "bytes-accessed"}`` floats; None when the backend or
+    jax version does not support it (the accounting is best-effort —
+    a CPU-only run must behave identically without it)."""
+    ca = fn.lower(*args).cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    flops = float(ca.get("flops", 0.0) or 0.0)
+    byts = float(ca.get("bytes accessed", 0.0) or 0.0)
+    if flops <= 0 and byts <= 0:
+        return None
+    return {"flops": flops, "bytes-accessed": byts}
+
+
+def _shape_cost(key: tuple, fn, args) -> Optional[Dict[str, float]]:
+    """Memoized per-executable cost model for one jit cache key +
+    padded shape. Never raises; a failed analysis memoizes None so the
+    lowering is not retried every segment."""
+    if key in _COST_BY_SHAPE:
+        cost = _COST_BY_SHAPE[key]
+    else:
+        try:
+            cost = _cost_analysis(fn, args)
+        except Exception:  # noqa: BLE001 — cost accounting is optional
+            cost = None
+        _COST_BY_SHAPE[key] = cost
+    return dict(cost) if cost else None
 
 
 def _first_call(key: tuple) -> bool:
@@ -1169,6 +1213,7 @@ def check_packed_tpu(p: PackedHistory, kernel: KernelSpec,
         ladder = _ladder_for(_window_needed(p))
     out: Dict[str, Any] = {}
     work: list = []
+    cost_entries: list = []
     for cap, win, exp in ladder:
         unroll = _unroll_factor()
         fn = _jit_single(_kernel_key(kernel), cap, win, exp, unroll)
@@ -1191,6 +1236,14 @@ def check_packed_tpu(p: PackedHistory, kernel: KernelSpec,
         work.append(((cap, win, exp), out["crash-width"], "lex",
                      int(levels)))
         out["work"] = list(work)
+        if obs.enabled():
+            cost = _shape_cost(shape_key, fn, [cols[c] for c in _COLS])
+            if cost:
+                cost_entries.append(dict(
+                    kind="single", rung=[cap, win, exp], unroll=unroll,
+                    levels=int(levels), **cost))
+        if cost_entries:
+            out["cost"] = [dict(e) for e in cost_entries]
         if out["valid"] is not UNKNOWN:
             return out
         if bool(wovf) and win >= MAX_WINDOW and not bool(lossy):
@@ -1200,6 +1253,31 @@ def check_packed_tpu(p: PackedHistory, kernel: KernelSpec,
 
 #: Mesh axis name for pool-sharded single-history searches.
 POOL_AXIS = "pool"
+
+
+def _shard_balance(pool, naxis: int) -> Optional[Dict[str, Any]]:
+    """Per-device frontier accounting for a pool-sharded search. Each
+    mesh-axis shard owns ``capacity / naxis`` contiguous pool rows;
+    because the merge sort is global, a shard hoarding most of the live
+    frontier means the others' lanes idle through the step math — the
+    straggler signature. Returns ``{"devices", "live-rows",
+    "deepest-k", "imbalance-ratio"}`` (max live rows over mean; 1.0 is
+    perfectly balanced) and feeds ``jtpu_shard_imbalance_ratio``."""
+    pk, ps, pa = (np.asarray(x) for x in pool)
+    cap = int(pa.shape[0])
+    if naxis <= 0 or cap % naxis:
+        return None
+    per = cap // naxis
+    live = [int(np.count_nonzero(pa[i * per:(i + 1) * per]))
+            for i in range(naxis)]
+    deepest = [int(np.max(pk[i * per:(i + 1) * per]
+                          * pa[i * per:(i + 1) * per], initial=0))
+               for i in range(naxis)]
+    mean = sum(live) / naxis
+    ratio = round(max(live) / mean, 3) if mean > 0 else 1.0
+    _SHARD_IMBALANCE.set(ratio)
+    return {"devices": naxis, "live-rows": live, "deepest-k": deepest,
+            "imbalance-ratio": ratio}
 
 
 def check_packed_sharded(p: PackedHistory, kernel: KernelSpec,
@@ -1269,6 +1347,21 @@ def check_packed_sharded(p: PackedHistory, kernel: KernelSpec,
                 pool = None
         out = _result(done, lossy, wovf, int(best),
                       int(levels), p, pool=pool)
+        if pool is not None:
+            # straggler accounting: live rows + deepest config per
+            # mesh-axis shard, and the max/mean imbalance ratio
+            balance = _shard_balance(pool, naxis)
+            if balance is not None:
+                out["shard-balance"] = balance
+        if obs.enabled():
+            # lowered INSIDE the mesh context: the search body carries
+            # with_sharding_constraint, which needs the mesh to trace
+            cost = _shape_cost(shape_key, fn, [cols[c] for c in _COLS])
+            if cost:
+                out["cost"] = [dict(
+                    kind="sharded", rung=[capacity, window, expand],
+                    unroll=_unroll_factor(), levels=int(levels),
+                    axis=naxis, **cost)]
     out["pool-sharding"] = f"{POOL_AXIS}={naxis}"
     return out
 
@@ -1403,6 +1496,7 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
     accel.ensure_usable("check_keyed_tpu")
     results: Dict[Any, Dict[str, Any]] = {}
     packed: Dict[Any, PackedHistory] = {}
+    cost_entries: list = []
     from jepsen_tpu.analysis import summarize
     from jepsen_tpu.analysis.history_lint import (MalformedHistoryError,
                                                   gate_history)
@@ -1631,6 +1725,13 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
             # a vmapped batch advances every key per program level, so
             # the device executed the slowest key's level count
             _LEVELS_TOTAL.inc(int(levels.max(initial=0)))
+            if obs.enabled():
+                cost = _shape_cost(shape_key, fn, arrays)
+                if cost:
+                    cost_entries.append(dict(
+                        kind="batch", rung=[cap, win, exp],
+                        unroll=unroll, keys=len(grp), crash_width=crw,
+                        levels=int(levels.max(initial=0)), **cost))
             # Pool columns ([capacity] rows per key) are only read for
             # clean refutations — don't ship up to 16384 ints/key
             # off-device (and over DCN) for the common all-valid rung.
@@ -1675,4 +1776,10 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
             break
         if r["valid"] is UNKNOWN:
             valid = UNKNOWN
-    return {"valid": valid, "results": results, "backend": "tpu"}
+    out = {"valid": valid, "results": results, "backend": "tpu"}
+    if cost_entries:
+        # one entry per batch executable actually launched (keys share
+        # it), at the TOP level — attaching the batch cost to every key
+        # result would overcount the work len(grp)-fold
+        out["cost"] = cost_entries
+    return out

@@ -87,6 +87,11 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
                    help="device-search iterations per checkpointed "
                         "segment (resilient execution; 0 = one "
                         "monolithic device call)")
+    p.add_argument("--watch", action="store_true",
+                   help="print a live search-progress status line "
+                        "(level/frontier/ETA) to stderr while the "
+                        "checker runs; `python -m jepsen_tpu watch` "
+                        "follows another process's run instead")
 
 
 def parse_concurrency(c: str, n_nodes: int) -> int:
@@ -145,6 +150,20 @@ def _apply_segment_iters(seg):
     return seg
 
 
+def _with_watch(opts: Dict[str, Any], fn: Callable[[], int]) -> int:
+    """Run ``fn`` with the in-process live status printer attached when
+    the user passed ``--watch`` (the observatory publishes from the
+    supervised device search; the printer mirrors it to stderr)."""
+    if not opts.get("watch"):
+        return fn()
+    from jepsen_tpu.obs import observatory
+    stop = observatory.live_status_printer()
+    try:
+        return fn()
+    finally:
+        stop()
+
+
 def single_test_cmd(test_fn: Callable[[dict], dict],
                     opt_spec: Optional[Callable] = None,
                     opt_fn: Optional[Callable] = None,
@@ -162,11 +181,15 @@ def single_test_cmd(test_fn: Callable[[dict], dict],
 
     def run(opts) -> int:
         from jepsen_tpu import core
-        for _ in range(opts.get("test-count", 1)):
-            test = core.run(test_fn(dict(opts)))
-            if test["results"].get("valid") is not True:
-                return TEST_FAILED
-        return OK
+
+        def loop() -> int:
+            for _ in range(opts.get("test-count", 1)):
+                test = core.run(test_fn(dict(opts)))
+                if test["results"].get("valid") is not True:
+                    return TEST_FAILED
+            return OK
+
+        return _with_watch(opts, loop)
 
     return {"test": {"parser": build_parser,
                      "opt_fn": (lambda o: opt_fn(test_opt_fn(o)))
@@ -222,11 +245,15 @@ def suite_run_cmd() -> dict:
                   file=sys.stderr)
             return INVALID_ARGS
         ctor = reg[name]
-        for _ in range(opts.get("test-count", 1)):
-            test = core.run(ctor(dict(opts)))
-            if test["results"].get("valid") is not True:
-                return TEST_FAILED
-        return OK
+
+        def loop() -> int:
+            for _ in range(opts.get("test-count", 1)):
+                test = core.run(ctor(dict(opts)))
+                if test["results"].get("valid") is not True:
+                    return TEST_FAILED
+            return OK
+
+        return _with_watch(opts, loop)
 
     return {"run": {"parser": build_parser, "opt_fn": test_opt_fn,
                     "run": run_}}
@@ -308,7 +335,14 @@ def analyze_cmd() -> dict:
         checker = linearizable(models[opts["model"]](),
                                backend=opts["backend"],
                                algorithm=opts["algorithm"])
-        out = repl.recheck(test, checker)
+        # Offline re-checks are the longest searches; publish their
+        # live progress to the run dir so `watch` / /live follow them.
+        from jepsen_tpu.obs import observatory
+        observatory.attach(test.get("store-dir"))
+        try:
+            out = repl.recheck(test, checker)
+        finally:
+            observatory.detach()
         print(_json.dumps(out, indent=2, default=repr))
         return OK if out.get("valid") is True else TEST_FAILED
 
@@ -440,6 +474,67 @@ def recover_cmd() -> dict:
     return {"recover": {"parser": build_parser, "run": run_}}
 
 
+def watch_cmd() -> dict:
+    """The 'watch' subcommand: follow another process's in-flight run
+    from its ``progress.json`` heartbeat (doc/observability.md). The
+    supervised device search publishes level / frontier-width /
+    configs-per-s / ETA after every checkpointed segment; this command
+    renders that as a refreshing status line until the run's
+    ``run.state`` goes terminal (done/dead/recovered). ``--once``
+    prints a single line and exits (scripting / tests)."""
+
+    def build_parser():
+        p = Parser(prog="watch",
+                   description="Live status line for an in-flight "
+                               "run's device search.")
+        p.add_argument("--store", default=None,
+                       help="run directory (default: latest under "
+                            "--store-root)")
+        p.add_argument("--store-root", default="store")
+        p.add_argument("--interval", type=float, default=1.0,
+                       help="seconds between refreshes")
+        p.add_argument("--once", action="store_true",
+                       help="print one status line and exit")
+        return p
+
+    def run_(opts) -> int:
+        import os as _os
+        import time as _time
+
+        from jepsen_tpu import store
+        from jepsen_tpu.obs import observatory
+
+        d = opts.get("store")
+        if d is None:
+            t = store.latest(opts.get("store_root") or "store")
+            d = t.get("store-dir") if t else None
+        if not d or not _os.path.isdir(d):
+            print(f"no such store directory: {d}", file=sys.stderr)
+            return INVALID_ARGS
+        tty = sys.stdout.isatty()
+        while True:
+            p = observatory.read_progress(d)
+            state = store.run_status(d)
+            if p is None:
+                line = (f"# watch: no search progress published yet "
+                        f"(state={state or 'unknown'})")
+            else:
+                line = observatory.format_status(p)
+                if state and state != "running":
+                    line += f" [{state}]"
+            end = "\r" if tty else "\n"
+            print(line, end=end, flush=True)
+            done = (p or {}).get("state") == "done"
+            if opts.get("once") or done \
+                    or state in ("done", "dead", "recovered"):
+                if tty:
+                    print()
+                return OK
+            _time.sleep(max(opts.get("interval") or 1.0, 0.05))
+
+    return {"watch": {"parser": build_parser, "run": run_}}
+
+
 def trace_cmd() -> dict:
     """The 'trace' subcommand family: read a run's ``trace.jsonl`` span
     artifact (doc/observability.md).
@@ -468,6 +563,11 @@ def trace_cmd() -> dict:
                        help="export format (chrome loads in Perfetto)")
         p.add_argument("-o", "--output", default=None, metavar="FILE",
                        help="write the export here (default: stdout)")
+        p.add_argument("--top", type=int, default=None, metavar="N",
+                       help="with `summary`: also print the N slowest "
+                            "span names by SELF time (total minus "
+                            "child spans) — the one slow span a "
+                            "count-only rollup buries")
         return p
 
     def run_(opts) -> int:
@@ -503,6 +603,18 @@ def trace_cmd() -> dict:
                 print(f"# trace: {name:<{width}}  {s['count']:>5}  "
                       f"{s['total-ns'] / 1e9:>8.3f}s "
                       f"{s['max-ns'] / 1e9:>8.3f}s")
+            if opts.get("top"):
+                top = trace_ns.self_time_rollup(records)
+                rows = sorted(top.items(),
+                              key=lambda kv: -kv[1]["self-ns"]
+                              )[:opts["top"]]
+                print(f"# trace: top {len(rows)} by self-time")
+                print(f"# trace: {'name':<{width}}  count  self"
+                      f"       p95")
+                for name, s in rows:
+                    print(f"# trace: {name:<{width}}  {s['count']:>5}  "
+                          f"{s['self-ns'] / 1e9:>8.3f}s "
+                          f"{s['p95-ns'] / 1e9:>8.3f}s")
             return OK
 
         if opts["format"] == "chrome":
@@ -660,10 +772,11 @@ def main(subcommands: Dict[str, dict],
 
 def default_commands() -> dict:
     """The stock subcommand set: runner + analyzer + recovery + linter
-    + trace tooling + server (what ``python -m jepsen_tpu``
-    dispatches)."""
+    + trace tooling + live watch + server (what ``python -m
+    jepsen_tpu`` dispatches)."""
     return merge_commands(suite_run_cmd(), analyze_cmd(), recover_cmd(),
-                          lint_cmd(), trace_cmd(), serve_cmd())
+                          lint_cmd(), trace_cmd(), watch_cmd(),
+                          serve_cmd())
 
 
 if __name__ == "__main__":  # default main

@@ -477,8 +477,11 @@ def run(test: dict) -> dict:
             test["_journal"] = journal_ns.open_journal(test["store-dir"])
             # Telemetry rides alongside the WAL: spans stream to
             # trace.jsonl as they close, so a killed run's timeline is
-            # recoverable too (doc/observability.md).
+            # recoverable too (doc/observability.md). The observatory
+            # mirrors live search progress to progress.json in the same
+            # directory — what `watch` and /live/<test> read.
             obs.start_run(test["store-dir"])
+            obs.observatory.attach(test["store-dir"])
         except ImportError:
             store = None
 
@@ -530,6 +533,7 @@ def run(test: dict) -> dict:
                     _os.path.join(test["store-dir"], "metrics.json"))
             except OSError as e:
                 log.warning("couldn't write metrics.json: %s", e)
+        obs.observatory.detach()
         obs.finish_run()
     log.info("Test %s: valid=%s", test.get("name"),
              test["results"].get("valid"))

@@ -18,6 +18,12 @@ and this package is that substrate. Two halves:
   histograms with label support, snapshotted to ``metrics.json`` at run
   end and served as Prometheus text exposition at ``/metrics`` by
   :mod:`jepsen_tpu.web`.
+* :mod:`jepsen_tpu.obs.observatory` — LIVE in-flight search progress
+  (level/frontier/ETA gauges + ``progress.json``), read by the
+  ``watch`` CLI and the web UI's ``/live/<test>/<ts>`` endpoint.
+* :mod:`jepsen_tpu.obs.devices` — per-device allocator gauges and the
+  headroom ratio that lets the resilience supervisor halve its pool
+  BEFORE the OOM (graceful no-op on backends without memory stats).
 
 Every layer is instrumented: ``core.run_case`` (setup / client-invoke /
 nemesis / teardown spans, op-timeout and wedge counters), the WAL
@@ -43,3 +49,5 @@ from jepsen_tpu.obs.trace import (  # noqa: F401
     TRACE_NAME, Tracer, enabled, event, finish_run, read_trace, span,
     start_run, to_chrome, tracer)
 from jepsen_tpu.obs import metrics  # noqa: F401
+from jepsen_tpu.obs import devices  # noqa: F401
+from jepsen_tpu.obs import observatory  # noqa: F401

@@ -1,0 +1,129 @@
+"""Device memory accounting: how close is the search to the cliff?
+
+Before this module the only memory signal was the cliff itself — an
+``xla`` RESOURCE_EXHAUSTED that the resilience supervisor answers
+*reactively* with pool-halving (doc/resilience.md). Accelerator runtimes
+expose allocator statistics (``device.memory_stats()`` on TPU/GPU
+backends: ``bytes_in_use``, ``bytes_limit``, ``peak_bytes_in_use``);
+polling them at segment boundaries turns the cliff into a gradient:
+
+* per-device gauges (``jtpu_device_bytes_in_use`` / ``_bytes_limit`` /
+  ``_peak_bytes_in_use``) scrape like any production workload;
+* a derived **headroom ratio** — min over devices of
+  ``(limit - in_use) / limit`` — feeds the supervised search, which
+  halves its pool *pre-emptively* when headroom drops below
+  ``JTPU_HEADROOM_MIN`` instead of waiting for the OOM
+  (:mod:`jepsen_tpu.resilience`).
+
+Graceful degradation is the contract: the CPU backend returns no
+memory statistics (``memory_stats()`` is ``None``), a backend that
+cannot even list devices returns none — every function here then
+answers with an empty list / ``None`` and touches nothing, so tier-1
+``JAX_PLATFORMS=cpu`` runs are behaviorally unchanged (asserted by
+``tests/test_obs.py``). jax is imported lazily for the same reason
+this package stays importable without it.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+from jepsen_tpu.obs import metrics as obs_metrics
+
+_BYTES_IN_USE = obs_metrics.gauge(
+    "jtpu_device_bytes_in_use",
+    "allocator bytes currently in use, per device (backends exposing "
+    "memory_stats only)")
+_BYTES_LIMIT = obs_metrics.gauge(
+    "jtpu_device_bytes_limit",
+    "allocator byte limit, per device")
+_BYTES_PEAK = obs_metrics.gauge(
+    "jtpu_device_peak_bytes_in_use",
+    "allocator peak bytes in use, per device")
+_HEADROOM = obs_metrics.gauge(
+    "jtpu_device_headroom_ratio",
+    "min over devices of (limit - in_use)/limit; absent when no "
+    "backend device exposes memory stats")
+
+#: Default pre-emptive pool-halving threshold (see headroom_threshold).
+DEFAULT_HEADROOM_MIN = 0.05
+
+
+def _devices() -> list:
+    """The backend's device list, or [] when jax is absent or the
+    backend cannot initialize (the accounting must never be the thing
+    that wedges a run)."""
+    try:
+        import jax
+        return list(jax.devices())
+    except Exception:  # noqa: BLE001 — no backend is a no-op, not a fault
+        return []
+
+
+def memory_stats(device) -> Optional[Dict[str, Any]]:
+    """``device.memory_stats()`` where the backend provides it; None on
+    backends that don't (CPU returns None, older plugins raise)."""
+    try:
+        ms = device.memory_stats()
+    except Exception:  # noqa: BLE001 — unsupported backends may raise
+        return None
+    if not isinstance(ms, dict) or not ms:
+        return None
+    return ms
+
+
+def poll() -> List[Dict[str, Any]]:
+    """Poll every device's allocator stats, update the per-device
+    gauges, and return one row per device that reported:
+    ``{"device", "bytes-in-use", "bytes-limit", "peak-bytes-in-use",
+    "headroom"}``. Empty list when no device exposes stats (CPU)."""
+    rows: List[Dict[str, Any]] = []
+    for d in _devices():
+        ms = memory_stats(d)
+        if ms is None:
+            continue
+        label = f"{getattr(d, 'platform', '?')}:{getattr(d, 'id', '?')}"
+        in_use = ms.get("bytes_in_use")
+        limit = ms.get("bytes_limit") or ms.get("bytes_reservable_limit")
+        peak = ms.get("peak_bytes_in_use")
+        if in_use is not None:
+            _BYTES_IN_USE.set(float(in_use), device=label)
+        if limit is not None:
+            _BYTES_LIMIT.set(float(limit), device=label)
+        if peak is not None:
+            _BYTES_PEAK.set(float(peak), device=label)
+        head = None
+        if in_use is not None and limit:
+            head = max(0.0, (float(limit) - float(in_use)) / float(limit))
+        rows.append({"device": label, "bytes-in-use": in_use,
+                     "bytes-limit": limit, "peak-bytes-in-use": peak,
+                     "headroom": head})
+    return rows
+
+
+def headroom_ratio(rows: Optional[List[Dict[str, Any]]] = None
+                   ) -> Optional[float]:
+    """Min over devices of (limit - in_use)/limit, updating the
+    ``jtpu_device_headroom_ratio`` gauge; None when no device reports
+    memory stats (the pre-emptive halving is then inert)."""
+    if rows is None:
+        rows = poll()
+    heads = [r["headroom"] for r in rows if r.get("headroom") is not None]
+    if not heads:
+        return None
+    h = min(heads)
+    _HEADROOM.set(h)
+    return h
+
+
+def headroom_threshold() -> float:
+    """The pre-emptive pool-halving threshold (JTPU_HEADROOM_MIN,
+    default 0.05). <= 0 disables pre-emptive halving entirely."""
+    v = os.environ.get("JTPU_HEADROOM_MIN")
+    if not v:
+        return DEFAULT_HEADROOM_MIN
+    try:
+        return float(v)
+    except ValueError:
+        return DEFAULT_HEADROOM_MIN

@@ -352,3 +352,33 @@ def summarize(records: List[dict]) -> Dict[str, Dict[str, Any]]:
         s["total-ns"] += d
         s["max-ns"] = max(s["max-ns"], d)
     return dict(sorted(out.items()))
+
+
+def self_time_rollup(records: List[dict]
+                     ) -> Dict[str, Dict[str, Any]]:
+    """Per-name SELF-time rollup: each span's duration minus its direct
+    children's (via the ``pid`` parent link), so an outer span that
+    merely contains a slow inner one stops dominating the table. The
+    ``jtpu trace summary --top N`` payload: ``{name: {count, self-ns,
+    p95-ns}}`` with p95 over the per-span self times."""
+    child_ns: Dict[int, int] = {}
+    for r in records:
+        pid = r.get("pid")
+        if pid:
+            child_ns[pid] = child_ns.get(pid, 0) \
+                + int(r.get("dur", 0) or 0)
+    selves: Dict[str, List[int]] = {}
+    for r in records:
+        dur = int(r.get("dur", 0) or 0)
+        if dur <= 0:
+            continue
+        own = max(0, dur - child_ns.get(r.get("sid"), 0))
+        selves.setdefault(str(r.get("name", "?")), []).append(own)
+    out: Dict[str, Dict[str, Any]] = {}
+    for name, vals in selves.items():
+        vals.sort()
+        # nearest-rank p95: the smallest value covering 95% of spans
+        idx = min(len(vals) - 1, max(0, -(-95 * len(vals) // 100) - 1))
+        out[name] = {"count": len(vals), "self-ns": sum(vals),
+                     "p95-ns": vals[idx]}
+    return dict(sorted(out.items()))

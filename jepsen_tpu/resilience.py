@@ -55,7 +55,9 @@ from jepsen_tpu import accel, obs
 from jepsen_tpu.checker import UNKNOWN
 from jepsen_tpu.checker import tpu as T
 from jepsen_tpu.models.core import KernelSpec, Model
+from jepsen_tpu.obs import devices as obs_devices
 from jepsen_tpu.obs import metrics as obs_metrics
+from jepsen_tpu.obs import observatory as obs_observatory
 from jepsen_tpu.ops.encode import PackedHistory, pack_with_init
 
 log = logging.getLogger("jepsen.resilience")
@@ -72,6 +74,10 @@ _TRANSIENT_TOTAL = obs_metrics.counter(
 _BACKOFF_SECONDS = obs_metrics.counter(
     "jtpu_search_backoff_seconds_total",
     "seconds slept in supervised-search retry backoff")
+_PREEMPT_TOTAL = obs_metrics.counter(
+    "jtpu_search_preemptive_halve_total",
+    "pool halvings triggered by low device-memory headroom BEFORE any "
+    "OOM fired (see JTPU_HEADROOM_MIN)")
 
 # ---------------------------------------------------------------------------
 # Failure taxonomy
@@ -351,17 +357,7 @@ def _errstr(e: BaseException) -> str:
 
 
 def supervised_check_packed(p: PackedHistory, kernel: KernelSpec,
-                            capacity: Optional[int] = None,
-                            window: Optional[int] = None,
-                            expand: Optional[int] = None,
-                            segment_iters: Optional[int] = None,
-                            deadline_s: Optional[float] = None,
-                            policy: Optional[RetryPolicy] = None,
-                            resume: Optional[Checkpoint] = None,
-                            checkpoint_path: Optional[str] = None,
-                            on_checkpoint: Optional[
-                                Callable[[Checkpoint], None]] = None
-                            ) -> Dict[str, Any]:
+                            **kwargs) -> Dict[str, Any]:
     """Checkpointed, supervised single-history device search.
 
     Semantics match :func:`jepsen_tpu.checker.tpu.check_packed_tpu`
@@ -372,13 +368,44 @@ def supervised_check_packed(p: PackedHistory, kernel: KernelSpec,
       checkpoint continues on the CPU fallback device.
     * OOM halves the pool and resumes the checkpoint in the smaller
       shape; transients retry with jittered backoff; fatals rethrow
-      (with the trail attached as ``exc.resilience_trail``).
+      (with the trail attached as ``exc.resilience_trail``). Below the
+      JTPU_HEADROOM_MIN device-memory headroom ratio the pool halves
+      PRE-emptively, once per rung, before any allocator failure
+      (:mod:`jepsen_tpu.obs.devices`; no-op on stat-less backends).
     * ``resume`` — continue a saved :class:`Checkpoint` (same packed
       history) instead of starting over; ``checkpoint_path`` /
       ``on_checkpoint`` persist/observe checkpoints after each segment.
     * The result carries ``attempts`` (the supervision trail),
-      ``segments``, and ``segment-iters`` alongside the usual keys.
+      ``segments``, ``segment-iters``, and (with tracing on) ``cost``
+      — per-executable XLA cost-model entries — alongside the usual
+      telemetry keys.
+    * Live progress (level / frontier width / configs-per-s / ETA) is
+      published to :mod:`jepsen_tpu.obs.observatory` after every
+      segment — the ``watch`` CLI and ``/live`` endpoint surface.
     """
+    try:
+        out = _supervised_check_packed(p, kernel, **kwargs)
+    except BaseException:
+        # a raised search must not leave the observatory "searching"
+        obs_observatory.finish(valid="error")
+        raise
+    obs_observatory.finish(valid=out.get("valid"),
+                           levels=out.get("levels"))
+    return out
+
+
+def _supervised_check_packed(p: PackedHistory, kernel: KernelSpec,
+                             capacity: Optional[int] = None,
+                             window: Optional[int] = None,
+                             expand: Optional[int] = None,
+                             segment_iters: Optional[int] = None,
+                             deadline_s: Optional[float] = None,
+                             policy: Optional[RetryPolicy] = None,
+                             resume: Optional[Checkpoint] = None,
+                             checkpoint_path: Optional[str] = None,
+                             on_checkpoint: Optional[
+                                 Callable[[Checkpoint], None]] = None
+                             ) -> Dict[str, Any]:
     if window is not None:
         T._check_window(window)
     seg = segment_iters or T._segment_config(None) or T.DEFAULT_SEGMENT_ITERS
@@ -413,6 +440,15 @@ def supervised_check_packed(p: PackedHistory, kernel: KernelSpec,
     frontier_hwm = 0
     transfer_bytes = 0
     cols_b = T._cols_nbytes(cols)
+    # Per-executable XLA cost-model entries (doc/observability.md):
+    # flops / bytes-accessed are per while-iteration (the HLO cost
+    # analysis counts a while body once), accumulated with the levels
+    # each shape actually ran — bench.py's utilization lines read this.
+    cost_entries: Dict[tuple, Dict[str, Any]] = {}
+    # Pre-emptive OOM avoidance (obs/devices.py): below this headroom
+    # ratio the pool halves BEFORE the allocator fails. Inert when the
+    # backend exposes no memory stats (CPU) or the knob is <= 0.
+    hr_min = obs_devices.headroom_threshold()
     if resume is not None:
         idx = next((i for i, r in enumerate(ladder)
                     if tuple(r) == tuple(resume.rung)), None)
@@ -433,8 +469,41 @@ def supervised_check_packed(p: PackedHistory, kernel: KernelSpec,
                                    int(cols["nr"]))
             cap_eff, exp_eff, seg_idx = cap, exp, 0
         transients = ooms = 0
+        preempted = False
         abort: Optional[str] = None
+        obs_observatory.begin(
+            level_budget=lmax, rung=(cap_eff, win, exp_eff),
+            segment_iters=seg,
+            backend=("cpu-fallback" if fallback is not None
+                     else "default"))
         while T._carry_active(carry, lmax):
+            # Segment-boundary device-memory poll: updates the
+            # per-device gauges; a headroom ratio below JTPU_HEADROOM_MIN
+            # halves the pool BEFORE the allocator fails. Once per rung:
+            # the allocator retains freed pages, so in_use does not drop
+            # after a halve and re-triggering would cascade to the floor.
+            headroom = obs_devices.headroom_ratio()
+            if (headroom is not None and hr_min > 0 and not preempted
+                    and headroom < hr_min
+                    and cap_eff // 2 >= policy.min_capacity):
+                new_cap = cap_eff // 2
+                carry, dropped = _shrink_carry(carry, new_cap)
+                cap_eff = new_cap
+                if isinstance(exp_eff, int):
+                    exp_eff = max(1, min(exp_eff // 2, cap_eff))
+                preempted = True
+                _PREEMPT_TOTAL.inc()
+                trail.append({"rung": (cap, win, exp),
+                              "effective": (cap_eff, win, exp_eff),
+                              "segment": seg_idx, "level": int(carry[8]),
+                              "event": OOM,
+                              "outcome": f"preemptive-halve-to-{cap_eff}",
+                              "headroom": round(headroom, 4),
+                              "lossy": dropped})
+                log.warning(
+                    "device headroom %.1f%% below the %.1f%% floor; "
+                    "pre-emptively halving the pool to %s rows",
+                    100 * headroom, 100 * hr_min, cap_eff)
             unroll = T._unroll_factor()
             fn = T._jit_segment(T._kernel_key(kernel), cap_eff, win,
                                 exp_eff, unroll)
@@ -451,6 +520,7 @@ def supervised_check_packed(p: PackedHistory, kernel: KernelSpec,
             phase = ("compile" if shape_key not in T._EXECUTED_SHAPES
                      else "execute")
             lvl0 = int(carry[8])
+            cost = None
             try:
                 if _inject_fault is not None:
                     _inject_fault(dict(ctx))
@@ -461,6 +531,17 @@ def supervised_check_packed(p: PackedHistory, kernel: KernelSpec,
                 with obs.span("checker.segment", phase=phase,
                               segment=seg_idx, level=lvl0,
                               backend=ctx["backend"]) as sp:
+                    if obs.enabled():
+                        # per-shape XLA cost model (memoized; lowering
+                        # only, no second compile) — before t0 so the
+                        # segment clock stays a device measurement
+                        cost = T._shape_cost(
+                            shape_key, fn,
+                            [cols[c] for c in T._COLS]
+                            + [np.int32(seg), carry])
+                        if cost:
+                            sp.set(flops=cost["flops"],
+                                   bytes_accessed=cost["bytes-accessed"])
                     t0 = time.perf_counter()
                     carry = _call_segment(fn, cols, carry, seg,
                                           device=fallback,
@@ -580,6 +661,24 @@ def supervised_check_packed(p: PackedHistory, kernel: KernelSpec,
                 T._TRANSFER_BYTES.inc(carry_b,
                                       direction="device-to-host")
                 transfer_bytes += cols_b + 2 * carry_b
+                if cost:
+                    ent = cost_entries.get(shape_key)
+                    if ent is None:
+                        ent = cost_entries[shape_key] = dict(
+                            kind="segment",
+                            rung=[cap_eff, win, exp_eff],
+                            unroll=unroll, levels=0, **cost)
+                    ent["levels"] += lvl1 - lvl0
+                # live heartbeat: level / frontier / rate / ETA into the
+                # observatory gauges + progress.json (the watch surface)
+                obs_observatory.publish(
+                    level=lvl1, frontier=alive, segments=seg_idx,
+                    seg_seconds=seg_s, levels_delta=lvl1 - lvl0,
+                    expansions=(lvl1 - lvl0)
+                    * min(exp_eff or cap_eff, cap_eff),
+                    rung=(cap_eff, win, exp_eff),
+                    backend=ctx["backend"], headroom=headroom,
+                    warmup=phase == "compile")
                 if checkpoint_path or on_checkpoint is not None:
                     cp = Checkpoint(carry=carry, rung=(cap, win, exp),
                                     window=win, expand_eff=exp_eff,
@@ -620,6 +719,10 @@ def supervised_check_packed(p: PackedHistory, kernel: KernelSpec,
         out["segment-levels"] = list(seg_levels)
         out["frontier-hwm"] = frontier_hwm
         out["transfer-bytes"] = transfer_bytes
+        if cost_entries:
+            # per-executable XLA cost-model accounting: flops / bytes
+            # are per while-iteration, "levels" is what this shape ran
+            out["cost"] = [dict(e) for e in cost_entries.values()]
         if fallback is not None:
             out["backend-fallback"] = "cpu"
         if out["valid"] is not UNKNOWN:

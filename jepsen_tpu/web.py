@@ -170,6 +170,9 @@ class Handler(BaseHTTPRequestHandler):
                 return self.home()
             if path == "/metrics":
                 return self.metrics()
+            if path.startswith("/live/"):
+                return self.live(path[len("/live/"):],
+                                 query=url.query)
             if path.startswith("/trace/"):
                 return self.trace(path[len("/trace/"):])
             if path.startswith("/files/"):
@@ -236,6 +239,52 @@ class Handler(BaseHTTPRequestHandler):
         self._send(200, obs_metrics.REGISTRY.to_prometheus().encode(),
                    ctype=obs_metrics.PROMETHEUS_CTYPE)
 
+    #: Long-poll ceiling for /live?wait= (seconds) — bounded so an
+    #: abandoned poller cannot pin a handler thread past the keep-alive.
+    LIVE_WAIT_MAX_S = 25.0
+
+    def live(self, rel: str, query: str = ""):
+        """``/live/<test>/<ts>`` — the run's live search progress as
+        JSON: ``{"state": <run.state status>, "progress": <progress.json
+        or null>}``. Long-poll flavor: ``?wait=N&since=TS`` blocks up to
+        N seconds (capped) until the progress heartbeat's ``ts`` moves
+        past ``since``, so the trace page's progress strip can follow a
+        multi-minute search without hammering the store. 404s only when
+        the run directory itself is missing — a run without a heartbeat
+        (JTPU_TRACE=0, killed before the first segment) answers with
+        ``progress: null``."""
+        import time as _time
+        from urllib.parse import parse_qs
+
+        from jepsen_tpu.obs import observatory
+        run_dir = os.path.join(self.root, rel.strip("/"))
+        if not _within(self.root, run_dir):
+            return self._page("403", "<p>Forbidden.</p>", code=403)
+        if not os.path.isdir(run_dir):
+            return self._send(
+                404, b'{"error": "no such run"}',
+                ctype="application/json")
+        q = parse_qs(query or "")
+
+        def _num(name, default=0.0):
+            try:
+                return float(q[name][0])
+            except (KeyError, IndexError, ValueError):
+                return default
+
+        wait = min(max(_num("wait"), 0.0), self.LIVE_WAIT_MAX_S)
+        since = _num("since")
+        deadline = _time.monotonic() + wait
+        while True:
+            progress = observatory.read_progress(run_dir)
+            changed = (progress or {}).get("ts", 0) > since
+            if changed or not wait or _time.monotonic() > deadline:
+                break
+            _time.sleep(0.25)
+        doc = {"state": _run_status(run_dir), "progress": progress}
+        self._send(200, json.dumps(doc, default=repr).encode(),
+                   ctype="application/json")
+
     #: Spans rendered per waterfall page (deepest-first file order);
     #: beyond this the page says how many were elided.
     TRACE_ROW_CAP = 2000
@@ -256,8 +305,9 @@ class Handler(BaseHTTPRequestHandler):
         from jepsen_tpu.obs import trace as trace_ns
         records, stats = trace_ns.read_trace(path)
         self._page(f"trace {rel}",
-                   _waterfall_html(records, stats,
-                                   cap=self.TRACE_ROW_CAP))
+                   _progress_strip_html(rel)
+                   + _waterfall_html(records, stats,
+                                     cap=self.TRACE_ROW_CAP))
 
     def files(self, rel: str, zip_requested: bool = False):
         """Static file / dir browser / zip download (web.clj:194-271)."""
@@ -353,6 +403,52 @@ class Handler(BaseHTTPRequestHandler):
             # re-raising would let do_GET's generic 500 page inject
             # status-line bytes into the middle of the body framing.
             self.close_connection = True
+
+
+def _progress_strip_html(rel: str) -> str:
+    """The live progress strip atop the trace waterfall: status text +
+    a fill bar kept fresh by long-polling ``/live/<run>`` (the poll
+    blocks server-side on ``?wait=&since=`` until the heartbeat moves,
+    so an idle page costs one request per ~20 s). Degrades to a static
+    'no heartbeat' line for runs that never published progress
+    (JTPU_TRACE=0, pre-observatory runs, or no JS)."""
+    live = f"/live/{quote(rel.strip('/'), safe='/')}"
+    return (
+        "<div style='margin:.5em 0;padding:.4em;background:#f5f5f5;"
+        "border-radius:4px'>"
+        "<div id=liveText style='font-size:12px'>live: waiting for "
+        "progress heartbeat&hellip;</div>"
+        "<div style='background:#ddd;height:6px;border-radius:3px;"
+        "margin-top:3px'><div id=liveBar style='background:#4E79A7;"
+        "height:100%;width:0%;border-radius:3px'></div></div></div>"
+        "<script>(function(){\n"
+        "var since=0;\n"
+        "function render(d){\n"
+        " var p=d.progress;\n"
+        " if(!p){document.getElementById('liveText').textContent="
+        "'live: no progress heartbeat (state='+(d.state||'?')+')';"
+        "return false;}\n"
+        " since=p.ts||0;\n"
+        " var b=p['level-budget']||0,l=p.level||0;\n"
+        " document.getElementById('liveBar').style.width="
+        "(b?Math.min(100,100*l/b):0)+'%';\n"
+        " var bits=['level '+l+'/'+b,'frontier '+(p['frontier-rows']"
+        "==null?'?':p['frontier-rows'])+' rows','seg '+p.segments];\n"
+        " if(p['levels-per-s'])bits.push(p['levels-per-s']+"
+        "' levels/s');\n"
+        " if(p['eta-s']!=null&&p.state!=='done')bits.push('eta '+"
+        "p['eta-s']+'s');\n"
+        " if(p.state==='done')bits.push('done valid='+p.valid);\n"
+        " document.getElementById('liveText').textContent='live: '+"
+        "bits.join(' | ');\n"
+        " return p.state!=='done';}\n"
+        "function tick(){\n"
+        f" fetch('{live}?wait=20&since='+since)"
+        ".then(function(r){return r.json();})\n"
+        "  .then(function(d){setTimeout(tick,"
+        "render(d)?500:10000);})\n"
+        "  .catch(function(){setTimeout(tick,5000);});}\n"
+        "tick();})();</script>")
 
 
 #: Categorical bar palette for the waterfall (cycled by span-name hash).

@@ -164,6 +164,67 @@ class TestTimeline:
         out = timeline.html().check({}, History())
         assert out["valid"] is True
 
+    def _nemesis_history(self, with_heal=True):
+        from jepsen_tpu.history import NEMESIS
+        rows = [
+            Op(type="invoke", f="read", value=None, process=0, time=1),
+            Op(type="ok", f="read", value=1, process=0, time=2),
+            Op(type="info", f="start", value=None, process=NEMESIS,
+               time=3),
+            Op(type="info", f="start", value="cut", process=NEMESIS,
+               time=4),
+            Op(type="invoke", f="write", value=2, process=1, time=5),
+            Op(type="fail", f="write", value=2, process=1, time=6),
+        ]
+        if with_heal:
+            rows += [
+                Op(type="info", f="stop", value=None, process=NEMESIS,
+                   time=7),
+                Op(type="info", f="stop", value="healed",
+                   process=NEMESIS, time=8),
+                Op(type="invoke", f="read", value=None, process=0,
+                   time=9),
+                Op(type="ok", f="read", value=2, process=0, time=10),
+            ]
+        return History.of(rows)
+
+    def test_fault_windows_from_nemesis_pairs(self):
+        # a window opens at the non-heal COMPLETION (index 3: the
+        # second `start` row) and closes at the heal completion
+        # (index 7) — the jtpu_fault_active transitions, as ranges
+        h = self._nemesis_history()
+        assert timeline.fault_windows(h) == [(3, 7, "start")]
+        # an unhealed fault extends to the end of the history
+        h = self._nemesis_history(with_heal=False)
+        assert timeline.fault_windows(h) == [(3, 6, "start")]
+        # probe annotations ride outside the pairing
+        from jepsen_tpu.history import NEMESIS
+        rows = list(self._nemesis_history())
+        rows.insert(7, Op(type="info", f="heal-verified", value={},
+                          process=NEMESIS, time=6))
+        assert len(timeline.fault_windows(History.of(rows))) == 1
+        # no nemesis ops -> no windows
+        assert timeline.fault_windows(History.of(rows[:2])) == []
+
+    def test_fault_bands_shade_the_page(self, tmp_path):
+        h = self._nemesis_history()
+        timeline.html().check({"store-dir": str(tmp_path),
+                               "name": "tl"}, h)
+        page = (tmp_path / "timeline.html").read_text()
+        assert page.count('class="fault"') == 1
+        assert "nemesis fault window: start" in page
+        # band sits at the window's row range (top = HEIGHT * 3)
+        assert f"top:{timeline.HEIGHT * 3}px" in page
+        # a fault-free history renders no bands
+        h2 = History.of([
+            Op(type="invoke", f="read", value=None, process=0, time=1),
+            Op(type="ok", f="read", value=1, process=0, time=2),
+        ])
+        timeline.html().check({"store-dir": str(tmp_path),
+                               "name": "tl"}, h2)
+        page = (tmp_path / "timeline.html").read_text()
+        assert 'class="fault"' not in page
+
 
 class TestControlNet:
     def test_reachable(self):

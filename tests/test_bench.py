@@ -1,13 +1,117 @@
 """The driver contract: bench.py prints exactly one JSON line with the
 required keys, and the multichip dryrun entry runs on the virtual mesh.
-A broken bench records nothing for the round, so it gets its own test."""
+A broken bench records nothing for the round, so it gets its own test.
+tools/bench_gate.py (the BENCH_r*.json trajectory regression gate) is
+covered here too — it is what finally makes the trajectory actionable
+in CI."""
 
+import importlib.util
 import json
 import os
 import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _bench_gate():
+    spec = importlib.util.spec_from_file_location(
+        "bench_gate", os.path.join(REPO, "tools", "bench_gate.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_records(d, values, cold=None, platform="cpu", start=1):
+    for i, v in enumerate(values):
+        n = start + i
+        parsed = None
+        if v is not None:
+            parsed = {"metric": "m", "value": v, "unit": "s",
+                      "vs_baseline": 1.0, "platform": platform}
+            if cold is not None:
+                parsed["cold_s"] = cold[i]
+        with open(os.path.join(d, f"BENCH_r{n:02d}.json"), "w") as f:
+            json.dump({"n": n, "cmd": "x", "rc": 0 if parsed else 1,
+                       "tail": "", "parsed": parsed}, f)
+
+
+class TestBenchGate:
+    def test_passes_on_the_committed_trajectory(self):
+        gate = _bench_gate().gate(REPO)
+        assert gate["ok"], gate
+
+    def test_flags_a_synthetic_2x_slowdown(self, tmp_path):
+        bg = _bench_gate()
+        _write_records(str(tmp_path), [0.6, 0.61, 0.62, 1.22],
+                       cold=[1.1, 1.2, 1.3, 1.25])
+        doc = bg.gate(str(tmp_path))
+        assert not doc["ok"]
+        (value_check,) = [c for c in doc["checks"]
+                          if c["axis"] == "value"]
+        assert value_check["status"] == "regression"
+        # cold stayed in band: only the warm axis fails
+        (cold_check,) = [c for c in doc["checks"]
+                         if c["axis"] == "cold_s"]
+        assert cold_check["status"] == "ok"
+
+    def test_cold_regression_flags_independently(self, tmp_path):
+        bg = _bench_gate()
+        _write_records(str(tmp_path), [0.6, 0.61, 0.62, 0.6],
+                       cold=[1.0, 1.2, 1.1, 9.0])
+        doc = bg.gate(str(tmp_path))
+        assert not doc["ok"]
+        (cold_check,) = [c for c in doc["checks"]
+                         if c["axis"] == "cold_s"]
+        assert cold_check["status"] == "regression"
+
+    def test_cross_platform_records_are_not_compared(self, tmp_path):
+        bg = _bench_gate()
+        # a tpu 9s record must not poison the cpu median (and vice
+        # versa) — exactly the committed trajectory's shape
+        _write_records(str(tmp_path), [9.0], platform="tpu", start=1)
+        _write_records(str(tmp_path), [0.6, 0.61, 0.62], start=2)
+        doc = bg.gate(str(tmp_path))
+        assert doc["ok"]
+        assert doc["comparable-priors"] == 2
+
+    def test_short_trajectory_passes_with_note(self, tmp_path):
+        bg = _bench_gate()
+        _write_records(str(tmp_path), [0.6, 1.8])
+        doc = bg.gate(str(tmp_path))
+        assert doc["ok"]
+        assert all(c["status"] == "skipped" for c in doc["checks"])
+
+    def test_newest_without_measurement_fails(self, tmp_path):
+        bg = _bench_gate()
+        _write_records(str(tmp_path), [0.6, 0.61])
+        with open(os.path.join(str(tmp_path), "BENCH_r03.json"),
+                  "w") as f:
+            json.dump({"n": 3, "rc": 1, "tail": "",
+                       "parsed": {"metric": "m", "value": None,
+                                  "unit": "s", "vs_baseline": 0,
+                                  "error": "wedged"}}, f)
+        doc = bg.gate(str(tmp_path))
+        assert not doc["ok"]
+        assert "no measurement" in doc["note"]
+
+    def test_cli_json_format_and_exit_codes(self, tmp_path):
+        _write_records(str(tmp_path), [0.6, 0.61, 0.62, 2.4])
+        pr = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "bench_gate.py"),
+             "--root", str(tmp_path), "--format", "json"],
+            capture_output=True, text=True, timeout=60)
+        assert pr.returncode == 1
+        doc = json.loads(pr.stdout)
+        assert doc["ok"] is False
+        pr = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "bench_gate.py"),
+             "--root", str(tmp_path), "--tolerance", "10"],
+            capture_output=True, text=True, timeout=60)
+        assert pr.returncode == 0
+        assert "clean" in pr.stdout
 
 
 class TestBenchContract:

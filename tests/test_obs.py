@@ -1,7 +1,9 @@
 """Observability subsystem (jepsen_tpu.obs): span tracer semantics,
 metrics registry math, export formats, run artifacts, the JTPU_TRACE
-kill switch, and the /metrics endpoint. Tier-1 under the ``obs``
-marker (doc/observability.md is the operator view)."""
+kill switch, the /metrics + /live endpoints, and the search
+observatory (live progress, device memory accounting, XLA cost
+accounting). Tier-1 under the ``obs`` marker (doc/observability.md is
+the operator view)."""
 
 import json
 import os
@@ -11,7 +13,9 @@ import urllib.request
 import pytest
 
 from jepsen_tpu import obs
+from jepsen_tpu.obs import devices as obs_devices
 from jepsen_tpu.obs import metrics as obs_metrics
+from jepsen_tpu.obs import observatory as obs_observatory
 from jepsen_tpu.obs import trace as obs_trace
 
 pytestmark = pytest.mark.obs
@@ -332,7 +336,304 @@ class TestInstrumentation:
         t = one_run(tmp_path / "off")
         arts = sorted(os.listdir(t["store-dir"]))
         assert "trace.jsonl" not in arts and "metrics.json" not in arts
+        assert "progress.json" not in arts
         assert t["results"]["valid"] is True
+
+
+# ---------------------------------------------------------------------------
+# Device memory accounting (obs/devices.py)
+# ---------------------------------------------------------------------------
+
+
+class _FakeDev:
+    def __init__(self, stats, platform="tpu", id=0):
+        self._stats = stats
+        self.platform = platform
+        self.id = id
+
+    def memory_stats(self):
+        if isinstance(self._stats, Exception):
+            raise self._stats
+        return self._stats
+
+
+class TestDevices:
+    def test_cpu_backend_is_a_silent_noop(self):
+        # tier-1 runs JAX_PLATFORMS=cpu: memory_stats() is None there,
+        # so the whole accounting stack must answer empty/None without
+        # touching a gauge or raising
+        rows = obs_devices.poll()
+        assert rows == []
+        assert obs_devices.headroom_ratio() is None
+
+    def test_memory_stats_none_and_raising_tolerated(self):
+        assert obs_devices.memory_stats(_FakeDev(None)) is None
+        assert obs_devices.memory_stats(
+            _FakeDev(RuntimeError("unsupported"))) is None
+        assert obs_devices.memory_stats(_FakeDev({})) is None
+
+    def test_poll_updates_gauges_and_headroom(self, monkeypatch):
+        devs = [_FakeDev({"bytes_in_use": 600, "bytes_limit": 1000,
+                          "peak_bytes_in_use": 800}, id=0),
+                _FakeDev({"bytes_in_use": 100, "bytes_limit": 1000},
+                         id=1)]
+        monkeypatch.setattr(obs_devices, "_devices", lambda: devs)
+        rows = obs_devices.poll()
+        assert len(rows) == 2
+        assert rows[0]["headroom"] == pytest.approx(0.4)
+        assert obs_devices.headroom_ratio(rows) == pytest.approx(0.4)
+        g = obs_metrics.REGISTRY.gauge("jtpu_device_bytes_in_use")
+        assert g.value(device="tpu:0") == 600
+        assert g.value(device="tpu:1") == 100
+        assert obs_metrics.REGISTRY.gauge(
+            "jtpu_device_peak_bytes_in_use").value(device="tpu:0") == 800
+
+    def test_headroom_threshold_env(self, monkeypatch):
+        monkeypatch.delenv("JTPU_HEADROOM_MIN", raising=False)
+        assert obs_devices.headroom_threshold() == \
+            obs_devices.DEFAULT_HEADROOM_MIN
+        monkeypatch.setenv("JTPU_HEADROOM_MIN", "0.2")
+        assert obs_devices.headroom_threshold() == 0.2
+        monkeypatch.setenv("JTPU_HEADROOM_MIN", "junk")
+        assert obs_devices.headroom_threshold() == \
+            obs_devices.DEFAULT_HEADROOM_MIN
+
+    def test_low_headroom_preemptively_halves_the_pool(self,
+                                                       monkeypatch):
+        # a fake backend reporting 1% headroom: the supervised search
+        # halves its pool BEFORE any OOM, exactly once per rung
+        from jepsen_tpu.models import CASRegister
+        from jepsen_tpu.ops.encode import pack_with_init
+        from jepsen_tpu.resilience import supervised_check_packed
+        from jepsen_tpu.testing import simulate_register_history
+        devs = [_FakeDev({"bytes_in_use": 990, "bytes_limit": 1000})]
+        monkeypatch.setattr(obs_devices, "_devices", lambda: devs)
+        monkeypatch.setenv("JTPU_HEADROOM_MIN", "0.05")
+        h = simulate_register_history(150, n_procs=5, n_vals=4, seed=3)
+        p, kernel = pack_with_init(h, CASRegister())
+        r = supervised_check_packed(p, kernel, capacity=64, expand=8,
+                                    segment_iters=8)
+        assert r["valid"] is True
+        pre = [a for a in r["attempts"]
+               if str(a.get("outcome", "")).startswith(
+                   "preemptive-halve")]
+        assert len(pre) == 1
+        assert pre[0]["headroom"] == pytest.approx(0.01)
+        assert r["rung"][0] == 32
+
+
+# ---------------------------------------------------------------------------
+# The search observatory (obs/observatory.py) + watch surfaces
+# ---------------------------------------------------------------------------
+
+
+class TestObservatory:
+    def test_publish_ewma_eta_and_format(self):
+        ob = obs_observatory.Observatory()
+        assert ob.snapshot() is None
+        ob.begin(level_budget=1000, rung=(64, 32, 8), segment_iters=100)
+        ob.publish(level=100, frontier=40, segments=1, seg_seconds=0.1,
+                   levels_delta=100, expansions=800)
+        ob.publish(level=200, frontier=30, segments=2, seg_seconds=0.1,
+                   levels_delta=100, expansions=800)
+        p = ob.snapshot()
+        assert p["state"] == "searching"
+        assert p["level"] == 200 and p["frontier-rows"] == 30
+        assert p["segments"] == 2 and p["segments-est"] == 10
+        assert p["levels-per-s"] == pytest.approx(1000, rel=0.01)
+        assert p["eta-s"] == pytest.approx(0.8, rel=0.01)
+        line = obs_observatory.format_status(p)
+        assert "level 200/1000" in line and "frontier 30 rows" in line
+        ob.finish(valid=True, levels=250)
+        p = ob.snapshot()
+        assert p["state"] == "done" and p["valid"] is True
+        assert p["level"] == 250
+        # finishing again (early-out paths) must not clobber anything
+        ob.finish(valid=False)
+        assert ob.snapshot()["valid"] is True
+
+    def test_progress_file_and_kill_switch(self, tmp_path,
+                                           monkeypatch):
+        monkeypatch.setenv("JTPU_TRACE", "1")
+        ob = obs_observatory.Observatory()
+        ob.attach(str(tmp_path))
+        ob.begin(level_budget=10, rung=(8, 32, 2), segment_iters=4)
+        ob.publish(level=4, frontier=2, segments=1, seg_seconds=0.01,
+                   levels_delta=4, expansions=8)
+        ob.finish(valid=True)
+        doc = obs_observatory.read_progress(str(tmp_path))
+        assert doc and doc["state"] == "done" and doc["level"] == 4
+        # kill switch: attach refuses the sink, nothing is written
+        monkeypatch.setenv("JTPU_TRACE", "0")
+        off_dir = tmp_path / "off"
+        off_dir.mkdir()
+        ob2 = obs_observatory.Observatory()
+        ob2.attach(str(off_dir))
+        ob2.begin(level_budget=10, rung=(8, 32, 2), segment_iters=4)
+        ob2.publish(level=4, frontier=2, segments=1, seg_seconds=0.01,
+                    levels_delta=4, expansions=8)
+        ob2.finish(valid=True)
+        assert not os.path.exists(
+            str(off_dir / obs_observatory.PROGRESS_NAME))
+        # ...but the in-memory snapshot still works (run --watch path)
+        assert ob2.snapshot()["state"] == "done"
+
+    def test_read_progress_tolerates_garbage(self, tmp_path):
+        assert obs_observatory.read_progress(str(tmp_path)) is None
+        (tmp_path / obs_observatory.PROGRESS_NAME).write_text("{nope")
+        assert obs_observatory.read_progress(str(tmp_path)) is None
+
+    def test_supervised_search_publishes_live_progress(self, tmp_path):
+        from jepsen_tpu.models import CASRegister
+        from jepsen_tpu.ops.encode import pack_with_init
+        from jepsen_tpu.resilience import supervised_check_packed
+        from jepsen_tpu.testing import simulate_register_history
+        h = simulate_register_history(150, n_procs=5, n_vals=4, seed=3)
+        p, kernel = pack_with_init(h, CASRegister())
+        obs_observatory.attach(str(tmp_path))
+        try:
+            r = supervised_check_packed(p, kernel, capacity=64,
+                                        expand=8, segment_iters=8)
+        finally:
+            obs_observatory.detach()
+        snap = obs_observatory.snapshot()
+        assert snap["state"] == "done" and snap["valid"] is True
+        assert snap["level"] == r["levels"]
+        assert snap["segments"] == r["segments"]
+        doc = obs_observatory.read_progress(str(tmp_path))
+        assert doc and doc["state"] == "done"
+        assert obs_metrics.REGISTRY.gauge(
+            "jtpu_search_level").value() == r["levels"]
+        assert obs_metrics.REGISTRY.gauge(
+            "jtpu_search_inflight").value() == 0
+
+    def test_live_status_printer(self):
+        import io
+        out = io.StringIO()
+        obs_observatory.OBSERVATORY.begin(
+            level_budget=100, rung=(8, 32, 2), segment_iters=10)
+        obs_observatory.OBSERVATORY.publish(
+            level=10, frontier=4, segments=1, seg_seconds=0.01,
+            levels_delta=10, expansions=20)
+        stop = obs_observatory.live_status_printer(interval=0.01,
+                                                   out=out)
+        import time as _t
+        _t.sleep(0.1)
+        stop()
+        assert "# watch: level" in out.getvalue()
+
+
+class TestWatchCLI:
+    def test_watch_once_and_degradation(self, tmp_path, capsys):
+        from jepsen_tpu import cli
+        d = tmp_path / "run"
+        d.mkdir()
+        # no progress.json at all: a graceful line, exit 0 (the watch
+        # path must be a silent no-op for pre-observatory runs)
+        rc = cli.run(cli.default_commands(),
+                     ["watch", "--store", str(d), "--once"])
+        assert rc == cli.OK
+        out = capsys.readouterr().out
+        assert "no search progress" in out
+        (d / obs_observatory.PROGRESS_NAME).write_text(json.dumps(
+            {"state": "searching", "ts": 1.0, "level": 50,
+             "level-budget": 200, "frontier-rows": 8, "segments": 2,
+             "segments-est": 20, "levels-per-s": 500.0,
+             "configs-per-s": 4000.0, "eta-s": 0.3}))
+        rc = cli.run(cli.default_commands(),
+                     ["watch", "--store", str(d), "--once"])
+        assert rc == cli.OK
+        out = capsys.readouterr().out
+        assert "level 50/200" in out and "eta 0.3s" in out
+        rc = cli.run(cli.default_commands(),
+                     ["watch", "--store", str(tmp_path / "nope"),
+                      "--once"])
+        assert rc == cli.INVALID_ARGS
+
+
+# ---------------------------------------------------------------------------
+# XLA cost accounting
+# ---------------------------------------------------------------------------
+
+
+class TestCostAccounting:
+    def _check(self, **kw):
+        from jepsen_tpu.models import CASRegister
+        from jepsen_tpu.ops.encode import pack_with_init
+        from jepsen_tpu.resilience import supervised_check_packed
+        from jepsen_tpu.testing import simulate_register_history
+        h = simulate_register_history(150, n_procs=5, n_vals=4, seed=3)
+        p, kernel = pack_with_init(h, CASRegister())
+        return supervised_check_packed(p, kernel, capacity=64,
+                                       expand=8, segment_iters=8, **kw)
+
+    def test_supervised_result_carries_per_executable_cost(self):
+        r = self._check()
+        assert r["valid"] is True
+        (ent,) = r["cost"]
+        assert ent["kind"] == "segment"
+        assert ent["flops"] > 0 and ent["bytes-accessed"] > 0
+        assert ent["levels"] == r["levels"]
+        assert ent["rung"] == [64, 32, 8]
+        seg_spans = [s for s in obs.tracer().spans()
+                     if s["name"] == "checker.segment"]
+        assert seg_spans and seg_spans[-1]["flops"] == ent["flops"]
+
+    def test_monolithic_and_keyed_carry_cost(self):
+        from jepsen_tpu.checker.tpu import (check_history_tpu,
+                                            check_keyed_tpu)
+        from jepsen_tpu.models import CASRegister
+        from jepsen_tpu.testing import simulate_register_history
+        h = simulate_register_history(150, n_procs=5, n_vals=4, seed=3)
+        r = check_history_tpu(h, CASRegister(), segment_iters=0)
+        assert r["cost"][0]["kind"] == "single"
+        assert r["cost"][0]["flops"] > 0
+        keyed = {k: simulate_register_history(60, n_procs=3, n_vals=4,
+                                              seed=500 + k)
+                 for k in range(3)}
+        rk = check_keyed_tpu(keyed, CASRegister())
+        assert rk["valid"] is True
+        assert rk["cost"] and rk["cost"][0]["kind"] == "batch"
+        assert rk["cost"][0]["keys"] == 3
+        # the batch executable's cost lives at the TOP level only —
+        # attaching it per key would overcount the work keys-fold
+        assert all("cost" not in res
+                   for res in rk["results"].values())
+
+    def test_cost_absent_with_trace_off(self, monkeypatch):
+        monkeypatch.setenv("JTPU_TRACE", "0")
+        r = self._check()
+        assert r["valid"] is True
+        assert "cost" not in r
+
+    def test_cost_analysis_failure_degrades_silently(self, monkeypatch):
+        # a backend/jax without cost_analysis: verdicts unchanged, no
+        # cost key, no exception — the tier-1 degradation contract
+        from jepsen_tpu.checker import tpu as T
+
+        def boom(fn, args):
+            raise AttributeError("no cost_analysis on this backend")
+
+        monkeypatch.setattr(T, "_cost_analysis", boom)
+        monkeypatch.setattr(T, "_COST_BY_SHAPE", {})
+        r = self._check()
+        assert r["valid"] is True
+        assert "cost" not in r
+
+    def test_shard_balance_accounting(self):
+        import numpy as np
+        from jepsen_tpu.checker.tpu import _shard_balance
+        pk = np.array([5, 4, 3, 2, 9, 0, 0, 0], np.int32)
+        pa = np.array([1, 1, 1, 1, 1, 0, 0, 0], bool)
+        bal = _shard_balance((pk, pk, pa), 2)
+        assert bal["devices"] == 2
+        assert bal["live-rows"] == [4, 1]
+        assert bal["deepest-k"] == [5, 9]
+        assert bal["imbalance-ratio"] == pytest.approx(1.6)
+        assert obs_metrics.REGISTRY.gauge(
+            "jtpu_shard_imbalance_ratio").value() == pytest.approx(1.6)
+        # odd split: refuses rather than mis-attributing rows
+        assert _shard_balance((pk, pk, pa), 3) is None
 
 
 # ---------------------------------------------------------------------------
@@ -376,6 +677,53 @@ class TestWebMetrics:
         finally:
             server.shutdown()
 
+    def test_live_endpoint(self, tmp_path):
+        import urllib.error
+        from jepsen_tpu import web
+        run = tmp_path / "t" / "20260804T000001.000"
+        run.mkdir(parents=True)
+        server = web.serve_background(root=str(tmp_path))
+        base = f"http://127.0.0.1:{server.server_port}"
+        url = base + "/live/t/20260804T000001.000"
+        try:
+            # run exists but never published: progress null, not a 500
+            with urllib.request.urlopen(url) as r:
+                doc = json.load(r)
+            assert r.status == 200 and doc["progress"] is None
+            (run / obs_observatory.PROGRESS_NAME).write_text(
+                json.dumps({"state": "searching", "ts": 7.5,
+                            "level": 10, "level-budget": 100,
+                            "frontier-rows": 4, "segments": 1}))
+            with urllib.request.urlopen(url) as r:
+                doc = json.load(r)
+            assert doc["progress"]["level"] == 10
+            # long-poll: already-seen ts blocks until the (capped)
+            # wait elapses, fresh ts returns immediately
+            import time as _t
+            t0 = _t.monotonic()
+            with urllib.request.urlopen(url + "?wait=1&since=7.5") as r:
+                json.load(r)
+            assert _t.monotonic() - t0 >= 0.9
+            t0 = _t.monotonic()
+            with urllib.request.urlopen(url + "?wait=5&since=7.0") as r:
+                doc = json.load(r)
+            assert _t.monotonic() - t0 < 2
+            assert doc["progress"]["ts"] == 7.5
+            # a missing run 404s with a JSON body
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(base + "/live/t/nope")
+            assert ei.value.code == 404
+            # the trace page of a progress-bearing run carries the strip
+            (run / "trace.jsonl").write_text(
+                '{"name": "core.run", "ts": 0, "dur": 5, "tid": 1, '
+                '"sid": 1}\n')
+            page = urllib.request.urlopen(
+                base + "/trace/t/20260804T000001.000").read().decode()
+            assert "liveBar" in page \
+                and "/live/t/20260804T000001.000" in page
+        finally:
+            server.shutdown()
+
 
 class TestTraceCLI:
     def _store_with_trace(self, tmp_path):
@@ -411,6 +759,31 @@ class TestTraceCLI:
                      ["trace", "summary", "--store",
                       str(tmp_path / "nope")])
         assert rc == cli.INVALID_ARGS
+
+    def test_summary_top_self_time(self, tmp_path, capsys):
+        from jepsen_tpu import cli
+        d = self._store_with_trace(tmp_path)
+        rc = cli.run(cli.default_commands(),
+                     ["trace", "summary", "--store", d, "--top", "5"])
+        assert rc == cli.OK
+        out = capsys.readouterr().out
+        assert "top" in out and "self" in out
+
+    def test_self_time_rollup_subtracts_children(self):
+        recs = [
+            {"name": "outer", "ts": 0, "dur": 100, "tid": 1, "sid": 1},
+            {"name": "inner", "ts": 10, "dur": 80, "tid": 1, "sid": 2,
+             "pid": 1},
+            {"name": "inner", "ts": 95, "dur": 4, "tid": 1, "sid": 3,
+             "pid": 1},
+        ]
+        top = obs_trace.self_time_rollup(recs)
+        # outer's 100ns minus its children's 84ns = 16ns of self time
+        assert top["outer"] == {"count": 1, "self-ns": 16,
+                                "p95-ns": 16}
+        assert top["inner"]["count"] == 2
+        assert top["inner"]["self-ns"] == 84
+        assert top["inner"]["p95-ns"] == 80
 
     def test_recover_emits_trace_summary(self, tmp_path, capsys):
         # a dead run with a WAL and a trace: recover prints the
@@ -485,12 +858,47 @@ class TestTraceInJitLint:
         assert not [f for f in findings
                     if f.rule == "JAX-TRACE-IN-JIT"]
 
+    def test_flags_progress_publish_in_traced_body(self, tmp_path):
+        findings = self._lint(tmp_path, (
+            "from jepsen_tpu.obs import observatory\n"
+            "from jax import lax\n"
+            "def search(x):\n"
+            "    def body(c):\n"
+            "        observatory.publish(level=c)\n"
+            "        return c + 1\n"
+            "    return lax.while_loop(lambda c: c < x, body, 0)\n"))
+        rules = [f.rule for f in findings]
+        assert rules.count("JAX-TRACE-IN-JIT") == 1
+
+    def test_allowlist_suppresses_sanctioned_site(self, tmp_path,
+                                                  monkeypatch):
+        from jepsen_tpu.analysis import jax_lint
+        body = (
+            "from jepsen_tpu.obs import observatory\n"
+            "from jax import lax\n"
+            "def supervise(x):\n"
+            "    def body(c):\n"
+            "        observatory.publish(level=c)\n"
+            "        return c + 1\n"
+            "    return lax.while_loop(lambda c: c < x, body, 0)\n")
+        p = tmp_path / "mod.py"
+        p.write_text(body)
+        findings = jax_lint.lint_file(str(p), root=str(tmp_path))
+        assert [f for f in findings if f.rule == "JAX-TRACE-IN-JIT"]
+        monkeypatch.setattr(jax_lint, "TRACE_IN_JIT_ALLOWLIST",
+                            (("mod.py", "supervise"),))
+        findings = jax_lint.lint_file(str(p), root=str(tmp_path))
+        assert not [f for f in findings
+                    if f.rule == "JAX-TRACE-IN-JIT"]
+
     def test_repo_checker_stack_obeys_the_rule(self):
         # the instrumented production files themselves must be clean
         from jepsen_tpu.analysis import jax_lint
         for rel in ("jepsen_tpu/checker/tpu.py",
                     "jepsen_tpu/resilience.py",
-                    "jepsen_tpu/obs/trace.py"):
+                    "jepsen_tpu/obs/trace.py",
+                    "jepsen_tpu/obs/observatory.py",
+                    "jepsen_tpu/obs/devices.py"):
             findings = jax_lint.lint_file(os.path.join(REPO, rel),
                                           root=REPO)
             assert not [f for f in findings

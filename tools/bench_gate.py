@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""CI bench gate: compare the newest ``BENCH_r*.json`` record against
+the trajectory of prior runs and exit nonzero on a wall-clock
+regression.
+
+The driver appends one ``BENCH_rNN.json`` per round (the bench.py
+contract line under ``parsed``), and until this gate the trajectory
+just piled up — a 2x slowdown would ship unnoticed. The gate:
+
+* parses every ``BENCH_r*.json`` in the repo root (``--root``), keeping
+  records whose ``parsed.value`` is a number;
+* compares the NEWEST record's ``value`` (warm seconds — the headline)
+  and ``cold_s`` against the MEDIAN of prior same-platform records
+  (a tpu number must not be judged against a cpu-fallback trajectory);
+* flags a regression when ``newest > median * tolerance``. Warm is a
+  steady-state measurement, so the band is tight (``--tolerance``,
+  default 1.5x); cold includes XLA compilation whose cache hit/miss
+  varies run to run, so its band is loose (``--cold-tolerance``,
+  default 4.0x).
+
+Fewer than two comparable prior records passes with a note — a gate
+that fails on an empty trajectory would block the first rounds.
+
+Usage: python tools/bench_gate.py [--root DIR] [--tolerance X]
+       [--cold-tolerance X] [--format json]
+Exit code 0 iff the newest record is within both bands (documented
+next to tools/lint_gate.py — run both in CI).
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_records(root):
+    """(n, path, parsed) for every BENCH_r*.json, ordered by round
+    number; ``parsed`` is None for rounds that crashed or emitted no
+    contract line."""
+    out = []
+    for path in glob.glob(os.path.join(root, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            out.append((int(m.group(1)), path, None))
+            continue
+        parsed = doc.get("parsed")
+        n = doc.get("n", int(m.group(1)))
+        out.append((int(n), path, parsed
+                    if isinstance(parsed, dict) else None))
+    return sorted(out)
+
+
+def _median(vals):
+    vals = sorted(vals)
+    mid = len(vals) // 2
+    if len(vals) % 2:
+        return vals[mid]
+    return (vals[mid - 1] + vals[mid]) / 2
+
+
+def _check_axis(name, newest, priors, tolerance):
+    """One comparison axis (value / cold_s). Returns a verdict dict;
+    ``status`` is 'ok' | 'regression' | 'skipped'."""
+    new_v = newest.get(name)
+    prior_vals = [p[name] for p in priors
+                  if isinstance(p.get(name), (int, float))]
+    if not isinstance(new_v, (int, float)):
+        return {"axis": name, "status": "skipped",
+                "note": "newest record has no numeric value"}
+    if len(prior_vals) < 2:
+        return {"axis": name, "status": "skipped", "newest": new_v,
+                "note": f"only {len(prior_vals)} comparable prior "
+                        f"record(s); need 2"}
+    med = _median(prior_vals)
+    limit = med * tolerance
+    status = "regression" if new_v > limit else "ok"
+    return {"axis": name, "status": status, "newest": new_v,
+            "median": round(med, 3), "tolerance": tolerance,
+            "limit": round(limit, 3), "priors": len(prior_vals)}
+
+
+def gate(root, tolerance=1.5, cold_tolerance=4.0):
+    """The whole gate as data: {records, platform, checks, ok}."""
+    records = load_records(root)
+    parsed = [(n, p) for n, _, p in records if p is not None]
+    doc = {"records": len(records), "parsed": len(parsed),
+           "checks": [], "ok": True}
+    if not parsed:
+        doc["note"] = "no parseable BENCH records; nothing to gate"
+        return doc
+    newest_n, newest = parsed[-1]
+    doc["newest"] = newest_n
+    if newest.get("value") is None:
+        # the newest round crashed or fell through every backend: that
+        # is a failure in its own right, not a silent pass
+        doc["ok"] = False
+        doc["note"] = (f"newest record r{newest_n:02d} carries no "
+                       f"measurement (error: "
+                       f"{newest.get('error', 'unknown')!r})")
+        return doc
+    platform = newest.get("platform")
+    doc["platform"] = platform
+    # same-platform priors only: a tpu 8.9 s and a cpu 0.6 s measure
+    # different machines, and a median across them gates nothing
+    priors = [p for n, p in parsed[:-1]
+              if n != newest_n and p.get("platform") == platform]
+    doc["comparable-priors"] = len(priors)
+    for axis, tol in (("value", tolerance),
+                      ("cold_s", cold_tolerance)):
+        check = _check_axis(axis, newest, priors, tol)
+        doc["checks"].append(check)
+        if check["status"] == "regression":
+            doc["ok"] = False
+    return doc
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--root", default=REPO,
+                    help="directory holding the BENCH_r*.json "
+                         "trajectory (default: the repo root)")
+    ap.add_argument("--tolerance", type=float, default=1.5,
+                    help="warm-value band: newest > median * T fails")
+    ap.add_argument("--cold-tolerance", type=float, default=4.0,
+                    help="cold_s band (loose: compile-cache variance)")
+    ap.add_argument("--format", default="text",
+                    choices=["text", "json"])
+    args = ap.parse_args()
+
+    doc = gate(args.root, tolerance=args.tolerance,
+               cold_tolerance=args.cold_tolerance)
+    if args.format == "json":
+        print(json.dumps(doc, indent=2))
+    else:
+        print(f"# bench-gate: {doc['parsed']}/{doc['records']} "
+              f"record(s) parsed"
+              + (f", newest r{doc['newest']:02d} "
+                 f"({doc.get('platform')}, "
+                 f"{doc.get('comparable-priors')} comparable "
+                 f"prior(s))" if "newest" in doc else ""))
+        for c in doc["checks"]:
+            if c["status"] == "skipped":
+                print(f"# bench-gate: {c['axis']}: skipped "
+                      f"({c['note']})")
+            else:
+                print(f"# bench-gate: {c['axis']}: {c['status']} — "
+                      f"newest {c['newest']}s vs median {c['median']}s "
+                      f"x{c['tolerance']} = {c['limit']}s limit")
+        if doc.get("note"):
+            print(f"# bench-gate: {doc['note']}")
+        print("# bench-gate: " + ("clean" if doc["ok"]
+                                  else "FAILED — bench regression"))
+    return 0 if doc["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

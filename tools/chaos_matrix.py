@@ -27,6 +27,11 @@ qualify a new accelerator image before trusting it with long runs):
                    streamed trace.jsonl survives (tail-tolerant read),
                    and `recover` prints a `# trace:` span-count
                    summary next to its `# lint:`/`# recovery:` lines
+  watched-kill     SIGKILL a WATCHED localkv run mid-workload: the
+                   /live/<test>/<ts> endpoint still answers (state
+                   dead, no 500), the `watch` CLI degrades to a
+                   graceful status line, and recovery still renders
+                   a verdict
 
 Usage: python tools/chaos_matrix.py [--seed N] [--only NAME ...]
 Exit code 0 iff every selected scenario passes — nonzero on any
@@ -487,6 +492,102 @@ def scenario_trace_integrity(seed):
                 f"recover said: {trace_lines[:1]!r}")
 
 
+def scenario_watched_kill(seed):
+    """SIGKILL a WATCHED localkv run mid-workload; assert the live
+    observability surfaces survive the crash: the `/live/<test>/<ts>`
+    endpoint answers with the dead run's state (never a 500), the
+    `watch` CLI renders a graceful status line, and `recover` still
+    turns the WAL into a verdict."""
+    import contextlib
+    import io
+    import json as _json
+    import tempfile
+    import urllib.request
+
+    from jepsen_tpu import cli, store, web
+
+    root = tempfile.mkdtemp(prefix="jepsen-chaos-watched-")
+    run_dir = os.path.join(root, "local-kv", "run")
+    ports_file = os.path.join(root, "ports.json")
+    child_src = (
+        "import json, sys\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "from jepsen_tpu import core\n"
+        "from jepsen_tpu.suites.localkv import localkv_test\n"
+        "test = localkv_test({'time-limit': 60, 'nemesis-period': 3})\n"
+        f"test['store-dir'] = {run_dir!r}\n"
+        f"json.dump(test['localkv-ports'], open({ports_file!r}, 'w'))\n"
+        "core.run(test)\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", JTPU_TRACE="1")
+    proc = subprocess.Popen([sys.executable, "-c", child_src], env=env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    wal = os.path.join(run_dir, "history.wal")
+    deadline = time.time() + 90
+    lines = 0
+    try:
+        while time.time() < deadline:
+            if os.path.exists(wal):
+                with open(wal, "rb") as f:
+                    lines = sum(1 for _ in f)
+                if lines >= 40:
+                    break
+            if proc.poll() is not None:
+                return False, (f"child exited rc={proc.returncode} "
+                               f"before the kill (wal lines={lines})")
+            time.sleep(0.2)
+        else:
+            return False, f"workload never reached 40 WAL ops ({lines})"
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()
+    finally:
+        try:
+            with open(ports_file) as f:
+                _kill_kvnodes(json.load(f))
+        except OSError:
+            pass
+
+    if store.run_status(run_dir) != "dead":
+        return False, (f"killed run not detected as dead "
+                       f"(status={store.run_status(run_dir)!r})")
+    # live endpoint on the dead run: must answer JSON, never 500 (the
+    # kill landed mid-workload, before any search segment — progress
+    # is legitimately absent)
+    server = web.serve_background(root=root)
+    try:
+        url = (f"http://127.0.0.1:{server.server_port}"
+               f"/live/local-kv/run")
+        with urllib.request.urlopen(url, timeout=10) as r:
+            live_ok = r.status == 200
+            doc = _json.load(r)
+        live_ok = live_ok and doc.get("state") == "dead" \
+            and "progress" in doc
+    except Exception as e:  # noqa: BLE001 — an erroring endpoint fails
+        return False, f"/live endpoint died on the killed run: {e!r}"
+    finally:
+        server.shutdown()
+    # watch CLI on the dead run: one graceful line, exit 0
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        watch_rc = cli.run(cli.default_commands(),
+                           ["watch", "--store", run_dir, "--once"])
+    watch_out = buf.getvalue()
+    # and the run still recovers to a verdict, exactly like kill9
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli.run(cli.default_commands(),
+                     ["recover", "--store-root", root])
+    out = buf.getvalue()
+    recovered = (rc == 0 and "# recovery:" in out
+                 and store.run_status(run_dir) == "recovered")
+    ok = live_ok and watch_rc == 0 and "# watch:" in watch_out \
+        and recovered
+    return ok, (f"/live answered state=dead progress="
+                f"{doc.get('progress') is not None}; watch rc="
+                f"{watch_rc}; recover rc={rc} "
+                f"status={store.run_status(run_dir)}")
+
+
 SCENARIOS = (
     ("oom", scenario_oom),
     ("wedge", scenario_wedge),
@@ -496,6 +597,7 @@ SCENARIOS = (
     ("kill9-recover", scenario_kill9_recover),
     ("malformed-history", scenario_malformed_history),
     ("trace-integrity", scenario_trace_integrity),
+    ("watched-kill", scenario_watched_kill),
 )
 
 
