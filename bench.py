@@ -90,6 +90,14 @@ def child_main(platform: str) -> int:
     print(f"# synthesized {len(history)} events in {time.time()-t0:.1f}s",
           file=sys.stderr)
 
+    # Ahead-of-time search-plan forecast (doc/plan.md): the candidate
+    # rung universe, the cheapest valid rung and its predicted
+    # footprint vs the device byte budget — printed before any device
+    # time so a config this bench would burn minutes discovering is
+    # rejected/derated here is visible up front.
+    from jepsen_tpu.checker.plan import summary_line as _plan_summary
+    print(_plan_summary(history, CASRegister()), file=sys.stderr)
+
     # COLD: time-to-first-verdict, compiles included. Host-side rung
     # selection means exactly one rung compiles for this (low-
     # concurrency) shape; with a populated persistent cache even that

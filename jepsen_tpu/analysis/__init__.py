@@ -27,11 +27,20 @@ that front end, done statically. Four passes:
    for the threaded orchestrator: reads/writes of
    ``_history_lock``-guarded state outside a ``with
    test["_history_lock"]`` block.
+5. :mod:`~jepsen_tpu.analysis.plan_lint` — ahead-of-time search-plan
+   verification (engine: :mod:`jepsen_tpu.checker.plan`): proves the
+   shape buckets the device search would compile actually trace, fit
+   the device byte budget, shard cleanly, and stay inside int32 —
+   over a pinned model × dims fixture matrix, with zero XLA compiles.
+   Doubles as the mandatory pre-search plan gate in
+   :mod:`jepsen_tpu.checker.tpu` (kill switch ``JTPU_PLAN_GATE=0``).
 
 Findings carry file:line, a rule id, and a severity; a committed
 baseline file (:mod:`~jepsen_tpu.analysis.baseline`) suppresses
-deliberately-accepted findings so CI gates on *new* ones. CLI:
-``python -m jepsen_tpu lint`` (see doc/lint.md for the rule catalog).
+deliberately-accepted findings so CI gates on *new* ones. Exports:
+text, JSON, and SARIF 2.1.0 (:mod:`~jepsen_tpu.analysis.sarif`) for
+forge PR annotation. CLI: ``python -m jepsen_tpu lint`` (see
+doc/lint.md for the rule catalog, doc/plan.md for ``PLAN-*``).
 """
 
 from __future__ import annotations
@@ -132,7 +141,7 @@ DEFAULT_SCOPES = {
                 "jepsen_tpu/nemesis", "jepsen_tpu/obs"),
 }
 
-PASSES = ("suite", "history", "jax", "lockset")
+PASSES = ("suite", "history", "jax", "lockset", "plan")
 
 
 def _expand(paths: Iterable[str], root: str) -> List[str]:
@@ -207,4 +216,10 @@ def lint_repo(root: Optional[str] = None,
         for h in histories:
             ap = h if os.path.isabs(h) else os.path.join(root, h)
             findings.extend(history_lint.lint_history_file(ap, root=root))
+    if "plan" in passes:
+        # not file-scoped: the plan pass verifies the pinned model ×
+        # dims fixture matrix (arithmetic only here — tools/lint_gate.py
+        # runs the traced variant in CI)
+        from jepsen_tpu.analysis import plan_lint
+        findings.extend(plan_lint.lint_matrix())
     return findings

@@ -1,0 +1,154 @@
+"""Pass 5: search-plan verification (``PLAN-*``) — the findings/gate
+face of :mod:`jepsen_tpu.checker.plan`.
+
+The engine lives in ``checker/plan.py`` (it reasons about the checker's
+own shape buckets and must stay next to them); this module translates
+its reports into the shared :class:`~jepsen_tpu.analysis.Finding`
+currency so plan results flow through the same baseline, summary, JSON
+and SARIF machinery as the other four passes, and defines the exception
+the mandatory pre-search gate raises (mirroring
+``history_lint.MalformedHistoryError``).
+
+Rule catalog (severity in parentheses; full semantics in doc/plan.md):
+
+=========================  ==========================================
+PLAN-OOM (error)           predicted carry + expansion-grid + sort
+                           working set exceeds the device bytes-limit
+PLAN-SHARD-INDIVISIBLE     the mesh axis does not divide capacity or
+(error)                    expand — the SPMD partitioner cannot split
+                           the pool rows
+PLAN-SHARD-SKEW (warning)  the per-device expansion slice is too thin
+                           to keep shards busy (straggler regime)
+PLAN-INT32-OVERFLOW        event indices / sort keys / level counters
+(error)                    leave int32 for this op count
+PLAN-CRASH-WIDTH (error)   crashed ops exceed the crashed-bitmask
+                           capacity (CRASH_MAX)
+PLAN-WINDOW (error)        a pinned window above MAX_WINDOW
+PLAN-WINDOW-UNBOUNDED      the needed window exceeds MAX_WINDOW:
+(warning)                  refutation is impossible at any rung
+PLAN-TRACE (error)         a bucket fails ``jax.eval_shape`` abstract
+                           evaluation (shape bug in the kernel/search)
+PLAN-EXPAND-CLAMPED        expand exceeds capacity (the search clamps)
+(note)
+PLAN-SEEDED (note)         the supervised search will seed this rung's
+                           pool below its maximum to fit the budget
+=========================  ==========================================
+
+The ``plan`` lint pass (``python -m jepsen_tpu lint --pass plan``, and
+part of the default repo lint) runs the engine over the **pinned
+fixture matrix** below — every integer-kernel model family at
+representative history dims — so a kernel- or search-shape regression
+that breaks a bucket fails lint/CI in seconds instead of on device.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from jepsen_tpu.analysis import ERROR, Finding, summarize
+
+#: Pinned plan fixture matrix: (label, model-ctor-name, dims kwargs).
+#: One row per integer-kernel model family, labeled with the suites
+#: that exercise it (registry: jepsen_tpu/suites/__init__.py —
+#: cas-register backs localkv/etcd/consul/zookeeper/cockroachdb/
+#: aerospike/mongodb registers; mutex backs rabbitmq-mutex/hazelcast;
+#: set backs the *-set(s) workloads; the queues back rabbitmq/disque;
+#: noop is the smoke floor), at representative dims: the tutorial
+#: scale, the 10k-op flagship, a crash-heavy shape, and a wide
+#: (multi-word-window) shape.
+PLAN_MATRIX = (
+    ("localkv-small", "cas-register",
+     dict(n_required=150, n_crashed=3, window_needed=5)),
+    ("register-10k-flagship", "cas-register",
+     dict(n_required=10000, n_crashed=20, window_needed=10)),
+    ("register-crashy", "cas-register",
+     dict(n_required=500, n_crashed=96, window_needed=8)),
+    ("register-wide-100", "cas-register",
+     dict(n_required=400, n_crashed=0, window_needed=100)),
+    ("mutex-suite", "mutex",
+     dict(n_required=600, n_crashed=4, window_needed=6)),
+    ("set-suite", "set",
+     dict(n_required=2000, n_crashed=8, window_needed=16)),
+    ("unordered-queue-suite", "unordered-queue",
+     dict(n_required=800, n_crashed=8, window_needed=12)),
+    ("fifo-queue-suite", "fifo-queue",
+     dict(n_required=800, n_crashed=8, window_needed=12)),
+    ("noop-smoke", "noop",
+     dict(n_required=64, n_crashed=0, window_needed=2)),
+)
+
+
+class PlanRejectedError(Exception):
+    """The pre-search plan gate rejected every candidate plan — raised
+    BEFORE any jit factory is invoked, any XLA compile starts, or any
+    byte ships to a device (the plan-level sibling of
+    ``MalformedHistoryError``). Kill switch: JTPU_PLAN_GATE=0."""
+
+    def __init__(self, message: str,
+                 findings: Optional[List[Finding]] = None,
+                 report: Optional[Dict[str, Any]] = None):
+        self.findings = findings or []
+        self.report = report or {}
+        counts = summarize(self.findings)
+        if counts:
+            message += " (" + " ".join(f"{r}={n}"
+                                       for r, n in counts.items()) + ")"
+        super().__init__(message)
+
+
+def findings_from_report(report: Dict[str, Any],
+                         path: str = "<plan>") -> List[Finding]:
+    """Lift a plan report's issues into Findings. The anchor is
+    structural — (candidate label | dims) / rule — so baselines and
+    SARIF fingerprints survive unrelated dims drift."""
+    out: List[Finding] = []
+    seen = set()
+    for i in report.get("issues", []):
+        label = i.get("label") or "dims"
+        key = (i["rule"], label, i["message"])
+        if key in seen:          # dims issues repeat per candidate row
+            continue
+        seen.add(key)
+        out.append(Finding(
+            rule=i["rule"], severity=i["severity"], path=path, line=0,
+            message=(f"{label}: {i['message']}" if label != "dims"
+                     else i["message"]),
+            anchor=f"{label}/{i['rule']}"))
+    return out
+
+
+def _model_registry() -> Dict[str, Any]:
+    from jepsen_tpu.models import (CASRegister, FIFOQueue, Mutex, NoOp,
+                                   SetModel, UnorderedQueue)
+    return {"cas-register": CASRegister, "mutex": Mutex, "set": SetModel,
+            "unordered-queue": UnorderedQueue, "fifo-queue": FIFOQueue,
+            "noop": NoOp}
+
+
+def lint_matrix(trace: bool = False,
+                mesh_axis: Optional[int] = None) -> List[Finding]:
+    """Run the plan engine over the pinned fixture matrix and return
+    the findings. ``trace=False`` (the default repo-lint path) is pure
+    arithmetic — milliseconds; ``trace=True`` (CI via
+    ``tools/lint_gate.py``) additionally abstract-evaluates every
+    bucket with ``jax.eval_shape``, still with zero XLA compiles."""
+    from jepsen_tpu.checker import plan as plan_mod
+    from jepsen_tpu.models.core import kernel_spec_for
+    models = _model_registry()
+    out: List[Finding] = []
+    for label, model_name, dkw in PLAN_MATRIX:
+        model = models[model_name]()
+        kernel = kernel_spec_for(model)
+        dims = plan_mod.PlanDims(**dkw)
+        report = plan_mod.analyze(dims, kernel=kernel, trace=trace,
+                                  mesh_axis=mesh_axis)
+        out.extend(findings_from_report(report,
+                                        path=f"plan:{label}"))
+        if report["selected"] is None:
+            out.append(Finding(
+                rule="PLAN-NO-VALID-CANDIDATE", severity=ERROR,
+                path=f"plan:{label}", line=0,
+                message=(f"no candidate plan survives for "
+                         f"{model_name} at {dkw}"),
+                anchor=f"{label}/PLAN-NO-VALID-CANDIDATE"))
+    return out

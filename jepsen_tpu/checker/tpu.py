@@ -1211,6 +1211,19 @@ def check_packed_tpu(p: PackedHistory, kernel: KernelSpec,
         ladder = ((capacity, window or WINDOW, expand),)
     else:
         ladder = _ladder_for(_window_needed(p))
+    # Mandatory pre-search plan gate (doc/plan.md), next to the PR-3
+    # history gate: prove every rung fits the byte budget and encodes
+    # inside int32 BEFORE any jit factory is touched. Invalid rungs are
+    # filtered (recorded in the result's "plan" entry, cheapest valid
+    # rung first); a fully-rejected ladder raises PlanRejectedError.
+    # Kill switch: JTPU_PLAN_GATE=0.
+    from jepsen_tpu.checker import plan as plan_mod
+    plan_entry = None
+    if plan_mod.gate_enabled():
+        ladder, plan_entry = plan_mod.gate_ladder(
+            p, kernel, ladder, kind="single",
+            explicit=capacity is not None,
+            where="the monolithic device search")
     out: Dict[str, Any] = {}
     work: list = []
     cost_entries: list = []
@@ -1236,6 +1249,8 @@ def check_packed_tpu(p: PackedHistory, kernel: KernelSpec,
         work.append(((cap, win, exp), out["crash-width"], "lex",
                      int(levels)))
         out["work"] = list(work)
+        if plan_entry is not None:
+            out["plan"] = plan_entry
         if obs.enabled():
             cost = _shape_cost(shape_key, fn, [cols[c] for c in _COLS])
             if cost:
@@ -1253,6 +1268,17 @@ def check_packed_tpu(p: PackedHistory, kernel: KernelSpec,
 
 #: Mesh axis name for pool-sharded single-history searches.
 POOL_AXIS = "pool"
+
+
+def _mesh_context(mesh):
+    """Activate a mesh for tracing/execution: ``jax.set_mesh`` where
+    this jax has it, else the legacy ``Mesh.__enter__`` global-mesh
+    context (pre-0.5 jax) — same semantics for the sharding
+    constraints the search body carries."""
+    setm = getattr(jax, "set_mesh", None)
+    if setm is not None:
+        return setm(mesh)
+    return mesh
 
 
 def _shard_balance(pool, naxis: int) -> Optional[Dict[str, Any]]:
@@ -1312,16 +1338,25 @@ def check_packed_sharded(p: PackedHistory, kernel: KernelSpec,
         # search exists to go big, so best-first is the sane default)
         per = max(1, capacity // 8)
         expand = max(naxis, -(-per // naxis) * naxis)
+    if window is None:
+        window = _window_bucket(_window_needed(p))
+    _check_window(window)
+    # Pre-search plan gate: divisibility, per-shard skew, footprint and
+    # int32 bounds verified BEFORE the jit factory (PLAN-SHARD-* /
+    # PLAN-OOM findings instead of a ValueError mid-compile). The
+    # legacy ValueError below stays as the JTPU_PLAN_GATE=0 fallback.
+    from jepsen_tpu.checker import plan as plan_mod
+    plan_entry = None
+    if plan_mod.gate_enabled():
+        plan_entry = plan_mod.gate_sharded(p, kernel, naxis, capacity,
+                                           window, expand)
     if capacity % naxis or expand % naxis:
         raise ValueError(
             f"the mesh axis ({naxis}) must divide capacity "
             f"({capacity}) and expand ({expand})")
-    if window is None:
-        window = _window_bucket(_window_needed(p))
-    _check_window(window)
     fn = _jit_single(_kernel_key(kernel), capacity, window, expand,
                      _unroll_factor(), POOL_AXIS)
-    with jax.set_mesh(mesh):
+    with _mesh_context(mesh):
         shape_key = ("sharded", _kernel_key(kernel), capacity, window,
                      expand, naxis, cols["f"].shape[0],
                      cols["cf"].shape[0])
@@ -1363,6 +1398,8 @@ def check_packed_sharded(p: PackedHistory, kernel: KernelSpec,
                     unroll=_unroll_factor(), levels=int(levels),
                     axis=naxis, **cost)]
     out["pool-sharding"] = f"{POOL_AXIS}={naxis}"
+    if plan_entry is not None:
+        out["plan"] = plan_entry
     return out
 
 
@@ -1590,6 +1627,25 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
                   + ((512, 64, 512), (4096, 128, 1024),
                      (16384, 128, 4096)))
 
+    # Pre-search plan gate over the batch's escalation schedule: dims
+    # aggregate over the keys (widest required section, crashiest key,
+    # widest needed window, K-fold footprint); rungs that cannot fit or
+    # encode are filtered before any batch executable is built, and the
+    # rejections land in the result's "plan" entry.
+    from jepsen_tpu.checker import plan as plan_mod
+    plan_entry = None
+    if rows and plan_mod.gate_enabled():
+        dims = plan_mod.PlanDims(
+            n_required=max(packed[r[0]].n_required for r in rows),
+            n_crashed=max(packed[r[0]].n - packed[r[0]].n_required
+                          for r in rows),
+            window_needed=max(r[2] for r in rows),
+            keys=len(rows))
+        ladder, plan_entry = plan_mod.gate_ladder(
+            dims, kernel, ladder, kind="batch",
+            explicit=capacity is not None, keys=len(rows),
+            where="the keyed device search")
+
     # First rung: hash tie-break (diversified beam — measured 2.4x on
     # dense key batches; a bad draw just escalates). Later rungs use the
     # deterministic lex order, as do single-rung ladders (where a lossy
@@ -1777,6 +1833,8 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
         if r["valid"] is UNKNOWN:
             valid = UNKNOWN
     out = {"valid": valid, "results": results, "backend": "tpu"}
+    if plan_entry is not None:
+        out["plan"] = plan_entry
     if cost_entries:
         # one entry per batch executable actually launched (keys share
         # it), at the TOP level — attaching the batch cost to every key

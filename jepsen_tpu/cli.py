@@ -332,6 +332,13 @@ def analyze_cmd() -> dict:
         from jepsen_tpu.analysis.history_lint import lint_history
         print(analysis.summary_line(
             lint_history(test.get("history") or [])))
+        # And the search-plan forecast next to it (doc/plan.md): the
+        # candidate universe, the cheapest valid rung, and its
+        # predicted footprint — so an offline re-check that would be
+        # rejected or derated is diagnosed before the search starts.
+        from jepsen_tpu.checker import plan as plan_mod
+        print(plan_mod.summary_line(test.get("history") or [],
+                                    models[opts["model"]]()))
         checker = linearizable(models[opts["model"]](),
                                backend=opts["backend"],
                                algorithm=opts["algorithm"])
@@ -448,6 +455,9 @@ def recover_cmd() -> dict:
             # gate here is about STRUCTURE the reconciler couldn't fix.
             findings = hl.lint_history(rec["history"], decode_errors=0)
             print(analysis.summary_line(findings))
+            from jepsen_tpu.checker import plan as plan_mod
+            print(plan_mod.summary_line(rec["history"],
+                                        models[opts["model"]]()))
             errs = hl.errors(findings)
             if errs:
                 for f in errs[:10]:
@@ -674,7 +684,9 @@ def lint_cmd() -> dict:
                        help="exit nonzero on new warnings too, not "
                             "just errors")
         p.add_argument("--format", default="text",
-                       choices=["text", "json"])
+                       choices=["text", "json", "sarif"],
+                       help="sarif: SARIF 2.1.0 of the NEW findings, "
+                            "for forge PR annotation (doc/lint.md)")
         p.add_argument("--root", default=None,
                        help="repo root override (fixtures/tests)")
         return p
@@ -709,6 +721,9 @@ def lint_cmd() -> dict:
                 "accepted": [vars(f) for f in accepted],
                 "counts": analysis.summarize(new),
             }, indent=2))
+        elif opts["format"] == "sarif":
+            from jepsen_tpu.analysis import sarif
+            print(sarif.render(new), end="")
         else:
             for f in sorted(new, key=lambda x: (x.path, x.line)):
                 print(f.format())
@@ -722,6 +737,159 @@ def lint_cmd() -> dict:
         return TEST_FAILED if gate else OK
 
     return {"lint": {"parser": build_parser, "run": run_}}
+
+
+def plan_cmd() -> dict:
+    """The 'plan' subcommand: the ahead-of-time search-plan verifier
+    (jepsen_tpu.checker.plan, doc/plan.md). Given a history artifact or
+    bare dimensions, it enumerates the shape-bucket universe the device
+    search would compile, abstract-evaluates every bucket with
+    ``jax.eval_shape`` (zero XLA compiles, zero device executions),
+    predicts the per-rung memory footprint and per-level cost, and
+    verifies mesh divisibility and int32 encoding bounds — exiting
+    nonzero on any error-severity PLAN-* finding, so admission control
+    can be a shell one-liner."""
+
+    def build_parser():
+        p = Parser(prog="plan",
+                   description="Verify a search plan ahead of any "
+                               "device time: shape, memory, sharding "
+                               "and bit-width safety.")
+        p.add_argument("--history", default=None, metavar="FILE",
+                       help="derive dims from a history artifact "
+                            "(.jsonl)")
+        p.add_argument("--dims", default=None, metavar="SPEC",
+                       help="dims without a history: 'N_REQUIRED"
+                            "[,N_CRASHED[,WINDOW_NEEDED[,N_EVENTS]]]' "
+                            "or @file.json (keys: n_required, "
+                            "n_crashed, window_needed, n_events, keys, "
+                            "capacity, window, expand, mesh, "
+                            "bytes_limit)")
+        p.add_argument("--model", default="cas-register",
+                       choices=list(MODEL_CHOICES))
+        p.add_argument("--keys", type=int, default=1,
+                       help="verify the keyed-batch plan for this many "
+                            "independent keys")
+        p.add_argument("--mesh", type=int, default=None, metavar="N",
+                       help="additionally verify the pool-sharded plan "
+                            "over a mesh axis of N devices")
+        p.add_argument("--capacity", type=int, default=None,
+                       help="pin the rung instead of the auto ladder")
+        p.add_argument("--window", type=int, default=None)
+        p.add_argument("--expand", type=int, default=None)
+        p.add_argument("--bytes-limit", type=int, default=None,
+                       help="byte budget override (default: "
+                            "JTPU_PLAN_BYTES_LIMIT, else the smallest "
+                            "device allocator limit, else unchecked)")
+        p.add_argument("--no-trace", action="store_true",
+                       help="skip jax.eval_shape abstract evaluation "
+                            "(arithmetic checks only; no jax needed)")
+        p.add_argument("--no-cost", action="store_true",
+                       help="skip the lower()-only XLA cost analysis")
+        p.add_argument("--format", default="text",
+                       choices=["text", "json", "sarif"])
+        return p
+
+    def run_(opts) -> int:
+        import json as _json
+
+        from jepsen_tpu.checker import plan as plan_mod
+        from jepsen_tpu.models.core import kernel_spec_for
+        model = _model_registry()[opts["model"]]()
+        kernel = kernel_spec_for(model)
+        if opts.get("history"):
+            import os as _os
+            if not _os.path.exists(opts["history"]):
+                print(f"no such history file: {opts['history']}",
+                      file=sys.stderr)
+                return INVALID_ARGS
+            from jepsen_tpu.history import History
+            with open(opts["history"], encoding="utf-8") as f:
+                h = History.from_jsonl(f.read())
+            dims = plan_mod.PlanDims.from_history(h, model)
+            if dims is None:
+                print(f"model {opts['model']} has no integer kernel; "
+                      f"nothing to plan", file=sys.stderr)
+                return INVALID_ARGS
+        elif opts.get("dims"):
+            spec = opts["dims"]
+            if spec.startswith("@"):
+                with open(spec[1:], encoding="utf-8") as f:
+                    d = _json.load(f)
+                dims = plan_mod.PlanDims(
+                    n_required=int(d["n_required"]),
+                    n_crashed=int(d.get("n_crashed", 0)),
+                    window_needed=int(d.get("window_needed", 1)),
+                    n_events=(int(d["n_events"])
+                              if d.get("n_events") is not None else None),
+                    keys=int(d.get("keys", opts.get("keys") or 1)))
+                # the fixture may pin shape knobs the flags didn't
+                for knob in ("capacity", "window", "expand", "mesh",
+                             "bytes_limit"):
+                    if opts.get(knob) is None and d.get(knob) is not None:
+                        opts[knob] = int(d[knob])
+            else:
+                try:
+                    parts = [int(x) for x in spec.split(",")]
+                except ValueError:
+                    print(f"--dims {spec!r}: expected comma-separated "
+                          f"integers or @file.json", file=sys.stderr)
+                    return INVALID_ARGS
+                if not parts or len(parts) > 4:
+                    print(f"--dims {spec!r}: 1-4 integers", file=sys.stderr)
+                    return INVALID_ARGS
+                dims = plan_mod.PlanDims(*parts,
+                                         keys=opts.get("keys") or 1)
+        else:
+            print("pass --history FILE or --dims SPEC", file=sys.stderr)
+            return INVALID_ARGS
+        if (opts.get("keys") or 1) > 1 and dims.keys == 1:
+            dims = plan_mod.PlanDims(dims.n_required, dims.n_crashed,
+                                     dims.window_needed, dims.n_events,
+                                     keys=opts["keys"])
+        report = plan_mod.analyze(
+            dims, kernel=kernel,
+            capacity=opts.get("capacity"), window=opts.get("window"),
+            expand=opts.get("expand"), mesh_axis=opts.get("mesh"),
+            bytes_limit=opts.get("bytes_limit"),
+            trace=not opts.get("no_trace"),
+            cost=not opts.get("no_cost") and not opts.get("no_trace"))
+        errors = [i for i in report["issues"]
+                  if i["severity"] == "error"]
+        if opts["format"] == "json":
+            print(_json.dumps(report, indent=2))
+        elif opts["format"] == "sarif":
+            from jepsen_tpu.analysis import plan_lint, sarif
+            print(sarif.render(
+                plan_lint.findings_from_report(report)), end="")
+        else:
+            d = report["dims"]
+            lim = report["bytes-limit"]
+            print(f"# plan: dims n={d['n-required']}+{d['n-crashed']} "
+                  f"window<={d['window-needed']} keys={d['keys']}, "
+                  f"limit "
+                  f"{'unchecked' if lim is None else f'{lim} B'}")
+            for i in report["issues"]:
+                if not i.get("label"):   # dims-level, not per-candidate
+                    print(f"# plan: {i['severity'].upper()} "
+                          f"[{i['rule']}] {i['message']}")
+            for c in report["candidates"]:
+                mark = "ok " if c["status"] == "ok" else "REJ"
+                fp = c["footprint"]["total-bytes"]
+                line = (f"# plan: {mark} {c['label']:<36} "
+                        f"{fp / 1e6:9.3f} MB")
+                if c.get("cost"):
+                    line += (f" {c['cost']['flops'] / 1e6:10.2f} "
+                             f"MFLOP/level")
+                rules = sorted({i["rule"] for i in c["issues"]})
+                if rules:
+                    line += "  " + " ".join(rules)
+                print(line)
+            print(f"# plan: selected {report['selected'] or 'NONE'}; "
+                  f"{len(errors)} error finding(s)")
+        return TEST_FAILED if errors else OK
+
+    return {"plan": {"parser": build_parser, "run": run_}}
 
 
 def merge_commands(*cmds: dict) -> dict:
@@ -772,11 +940,11 @@ def main(subcommands: Dict[str, dict],
 
 def default_commands() -> dict:
     """The stock subcommand set: runner + analyzer + recovery + linter
-    + trace tooling + live watch + server (what ``python -m
-    jepsen_tpu`` dispatches)."""
+    + plan verifier + trace tooling + live watch + server (what
+    ``python -m jepsen_tpu`` dispatches)."""
     return merge_commands(suite_run_cmd(), analyze_cmd(), recover_cmd(),
-                          lint_cmd(), trace_cmd(), watch_cmd(),
-                          serve_cmd())
+                          lint_cmd(), plan_cmd(), trace_cmd(),
+                          watch_cmd(), serve_cmd())
 
 
 if __name__ == "__main__":  # default main

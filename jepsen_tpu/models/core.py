@@ -396,7 +396,10 @@ def _mutex_step(state, f, v1, v2):
 
 
 def _noop_step(state, f, v1, v2):
-    return state, (f == f)  # always ok, shape-matching
+    # state must broadcast to the op grid's shape like every other
+    # kernel's (the search sorts state next to per-candidate columns;
+    # found by the plan verifier's eval_shape matrix — PLAN-TRACE)
+    return state + f * 0, (f == f)
 
 
 # --- grow-only set: state = presence bitmask over <= 31 interned ids -------

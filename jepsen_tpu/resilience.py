@@ -421,6 +421,19 @@ def _supervised_check_packed(p: PackedHistory, kernel: KernelSpec,
         ladder = ((capacity, window or T.WINDOW, expand),)
     else:
         ladder = T._ladder_for(T._window_needed(p))
+    # Mandatory pre-search plan gate (doc/plan.md): an explicit rung
+    # that cannot fit/shard/encode is rejected BEFORE any compilation;
+    # auto-ladder rungs whose only problem is footprint stay in — the
+    # seeding below starts their pool at the largest size the predicted
+    # footprint says fits, instead of always starting at the rung max
+    # and OOM-halving reactively. Kill switch: JTPU_PLAN_GATE=0.
+    from jepsen_tpu.checker import plan as plan_mod
+    plan_entry = None
+    if plan_mod.gate_enabled():
+        ladder, plan_entry = plan_mod.gate_ladder(
+            p, kernel, ladder, kind="segment",
+            explicit=capacity is not None, derate=capacity is None,
+            where="the supervised device search")
     crw = T._crash_width(p.n - p.n_required) or 0
     cr_pad = cols["cf"].shape[0]
     lmax = T._level_budget(cols["f"].shape[0], cr_pad)
@@ -465,9 +478,31 @@ def _supervised_check_packed(p: PackedHistory, kernel: KernelSpec,
             seg_idx = resume.segment
             resume = None
         else:
-            carry = T._carry0_host(cap, win, cr_pad, cols["ini"],
-                                   int(cols["nr"]))
             cap_eff, exp_eff, seg_idx = cap, exp, 0
+            if plan_entry is not None:
+                # Footprint-seeded pool: start at the largest halving of
+                # the rung whose predicted working set fits the byte
+                # budget (JTPU_PLAN_BYTES_LIMIT / device bytes-limit) —
+                # the ahead-of-time twin of the reactive OOM halving.
+                # No-op when no limit is known (CPU) or the rung fits.
+                cap_s, exp_s, pred, blim = plan_mod.seed_rung(
+                    cap, win, exp, breq=cols["f"].shape[0], crw=cr_pad,
+                    floor=policy.min_capacity)
+                if cap_s != cap_eff:
+                    trail.append({"rung": (cap, win, exp),
+                                  "effective": (cap_s, win, exp_s),
+                                  "segment": 0, "level": 0,
+                                  "event": "plan",
+                                  "outcome": f"plan-seeded-pool-{cap_s}",
+                                  "predicted-bytes": pred,
+                                  "bytes-limit": blim})
+                    log.warning(
+                        "predicted footprint at %s rows exceeds the "
+                        "%s B byte budget; seeding the pool at %s "
+                        "rows (predicted %s B)", cap, blim, cap_s, pred)
+                    cap_eff, exp_eff = cap_s, exp_s
+            carry = T._carry0_host(cap_eff, win, cr_pad, cols["ini"],
+                                   int(cols["nr"]))
         transients = ooms = 0
         preempted = False
         abort: Optional[str] = None
@@ -707,6 +742,8 @@ def _supervised_check_packed(p: PackedHistory, kernel: KernelSpec,
         out["tiebreak"] = "lex"
         work.append((rung_eff, crw, "lex", levels))
         out["work"] = list(work)
+        if plan_entry is not None:
+            out["plan"] = plan_entry
         out["segments"] = seg_idx
         out["segment-iters"] = seg
         out["attempts"] = list(trail)

@@ -40,6 +40,11 @@ def pytest_configure(config):
         "(tests/test_obs.py): span tracer, metrics registry, "
         "Prometheus/Chrome exports, run artifacts, and the "
         "JTPU_TRACE kill switch")
+    config.addinivalue_line(
+        "markers", "plan: search-plan verifier tests "
+        "(tests/test_plan.py): bucket enumeration, zero-compile "
+        "abstract evaluation, footprint math, the pre-search plan "
+        "gate, and the JTPU_PLAN_GATE kill switch")
 
 
 def pytest_collection_modifyitems(config, items):

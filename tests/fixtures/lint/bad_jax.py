@@ -8,6 +8,14 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from jepsen_tpu.ops.encode import RET_INF
+
+#: module-level named width: a shift routed through it must NOT escape
+#: the JAX-SHIFT-WIDTH rule (named-constant folding)
+WIDE_SHIFT = 8 * 5
+#: named constant past int32 via constant arithmetic
+TOO_BIG = (1 << 31) + 7
+
 
 @functools.lru_cache(maxsize=8)
 def _jit_thing(kernel_id, capacity, window):
@@ -51,3 +59,14 @@ def pack(v):
     # JAX-SHIFT-WIDTH: a 32-bit lane shifts modulo 32 on device
     lo = v << 33
     return hi, lo
+
+
+def pack_named(v):
+    # JAX-SHIFT-WIDTH through a module-level named width (WIDE_SHIFT=40)
+    lo = v << WIDE_SHIFT
+    # JAX-INT32-OVERFLOW through a named constant built by arithmetic
+    hi = np.int32(TOO_BIG)
+    # JAX-INT32-OVERFLOW through a width IMPORTED from ops/encode.py:
+    # RET_INF + 1 == 2**31 leaves int32
+    inf = np.int32(RET_INF + 1)
+    return lo, hi, inf

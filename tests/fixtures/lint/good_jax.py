@@ -34,7 +34,18 @@ def launch(xs):
     return fn(xs)
 
 
+#: module-level width, shadowed locally below — the folding must respect
+#: function scope and stay silent
+SHIFT = 8 * 5
+
+
 def pack(v):
     hi = np.int32(2 ** 31 - 1)
     lo = v << 31
     return hi, lo
+
+
+def pack_shadowed(v, n):
+    # the local SHIFT (< 32) shadows the module's 40: no finding
+    SHIFT = n & 7
+    return v << SHIFT

@@ -213,7 +213,96 @@ class TestJaxLint:
 
     def test_good_jax_fixture_is_clean(self):
         # trace-time numpy in a host-side builder is idiom, not hazard
+        # (including the locally-shadowed module width in pack_shadowed)
         assert _lint("good_jax.py") == []
+
+    def test_named_constant_folding(self):
+        # the overflow/shift rules fold module-level named constants,
+        # not just literals (pack_named in the bad fixture)
+        fs = [f for f in _lint("bad_jax.py") if "pack_named" in f.anchor]
+        assert {f.rule for f in fs} == {"JAX-SHIFT-WIDTH",
+                                        "JAX-INT32-OVERFLOW"}
+        assert len([f for f in fs
+                    if f.rule == "JAX-INT32-OVERFLOW"]) == 2
+
+    def test_imported_constant_resolves_through_repo_module(self):
+        # RET_INF comes from jepsen_tpu/ops/encode.py: the width chain
+        # crosses a module boundary and still folds
+        fs = [f for f in _lint("bad_jax.py")
+              if f.rule == "JAX-INT32-OVERFLOW"
+              and "2147483648" in f.message]
+        assert fs, "np.int32(RET_INF + 1) must fold via the import"
+
+    def test_shadowed_name_does_not_fold(self):
+        from jepsen_tpu.analysis import jax_lint
+        import ast
+        tree = ast.parse(
+            "W = 40\n"
+            "def f(v, n):\n"
+            "    W = n & 7\n"
+            "    return v << W\n"
+            "def g(v):\n"
+            "    return v << W\n")
+        shadows = jax_lint._shadow_sets(tree)
+        env = jax_lint._module_env(tree, None)
+        assert env == {"W": 40}
+        shifts = [n for n in ast.walk(tree)
+                  if isinstance(n, ast.BinOp)
+                  and isinstance(n.op, ast.LShift)]
+        shadowed = [n for n in shifts
+                    if "W" in shadows.get(id(n), ())]
+        assert len(shadowed) == 1  # f's shift only; g's folds to 40
+
+
+# ---------------------------------------------------------------------------
+# SARIF export (shared findings core)
+# ---------------------------------------------------------------------------
+
+class TestSarif:
+    def test_sarif_document_shape(self):
+        from jepsen_tpu.analysis import sarif
+        fs = _lint("bad_jax.py")
+        assert fs
+        doc = sarif.to_sarif(fs)
+        assert doc["version"] == "2.1.0"
+        run = doc["runs"][0]
+        rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+        assert rule_ids == {f.rule for f in fs}
+        assert len(run["results"]) == len(fs)
+        r0 = run["results"][0]
+        assert r0["level"] in ("error", "warning", "note")
+        loc = r0["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith("bad_jax.py")
+        assert loc["region"]["startLine"] >= 1
+
+    def test_sarif_fingerprints_are_baseline_anchors(self):
+        from jepsen_tpu.analysis import sarif
+        fs = _lint("bad_jax.py")
+        doc = sarif.to_sarif(fs)
+        prints = [r["partialFingerprints"]["jtpuAnchor/v1"]
+                  for r in doc["runs"][0]["results"]]
+        assert sorted(prints) == sorted(f.anchor for f in fs)
+
+    def test_sarif_render_round_trips(self):
+        from jepsen_tpu.analysis import sarif
+        text = sarif.render(_lint("bad_lockset.py"))
+        doc = json.loads(text)
+        assert doc["$schema"].endswith("sarif-schema-2.1.0.json")
+
+    def test_lint_gate_sarif_flag(self, tmp_path):
+        import subprocess
+        import sys as _sys
+        out = tmp_path / "lint.sarif"
+        r = subprocess.run(
+            [_sys.executable, os.path.join(REPO, "tools",
+                                           "lint_gate.py"),
+             "--sarif", str(out), "--no-plan"],
+            capture_output=True, text=True, timeout=300,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert r.returncode == 0, r.stdout + r.stderr
+        doc = json.loads(out.read_text())
+        assert doc["version"] == "2.1.0"
+        assert doc["runs"][0]["results"] == []  # clean vs baseline
 
 
 # ---------------------------------------------------------------------------

@@ -409,6 +409,10 @@ class TestDevices:
         devs = [_FakeDev({"bytes_in_use": 990, "bytes_limit": 1000})]
         monkeypatch.setattr(obs_devices, "_devices", lambda: devs)
         monkeypatch.setenv("JTPU_HEADROOM_MIN", "0.05")
+        # this test targets the REACTIVE halving path; the ahead-of-time
+        # plan gate (doc/plan.md) would reject this synthetic 1 kB
+        # device before the reactive machinery could ever be exercised
+        monkeypatch.setenv("JTPU_PLAN_GATE", "0")
         h = simulate_register_history(150, n_procs=5, n_vals=4, seed=3)
         p, kernel = pack_with_init(h, CASRegister())
         r = supervised_check_packed(p, kernel, capacity=64, expand=8,

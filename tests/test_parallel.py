@@ -122,8 +122,9 @@ class TestPoolSharded:
         from jepsen_tpu.checker.tpu import POOL_AXIS
         assert r["pool-sharding"] == f"pool={mesh.shape[POOL_AXIS]}"
 
-    def test_divisibility_enforced(self):
+    def test_divisibility_enforced(self, monkeypatch):
         import pytest as _pytest
+        from jepsen_tpu.analysis.plan_lint import PlanRejectedError
         from jepsen_tpu.checker.tpu import POOL_AXIS, check_history_sharded
         from jepsen_tpu.history import History, Op
         h = History.of([Op(type="invoke", f="write", value=1, process=0,
@@ -135,7 +136,14 @@ class TestPoolSharded:
         if naxis == 1:
             _pytest.skip("1-device mesh: every capacity divides")
         # a capacity the mesh axis provably cannot divide, whatever the
-        # ambient device count
+        # ambient device count. The plan gate rejects it with a rule id
+        # before any jit work (doc/plan.md)...
+        with _pytest.raises(PlanRejectedError,
+                            match="PLAN-SHARD-INDIVISIBLE"):
+            check_history_sharded(h, CASRegister(), mesh,
+                                  capacity=8 * naxis + 1)
+        # ...and the legacy ValueError still guards the ungated path.
+        monkeypatch.setenv("JTPU_PLAN_GATE", "0")
         with _pytest.raises(ValueError, match="divide"):
             check_history_sharded(h, CASRegister(), mesh,
                                   capacity=8 * naxis + 1)

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""CI lint gate: run the four-pass static analyzer over the repo and
+"""CI lint gate: run the five-pass static analyzer over the repo and
 exit nonzero on any finding not covered by the committed baseline.
 
 Stricter than ``python -m jepsen_tpu lint`` (whose exit code gates on
@@ -7,16 +7,31 @@ new *errors* only): CI should not accumulate new warnings silently
 either — either fix them or accept them into ``lint.baseline`` with a
 one-line justification.
 
+On top of the repo scan this gate runs the **traced plan fixture
+matrix** (``jepsen_tpu.analysis.plan_lint.PLAN_MATRIX``): every
+integer-kernel model family at representative history dims, each shape
+bucket abstract-evaluated with ``jax.eval_shape`` — so a kernel- or
+search-shape regression that would break a bucket fails CI in seconds,
+on CPU, with zero XLA compiles, instead of failing on device minutes
+into a run. ``--no-plan`` skips the traced matrix (the arithmetic
+matrix still runs inside the repo scan).
+
 Usage: python tools/lint_gate.py [--baseline FILE] [--root DIR]
+                                 [--sarif OUT] [--no-plan]
 Exit code 0 iff the tree is clean against the baseline.
+``--sarif OUT`` additionally writes the new findings as SARIF 2.1.0
+(doc/lint.md) so CI can annotate the pull request inline.
 """
 
 import argparse
 import os
 import sys
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 from jepsen_tpu import analysis  # noqa: E402
 from jepsen_tpu.analysis import baseline as bl  # noqa: E402
@@ -28,11 +43,29 @@ def main() -> int:
                     help="baseline file (default: lint.baseline at the "
                          "repo root)")
     ap.add_argument("--root", default=None, help="repo root override")
+    ap.add_argument("--sarif", default=None, metavar="OUT",
+                    help="also write the new findings as SARIF 2.1.0 "
+                         "(forge PR annotation)")
+    ap.add_argument("--no-plan", action="store_true",
+                    help="skip the traced plan fixture matrix (the "
+                         "arithmetic plan pass still runs)")
     args = ap.parse_args()
 
     root = args.root or REPO
     bpath = args.baseline or bl.default_path(root)
     findings = analysis.lint_repo(root=root)
+    if not args.no_plan:
+        # Upgrade the repo scan's arithmetic plan rows to the traced
+        # variant: every bucket in the pinned matrix must still
+        # abstract-evaluate (jax.eval_shape; zero compiles).
+        from jepsen_tpu.analysis import plan_lint
+        t0 = time.time()
+        traced = plan_lint.lint_matrix(trace=True)
+        findings = ([f for f in findings if not f.path.startswith("plan:")]
+                    + traced)
+        print(f"# lint-gate: plan matrix traced "
+              f"({len(plan_lint.PLAN_MATRIX)} row(s) in "
+              f"{time.time() - t0:.1f}s, zero XLA compiles)")
     accepted_keys = bl.load(bpath)
     new, accepted = bl.split(findings, accepted_keys)
 
@@ -50,6 +83,11 @@ def main() -> int:
     if accepted:
         print(f"# lint-gate: {len(accepted)} finding(s) accepted by "
               f"{bpath}")
+    if args.sarif:
+        from jepsen_tpu.analysis import sarif
+        sarif.write(args.sarif, new)
+        print(f"# lint-gate: wrote SARIF ({len(new)} new finding(s)) "
+              f"to {args.sarif}")
     if new:
         print(f"# lint-gate: FAILED — {len(new)} new finding(s) not in "
               f"the baseline; fix them or accept them with a "
