@@ -103,18 +103,31 @@ def child_main(platform: str) -> int:
     # concurrency) shape; with a populated persistent cache even that
     # compile is skipped — the orchestrator runs a second cold child to
     # record the cached-cold number.
+    from jepsen_tpu.checker.tpu import (compile_delta, compile_line,
+                                        compile_snapshot,
+                                        persistent_cache_dir)
+    comp0 = compile_snapshot()
     t0 = time.time()
     result = check_history_tpu(history, CASRegister())
     cold = time.time() - t0
+    cold_comp = compile_delta(comp0)
     print(f"# cold check (incl. compile): valid={result['valid']} "
           f"levels={result.get('levels')} in {cold:.2f}s", file=sys.stderr)
 
     # WARM: steady-state search time, compilation cached in-process.
+    comp1 = compile_snapshot()
     t0 = time.time()
     result2 = check_history_tpu(history, CASRegister())
     warm = time.time() - t0
+    warm_comp = compile_delta(comp1)
     print(f"# warm check: valid={result2['valid']} in {warm:.2f}s",
           file=sys.stderr)
+    # cold/warm wall-clock attribution (doc/observability.md "Compile
+    # accounting"): which share of each check was XLA compilation vs
+    # execution vs host work — the split the warm-executable-cache
+    # daemon (ROADMAP item 1) must drive to zero cold shapes.
+    print(compile_line(cold_comp, cold), file=sys.stderr)
+    print(compile_line(warm_comp, warm), file=sys.stderr)
 
     if result["valid"] is not True or result2["valid"] is not True:
         # A wrong or unknown verdict on a valid-by-construction history is
@@ -147,6 +160,21 @@ def child_main(platform: str) -> int:
         rec["compile_s"] = round(split.get("compile", 0.0), 3)
         rec["execute_s"] = round(split2.get("execute", 0.0)
                                  or split.get("execute", 0.0), 3)
+    # compile-cache attribution in the BENCH record (registry deltas
+    # around the cold and warm checks): bench_gate.py reads these to
+    # say WHICH phase moved when the trajectory regresses.
+    rec["compile"] = {
+        "cold_shapes": int(cold_comp["cold"]),
+        "cold_compile_s": round(cold_comp["compile-s"], 3),
+        "warm_cache_hits": int(warm_comp["cache-hits"]),
+        "warm_execute_s": round(warm_comp["execute-s"], 3),
+        "persistent_cache": persistent_cache_dir() is not None,
+        "persistent_hits": int(cold_comp["persistent-hits"]
+                               + warm_comp["persistent-hits"]),
+    }
+    rec["transfer_mb"] = round(
+        (cold_comp["transfer-bytes"] + warm_comp["transfer-bytes"])
+        / 1e6, 3)
     print(json.dumps(rec))
     sys.stdout.flush()
     _search_line("10k headline", result2, warm)
@@ -884,7 +912,8 @@ def main() -> int:
             break  # hard init hang: a retry would hang identically
         if rec is not None and rec.get("value") is not None:
             extras = {k: rec[k] for k in ("cold_s", "cold_vs_baseline",
-                                          "compile_s", "execute_s")
+                                          "compile_s", "execute_s",
+                                          "compile", "transfer_mb")
                       if k in rec}
             # Second cold child: same measurement in a FRESH process —
             # its cold_s shows whether the persistent compilation cache
@@ -920,7 +949,8 @@ def main() -> int:
         notes.append(note)
         if rec is not None and rec.get("value") is not None:
             extras = {k: rec[k] for k in ("cold_s", "cold_vs_baseline",
-                                          "compile_s", "execute_s")
+                                          "compile_s", "execute_s",
+                                          "compile", "transfer_mb")
                       if k in rec}
             emit(rec["value"], rec["vs_baseline"], platform="cpu",
                  note="tpu unavailable; cpu-backend fallback", **extras)

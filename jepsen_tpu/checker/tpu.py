@@ -57,6 +57,7 @@ from jepsen_tpu.checker import UNKNOWN
 from jepsen_tpu.history import History
 from jepsen_tpu.models.core import KernelSpec, Model, kernel_spec_for
 from jepsen_tpu.obs import metrics as obs_metrics
+from jepsen_tpu.obs import profiler as obs_profiler
 from jepsen_tpu.ops.encode import PackedHistory, RET_INF, pack_with_init
 
 try:  # JAX is a hard dependency of this module, soft for the package.
@@ -691,6 +692,132 @@ _SHARD_IMBALANCE = obs_metrics.gauge(
     "pool-sharded search straggler imbalance: max over shards of live "
     "frontier rows divided by the mean (1.0 = perfectly balanced)")
 
+# -- compile-cache accounting (doc/observability.md "Compile accounting"):
+# every executable shape's first call in this process is a COLD compile
+# (XLA compilation + one execution), every later call a cache hit of the
+# in-process jit cache. BENCH_r02's 271 s warm-up vs 8.85 s check is the
+# motivating ratio — the warm-executable-cache daemon (ROADMAP item 1)
+# must prove these counters move the right way.
+
+_COMPILE_COLD = obs_metrics.counter(
+    "jtpu_compile_cold_total",
+    "executable shapes cold-compiled in this process (first call for "
+    "the shape: XLA compilation + one execution), labeled kind")
+_COMPILE_HIT = obs_metrics.counter(
+    "jtpu_compile_cache_hit_total",
+    "device calls that hit an already-compiled executable shape "
+    "(in-process jit cache), labeled kind")
+_COMPILE_SECONDS = obs_metrics.histogram(
+    "jtpu_compile_seconds",
+    "wall time of cold first calls per executable shape (XLA "
+    "compilation + one execution), labeled kind",
+    buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+             120.0, 300.0))
+_PERSISTENT_HIT = obs_metrics.counter(
+    "jtpu_persistent_cache_hit_total",
+    "XLA persistent-compilation-cache hits (jax.monitoring "
+    "/jax/compilation_cache/cache_hits; requires "
+    "jax_compilation_cache_dir)")
+_PERSISTENT_MISS = obs_metrics.counter(
+    "jtpu_persistent_cache_miss_total",
+    "XLA persistent-compilation-cache misses (jax.monitoring "
+    "/jax/compilation_cache/cache_misses)")
+
+_CACHE_LISTENER_HOOKED = False
+
+
+def _ensure_cache_listener() -> None:
+    """Register a jax.monitoring listener translating the persistent
+    compilation cache's hit/miss events into registry counters. Once
+    per process; silently absent on jax builds without monitoring."""
+    global _CACHE_LISTENER_HOOKED
+    if _CACHE_LISTENER_HOOKED:
+        return
+    _CACHE_LISTENER_HOOKED = True
+    try:
+        from jax import monitoring
+
+        def on_event(name: str, **kw) -> None:
+            if "/compilation_cache/cache_hits" in name:
+                _PERSISTENT_HIT.inc()
+            elif "/compilation_cache/cache_misses" in name:
+                _PERSISTENT_MISS.inc()
+
+        monitoring.register_event_listener(on_event)
+    except Exception:  # noqa: BLE001 — accounting is optional
+        pass
+
+
+def persistent_cache_dir() -> Optional[str]:
+    """The configured jax persistent-compilation-cache directory, or
+    None when off (the # compile: line reports which)."""
+    try:
+        d = jax.config.jax_compilation_cache_dir
+        return str(d) if d else None
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def _note_call_phase(kind: str, phase: str, seconds: float) -> None:
+    """Account one device call's phase: the wall-time histogram plus
+    the cold-compile vs cache-hit counters (and their latency split).
+    Shared by _timed_call and the resilience supervisor's segment
+    path."""
+    _ensure_cache_listener()
+    _DEVICE_SECONDS.observe(seconds, kind=kind, phase=phase)
+    if phase == "compile":
+        _COMPILE_COLD.inc(kind=kind)
+        _COMPILE_SECONDS.observe(seconds, kind=kind)
+    else:
+        _COMPILE_HIT.inc(kind=kind)
+
+
+def compile_snapshot() -> Dict[str, Any]:
+    """A registry readout of the compile/execute/transfer accounting —
+    diff two of these around a check to attribute its wall-clock
+    (:func:`compile_line`)."""
+    return {
+        "cold": _COMPILE_COLD.total(),
+        "cache-hits": _COMPILE_HIT.total(),
+        "persistent-hits": _PERSISTENT_HIT.total(),
+        "persistent-misses": _PERSISTENT_MISS.total(),
+        "compile-s": _COMPILE_SECONDS.total()["sum"],
+        "execute-s": _DEVICE_SECONDS.total(phase="execute")["sum"],
+        "transfer-bytes": _TRANSFER_BYTES.total(),
+    }
+
+
+def compile_delta(before: Dict[str, Any],
+                  after: Optional[Dict[str, Any]] = None
+                  ) -> Dict[str, Any]:
+    """after - before, field-wise (after defaults to a fresh
+    snapshot)."""
+    after = after or compile_snapshot()
+    return {k: after[k] - before.get(k, 0) for k in after}
+
+
+def compile_line(delta: Dict[str, Any],
+                 wall_s: Optional[float] = None) -> str:
+    """One ``# compile:`` attribution line splitting a check's
+    wall-clock into cold-compile / execute / transfer — printed by
+    analyze, recover, and bench.py. ``delta`` comes from
+    :func:`compile_delta` around the check."""
+    pc = persistent_cache_dir()
+    if pc is None:
+        pc_bit = "persistent-cache=off"
+    else:
+        pc_bit = (f"persistent-cache hit={int(delta['persistent-hits'])}"
+                  f"/miss={int(delta['persistent-misses'])}")
+    line = (f"# compile: cold={int(delta['cold'])} shape(s) "
+            f"{delta['compile-s']:.3f}s | "
+            f"cache-hit={int(delta['cache-hits'])} | "
+            f"execute={delta['execute-s']:.3f}s | "
+            f"transfer={delta['transfer-bytes'] / 1e6:.1f}MB | {pc_bit}")
+    if wall_s is not None:
+        host = max(0.0, wall_s - delta["compile-s"] - delta["execute-s"])
+        line += f" | host={host:.3f}s of {wall_s:.3f}s wall"
+    return line
+
 #: Executable shapes (cache key + padded input shape) that have already
 #: run once in this process — the compile/execute phase separator.
 _EXECUTED_SHAPES: set = set()
@@ -756,7 +883,7 @@ def _timed_call(kind: str, key: tuple, fn, args, **attrs):
         t0 = _hosttime.perf_counter()
         out = jax.block_until_ready(fn(*args))
         dt = _hosttime.perf_counter() - t0
-    _DEVICE_SECONDS.observe(dt, kind=kind, phase=phase)
+    _note_call_phase(kind, phase, dt)
     return out, dt, phase
 
 
@@ -772,6 +899,9 @@ _KERNELS_BY_ID: Dict[int, KernelSpec] = {}
 
 
 def _kernel_key(kernel: KernelSpec) -> int:
+    # every jit-factory use passes through here, BEFORE any compile —
+    # the persistent-cache listener must be live for the first miss
+    _ensure_cache_listener()
     _KERNELS_BY_ID[id(kernel)] = kernel
     return id(kernel)
 
@@ -1227,6 +1357,17 @@ def check_packed_tpu(p: PackedHistory, kernel: KernelSpec,
     out: Dict[str, Any] = {}
     work: list = []
     cost_entries: list = []
+    # Opt-in device profiling (doc/observability.md "Device
+    # profiling"): a no-op unless JTPU_PROF=1 and a run dir is armed.
+    with obs_profiler.capture():
+        out = _check_packed_ladder(p, kernel, ladder, cols, plan_entry,
+                                   work, cost_entries)
+    return out
+
+
+def _check_packed_ladder(p, kernel, ladder, cols, plan_entry, work,
+                         cost_entries) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
     for cap, win, exp in ladder:
         unroll = _unroll_factor()
         fn = _jit_single(_kernel_key(kernel), cap, win, exp, unroll)
@@ -1657,6 +1798,38 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
         raise ValueError(
             f"JTPU_TIEBREAK0 must be lex|hash, got {tb_env!r}")
 
+    # Opt-in device profiling across the whole batch escalation (one
+    # capture, not one per rung); no-op unless JTPU_PROF=1 + a run dir.
+    _prof = obs_profiler.capture()
+    _prof.__enter__()
+    try:
+        results, cost_entries = _keyed_ladder(
+            ladder, rows, adaptive, tb_env, mesh, axis, packed, breq,
+            kernel, results, cost_entries)
+    finally:
+        _prof.__exit__(None, None, None)
+    valid = True
+    for r in results.values():
+        if r["valid"] is False:
+            valid = False
+            break
+        if r["valid"] is UNKNOWN:
+            valid = UNKNOWN
+    out = {"valid": valid, "results": results, "backend": "tpu"}
+    if plan_entry is not None:
+        out["plan"] = plan_entry
+    if cost_entries:
+        # one entry per batch executable actually launched (keys share
+        # it), at the TOP level — attaching the batch cost to every key
+        # result would overcount the work len(grp)-fold
+        out["cost"] = cost_entries
+    return out
+
+
+def _keyed_ladder(ladder, rows, adaptive, tb_env, mesh, axis, packed,
+                  breq, kernel, results, cost_entries):
+    """The keyed batch's escalation loop (split out so the profiler
+    capture wraps exactly the device work)."""
     for step, (cap, win, exp) in enumerate(ladder):
         if not rows:
             break
@@ -1825,19 +1998,4 @@ def check_keyed_tpu(keyed: Dict[Any, Sequence], model: Model,
                 else:
                     results[key] = res
         rows = retry
-    valid = True
-    for r in results.values():
-        if r["valid"] is False:
-            valid = False
-            break
-        if r["valid"] is UNKNOWN:
-            valid = UNKNOWN
-    out = {"valid": valid, "results": results, "backend": "tpu"}
-    if plan_entry is not None:
-        out["plan"] = plan_entry
-    if cost_entries:
-        # one entry per batch executable actually launched (keys share
-        # it), at the TOP level — attaching the batch cost to every key
-        # result would overcount the work len(grp)-fold
-        out["cost"] = cost_entries
-    return out
+    return results, cost_entries

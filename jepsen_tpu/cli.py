@@ -92,6 +92,12 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
                         "(level/frontier/ETA) to stderr while the "
                         "checker runs; `python -m jepsen_tpu watch` "
                         "follows another process's run instead")
+    p.add_argument("--profile", action="store_true",
+                   help="capture a jax.profiler device trace of the "
+                        "checker's searches into <run>/profile/ "
+                        "(equivalent to JTPU_PROF=1); kernel spans "
+                        "merge into the Perfetto export and `trace "
+                        "summary` — doc/observability.md")
 
 
 def parse_concurrency(c: str, n_nodes: int) -> int:
@@ -137,6 +143,7 @@ def test_opt_fn(opts: Dict[str, Any]) -> Dict[str, Any]:
     opts["op-timeout"] = opts.pop("op_timeout", None)
     opts["segment-iters"] = _apply_segment_iters(
         opts.pop("segment_iters", None))
+    opts["profile"] = _apply_profile(opts.pop("profile", False))
     return opts
 
 
@@ -148,6 +155,16 @@ def _apply_segment_iters(seg):
         import os
         os.environ["JTPU_SEGMENT_ITERS"] = str(seg)
     return seg
+
+
+def _apply_profile(flag):
+    """Deploy --profile: the device checkers read the opt-in profiling
+    knob from JTPU_PROF (obs/profiler.py), so the flag exports it for
+    every search this process runs."""
+    if flag:
+        import os
+        os.environ["JTPU_PROF"] = "1"
+    return bool(flag)
 
 
 def _with_watch(opts: Dict[str, Any], fn: Callable[[], int]) -> int:
@@ -285,6 +302,9 @@ def _add_analysis_opts(p: argparse.ArgumentParser) -> None:
                    metavar="N",
                    help="device-search iterations per checkpointed "
                         "segment (0 = monolithic)")
+    p.add_argument("--profile", action="store_true",
+                   help="capture a jax.profiler device trace of the "
+                        "re-check into <run>/profile/ (JTPU_PROF=1)")
 
 
 def analyze_cmd() -> dict:
@@ -306,6 +326,7 @@ def analyze_cmd() -> dict:
         import json as _json
 
         _apply_segment_iters(opts.pop("segment_iters", None))
+        _apply_profile(opts.pop("profile", False))
 
         from jepsen_tpu import repl, store
         from jepsen_tpu.checker.wgl import linearizable
@@ -343,13 +364,25 @@ def analyze_cmd() -> dict:
                                backend=opts["backend"],
                                algorithm=opts["algorithm"])
         # Offline re-checks are the longest searches; publish their
-        # live progress to the run dir so `watch` / /live follow them.
-        from jepsen_tpu.obs import observatory
+        # live progress to the run dir so `watch` / /live follow them
+        # (and arm the device profiler for --profile re-checks).
+        import time as _time
+
+        from jepsen_tpu.checker import tpu as tpu_ns
+        from jepsen_tpu.obs import observatory, profiler
         observatory.attach(test.get("store-dir"))
+        profiler.attach(test.get("store-dir"))
+        comp0 = tpu_ns.compile_snapshot()
+        t0 = _time.perf_counter()
         try:
             out = repl.recheck(test, checker)
         finally:
+            wall = _time.perf_counter() - t0
             observatory.detach()
+            profiler.detach()
+        # wall-clock attribution: cold-compile / execute / transfer
+        # (doc/observability.md "Compile accounting")
+        print(tpu_ns.compile_line(tpu_ns.compile_delta(comp0), wall))
         print(_json.dumps(out, indent=2, default=repr))
         return OK if out.get("valid") is True else TEST_FAILED
 
@@ -390,6 +423,7 @@ def recover_cmd() -> dict:
         import os as _os
 
         _apply_segment_iters(opts.pop("segment_iters", None))
+        _apply_profile(opts.pop("profile", False))
 
         from jepsen_tpu import repl, store
         from jepsen_tpu.checker.wgl import linearizable
@@ -473,7 +507,14 @@ def recover_cmd() -> dict:
             checker = linearizable(models[opts["model"]](),
                                    backend=opts["backend"],
                                    algorithm=opts["algorithm"])
+            import time as _time
+
+            from jepsen_tpu.checker import tpu as tpu_ns
+            comp0 = tpu_ns.compile_snapshot()
+            t0 = _time.perf_counter()
             out = repl.recheck(test, checker)
+            print(tpu_ns.compile_line(tpu_ns.compile_delta(comp0),
+                                      _time.perf_counter() - t0))
             store.write_results(d, out)
             store.write_state(d, "done", recovered=True, recovery=s)
             print(f"# recovery: {d}: verdict valid={out.get('valid')}")
@@ -505,7 +546,36 @@ def watch_cmd() -> dict:
                        help="seconds between refreshes")
         p.add_argument("--once", action="store_true",
                        help="print one status line and exit")
+        p.add_argument("--fleet", nargs="+", default=None,
+                       metavar="HOST_DIR",
+                       help="fleet mode: merge N hosts' run "
+                            "directories (trace/metrics/progress) and "
+                            "render per-host level, shard-imbalance "
+                            "and headroom side by side "
+                            "(obs/fleet.py, doc/observability.md)")
         return p
+
+    def _watch_fleet(opts) -> int:
+        import os as _os
+        import time as _time
+
+        from jepsen_tpu.obs import fleet
+        dirs = list(opts["fleet"])
+        missing = [d for d in dirs if not _os.path.isdir(d)]
+        if missing:
+            print(f"no such host directory: {missing[0]}",
+                  file=sys.stderr)
+            return INVALID_ARGS
+        while True:
+            merged = fleet.merge(dirs)
+            for line in fleet.format_fleet(merged):
+                print(line, flush=True)
+            states = [(p or {}).get("state")
+                      for p in merged["progress"].values()]
+            done = all(s in (None, "done") for s in states)
+            if opts.get("once") or done:
+                return OK
+            _time.sleep(max(opts.get("interval") or 1.0, 0.05))
 
     def run_(opts) -> int:
         import os as _os
@@ -514,6 +584,8 @@ def watch_cmd() -> dict:
         from jepsen_tpu import store
         from jepsen_tpu.obs import observatory
 
+        if opts.get("fleet"):
+            return _watch_fleet(opts)
         d = opts.get("store")
         if d is None:
             t = store.latest(opts.get("store_root") or "store")
@@ -569,8 +641,9 @@ def trace_cmd() -> dict:
                        help="store directory (default: latest under "
                             "./store)")
         p.add_argument("--format", default="chrome",
-                       choices=["chrome", "jsonl"],
-                       help="export format (chrome loads in Perfetto)")
+                       choices=["chrome", "jsonl", "json"],
+                       help="export format (chrome loads in Perfetto; "
+                            "json = machine-readable `summary` output)")
         p.add_argument("-o", "--output", default=None, metavar="FILE",
                        help="write the export here (default: stdout)")
         p.add_argument("--top", type=int, default=None, metavar="N",
@@ -603,9 +676,28 @@ def trace_cmd() -> dict:
         print(f"# trace: {stats['spans']} span(s) in {path} "
               f"({stats['torn']} torn, {stats['corrupt']} corrupt)",
               file=sys.stderr)
+        # Device capture (opt-in --profile runs): merge the profiler's
+        # kernel spans under their host spans as a device-track lane.
+        # Degrades to host-only for runs without (or with a torn)
+        # capture — a SIGKILL mid-capture must not break export.
+        from jepsen_tpu.obs import profiler
+        device = []
+        if _os.path.isdir(profiler.profile_dir(d)):
+            raw_dev, pstats = profiler.read_profile(d)
+            device = profiler.merge_into_host(records, raw_dev)
+            print(f"# trace: {len(device)} device span(s) merged from "
+                  f"profile/ ({pstats['files']} file(s), "
+                  f"{pstats['errors']} unreadable)", file=sys.stderr)
 
         if opts["action"] == "summary":
             rollup = trace_ns.summarize(records)
+            kern = profiler.top_kernels(device, k=opts.get("top") or 10)
+            if opts["format"] == "json":
+                print(_json.dumps({
+                    "stats": stats, "summary": rollup,
+                    "self-time": trace_ns.self_time_rollup(records),
+                    "kernels": kern}, indent=2, default=repr))
+                return OK
             width = max((len(n) for n in rollup), default=4)
             print(f"# trace: {'name':<{width}}  count  total      max")
             for name, s in sorted(rollup.items(),
@@ -625,14 +717,24 @@ def trace_cmd() -> dict:
                     print(f"# trace: {name:<{width}}  {s['count']:>5}  "
                           f"{s['self-ns'] / 1e9:>8.3f}s "
                           f"{s['p95-ns'] / 1e9:>8.3f}s")
+            if kern:
+                print(f"# trace: device kernels, top {len(kern)} by "
+                      f"self-time (per rung)")
+                for row in kern:
+                    rung = row.get("rung")
+                    print(f"# trace:   {row['name'][:60]:<60} "
+                          f"{row['count']:>5}  "
+                          f"{row['self-ns'] / 1e9:>8.3f}s  "
+                          f"rung={rung if rung else '?'}")
             return OK
 
         if opts["format"] == "chrome":
             text = _json.dumps(trace_ns.to_chrome(
-                records, process_name=_os.path.basename(d) or "jtpu"))
+                records + device,
+                process_name=_os.path.basename(d) or "jtpu"))
         else:
             text = "\n".join(_json.dumps(r, default=repr)
-                             for r in records) + "\n"
+                             for r in records + device) + "\n"
         if opts.get("output"):
             with open(opts["output"], "w") as f:
                 f.write(text)

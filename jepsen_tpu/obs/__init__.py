@@ -45,9 +45,27 @@ See doc/observability.md for the span/metric catalog.
 
 from __future__ import annotations
 
+from typing import Optional
+
 from jepsen_tpu.obs.trace import (  # noqa: F401
-    TRACE_NAME, Tracer, enabled, event, finish_run, read_trace, span,
-    start_run, to_chrome, tracer)
+    TRACE_NAME, Tracer, enabled, event, read_trace, span, to_chrome,
+    tracer)
 from jepsen_tpu.obs import metrics  # noqa: F401
 from jepsen_tpu.obs import devices  # noqa: F401
 from jepsen_tpu.obs import observatory  # noqa: F401
+from jepsen_tpu.obs import profiler  # noqa: F401
+from jepsen_tpu.obs import fleet  # noqa: F401
+from jepsen_tpu.obs import trace as _trace
+
+
+def start_run(store_dir: Optional[str]) -> None:
+    """Attach the run-scoped telemetry sinks: the tracer's trace.jsonl
+    (see :func:`jepsen_tpu.obs.trace.start_run`) and — when JTPU_PROF
+    opts in — the device profiler's capture directory."""
+    _trace.start_run(store_dir)
+    profiler.attach(store_dir)
+
+
+def finish_run() -> None:
+    _trace.finish_run()
+    profiler.detach()

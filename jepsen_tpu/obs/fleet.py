@@ -1,0 +1,301 @@
+"""Fleet telemetry: merge N hosts' run artifacts into one view.
+
+The MULTICHIP_r* two-host runs each produce a per-host artifact set —
+``trace.jsonl`` + ``metrics.json`` + ``progress.json`` in that host's
+run directory — and until this module nothing correlated them: two
+disjoint timelines, two metric registries, two progress heartbeats.
+The elastic-fleet work (ROADMAP item 3) needs exactly the correlated
+view: which host straggles, which host's shards hoard the frontier,
+how much headroom each chip has left.
+
+:func:`merge` fuses host directories:
+
+* **Clock alignment.** Each host's trace timestamps are monotonic ns
+  from *that process's* epoch — mutually meaningless. But a multi-host
+  device step is a barrier: the cross-host collective (the DCN gather
+  of a sharded search, the keyed batch launch — spans
+  ``checker.device.sharded`` / ``checker.device.batch``; failing
+  those, the first ``checker.segment`` / ``core.run``) happens at the
+  same wall instant on every participating host. The first anchor span
+  name present in every host's trace aligns them: every host's
+  timeline is shifted so its first anchor span starts where the
+  reference host's does.
+* **Traces** concatenate with a ``host`` attribute and per-track
+  monotonic order preserved; :func:`to_chrome` renders one Chrome/
+  Perfetto document with one process per host, device lanes included.
+* **Metrics** re-key every series with a ``host`` label; counters
+  additionally aggregate to a summed ``fleet`` series and gauges to a
+  maxed one (the conservative read for headroom-style gauges is the
+  worst host — consumers can still read per-host series).
+* **Progress** is kept per host, and :func:`format_fleet` renders the
+  side-by-side status lines (level, shard imbalance, headroom) that
+  ``python -m jepsen_tpu watch --fleet`` and the web ``/fleet``
+  endpoint show.
+
+Everything tolerates ragged fleets: a host missing an artifact (killed
+early, ``JTPU_TRACE=0``) contributes what it has.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+from jepsen_tpu.obs import trace as obs_trace
+
+#: The per-host artifacts a fleet merge consumes.
+HOST_ARTIFACTS = ("trace.jsonl", "metrics.json", "progress.json")
+
+#: Anchor span names tried in order; the first present in EVERY host's
+#: trace wins. The cross-host device launches are true barriers; the
+#: fallbacks degrade gracefully for single-device fixtures.
+DEFAULT_ANCHORS = ("checker.device.sharded", "checker.device.batch",
+                   "checker.segment", "core.run")
+
+
+def is_host_dir(d: str) -> bool:
+    return any(os.path.exists(os.path.join(d, a))
+               for a in HOST_ARTIFACTS)
+
+
+def discover_hosts(run_dir: str) -> List[str]:
+    """Host artifact directories under a run directory: immediate
+    subdirectories carrying any host artifact, else the run directory
+    itself (a single-host run is a one-host fleet)."""
+    if not os.path.isdir(run_dir):
+        return []
+    subs = sorted(
+        os.path.join(run_dir, e) for e in os.listdir(run_dir)
+        if os.path.isdir(os.path.join(run_dir, e))
+        and not os.path.islink(os.path.join(run_dir, e))
+        and is_host_dir(os.path.join(run_dir, e)))
+    if subs:
+        return subs
+    return [run_dir] if is_host_dir(run_dir) else []
+
+
+def read_host(d: str, host: Optional[str] = None) -> Dict[str, Any]:
+    """One host's artifact set: ``{"host", "dir", "trace",
+    "trace-stats", "metrics", "progress"}`` with absent artifacts as
+    empty/None."""
+    host = host or os.path.basename(os.path.normpath(d)) or d
+    out: Dict[str, Any] = {"host": host, "dir": d, "trace": [],
+                           "trace-stats": None, "metrics": None,
+                           "progress": None}
+    tpath = os.path.join(d, obs_trace.TRACE_NAME)
+    if os.path.exists(tpath):
+        try:
+            out["trace"], out["trace-stats"] = obs_trace.read_trace(tpath)
+        except OSError:
+            pass
+    mpath = os.path.join(d, "metrics.json")
+    try:
+        with open(mpath) as f:
+            doc = json.load(f)
+        if isinstance(doc, dict):
+            out["metrics"] = doc
+    except (OSError, ValueError):
+        pass
+    from jepsen_tpu.obs import observatory
+    out["progress"] = observatory.read_progress(d)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Clock alignment
+# ---------------------------------------------------------------------------
+
+
+def _first_span_ts(records: List[dict], name: str) -> Optional[int]:
+    hits = [int(r.get("ts", 0)) for r in records if r.get("name") == name]
+    return min(hits) if hits else None
+
+
+def clock_offsets(hosts: List[Dict[str, Any]],
+                  anchors: Tuple[str, ...] = DEFAULT_ANCHORS
+                  ) -> Tuple[Dict[str, int], Optional[str]]:
+    """Per-host ns offsets aligning every host's first anchor span onto
+    the reference (first) host's. Returns ``({host: offset}, anchor)``;
+    hosts without a trace (or when no anchor is shared) get offset 0
+    and anchor None is reported."""
+    traced = [h for h in hosts if h["trace"]]
+    offsets = {h["host"]: 0 for h in hosts}
+    if len(traced) < 2:
+        return offsets, None
+    for name in anchors:
+        ts = {h["host"]: _first_span_ts(h["trace"], name)
+              for h in traced}
+        if all(v is not None for v in ts.values()):
+            ref = ts[traced[0]["host"]]
+            for h in traced:
+                offsets[h["host"]] = ref - ts[h["host"]]
+            return offsets, name
+    return offsets, None
+
+
+# ---------------------------------------------------------------------------
+# Metrics merging
+# ---------------------------------------------------------------------------
+
+_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+def _parse_labels(key: str) -> List[Tuple[str, str]]:
+    """A formatted label string (``{a="b",c="d"}`` or ``""``) back to
+    pairs — the inverse of metrics._fmt_labels for the label values the
+    registry actually emits."""
+    return _LABEL_RE.findall(key or "")
+
+
+def _with_host(key: str, host: str) -> str:
+    pairs = _parse_labels(key) + [("host", host)]
+    pairs.sort()
+    return "{" + ",".join(f'{k}="{v}"' for k, v in pairs) + "}"
+
+
+def merge_metrics(hosts: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """All hosts' ``metrics.json`` snapshots as one catalog:
+    ``{name: {"kind", "help", "series": {labels+host: value},
+    "fleet": {labels: aggregate}}}`` — counters/histograms sum across
+    hosts, gauges take the max (the worst-host read)."""
+    out: Dict[str, Any] = {}
+    for h in hosts:
+        snap = h.get("metrics") or {}
+        for name, m in snap.items():
+            if not isinstance(m, dict):
+                continue
+            ent = out.setdefault(name, {"kind": m.get("kind"),
+                                        "help": m.get("help", ""),
+                                        "series": {}, "fleet": {}})
+            for key, val in (m.get("series") or {}).items():
+                ent["series"][_with_host(key, h["host"])] = val
+                if not isinstance(val, (int, float)):
+                    continue  # histogram series aggregate below
+                cur = ent["fleet"].get(key)
+                if m.get("kind") == "gauge":
+                    ent["fleet"][key] = (val if cur is None
+                                         else max(cur, val))
+                else:
+                    ent["fleet"][key] = (cur or 0) + val
+    return out
+
+
+def _gauge_value(metrics: Optional[dict], name: str) -> Optional[float]:
+    m = (metrics or {}).get(name)
+    series = (m or {}).get("series") or {}
+    vals = [v for v in series.values() if isinstance(v, (int, float))]
+    return min(vals) if vals else None
+
+
+# ---------------------------------------------------------------------------
+# The merge
+# ---------------------------------------------------------------------------
+
+
+def merge(dirs: List[str],
+          names: Optional[List[str]] = None) -> Dict[str, Any]:
+    """Fuse N host run directories. Returns ``{"hosts", "anchor",
+    "offsets", "trace", "metrics", "progress", "summary"}`` where
+    ``trace`` is the aligned, host-attributed record list (monotonic
+    per (host, tid) track) and ``summary`` is one row per host with the
+    fleet-view fields (state, level, imbalance, headroom)."""
+    hosts = [read_host(d, (names[i] if names and i < len(names)
+                           else None))
+             for i, d in enumerate(dirs)]
+    # de-duplicate colliding basenames (two ".../run" dirs)
+    seen: Dict[str, int] = {}
+    for h in hosts:
+        n = seen.get(h["host"], 0)
+        seen[h["host"]] = n + 1
+        if n:
+            h["host"] = f"{h['host']}~{n}"
+    offsets, anchor = clock_offsets(hosts)
+    merged_trace: List[dict] = []
+    for h in hosts:
+        off = offsets.get(h["host"], 0)
+        recs = [dict(r, ts=int(r.get("ts", 0)) + off, host=h["host"])
+                for r in h["trace"]]
+        recs.sort(key=lambda r: (r.get("tid", 0), r["ts"]))
+        merged_trace.extend(recs)
+    summary = []
+    for h in hosts:
+        p = h.get("progress") or {}
+        summary.append({
+            "host": h["host"],
+            "state": p.get("state"),
+            "level": p.get("level"),
+            "level-budget": p.get("level-budget"),
+            "frontier-rows": p.get("frontier-rows"),
+            "imbalance": _gauge_value(h.get("metrics"),
+                                      "jtpu_shard_imbalance_ratio"),
+            "headroom": _gauge_value(h.get("metrics"),
+                                     "jtpu_device_headroom_ratio"),
+            "spans": len(h["trace"]),
+        })
+    return {"hosts": [h["host"] for h in hosts],
+            "anchor": anchor, "offsets": offsets,
+            "trace": merged_trace,
+            "metrics": merge_metrics(hosts),
+            "progress": {h["host"]: h.get("progress") for h in hosts},
+            "summary": summary}
+
+
+def to_chrome(merged: Dict[str, Any]) -> dict:
+    """A merged fleet -> one Chrome/Perfetto document, one process per
+    host (vs the single-process :func:`jepsen_tpu.obs.trace.to_chrome`)
+    so host timelines render as separate, aligned track groups."""
+    pids = {h: i + 1 for i, h in enumerate(merged.get("hosts", []))}
+    events: List[dict] = [
+        {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+         "args": {"name": f"jtpu:{host}"}}
+        for host, pid in pids.items()]
+    for r in merged.get("trace", []):
+        args = {k: v for k, v in r.items()
+                if k not in ("name", "ts", "dur", "tid", "sid", "pid",
+                             "host")}
+        if "pid" in r:
+            args["parent"] = r["pid"]
+        ev = {"name": str(r.get("name", "?")), "cat": "jtpu",
+              "pid": pids.get(r.get("host"), 0),
+              "tid": int(r.get("tid", 0)),
+              "ts": int(r.get("ts", 0)) / 1e3, "args": args}
+        if r.get("dur", 0) > 0:
+            ev["ph"] = "X"
+            ev["dur"] = int(r["dur"]) / 1e3
+        else:
+            ev["ph"] = "i"
+            ev["s"] = "t"
+        events.append(ev)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def format_fleet(merged: Dict[str, Any]) -> List[str]:
+    """Side-by-side status lines, one per host — the ``watch --fleet``
+    payload (imbalance + headroom are the straggler/OOM-risk signals
+    the fleet scheduler will act on)."""
+    lines = []
+    anchor = merged.get("anchor")
+    lines.append(f"# fleet: {len(merged.get('hosts', []))} host(s)"
+                 + (f", clocks aligned on {anchor}" if anchor
+                    else ", clocks unaligned (no shared anchor span)"))
+    for row in merged.get("summary", []):
+        bits = []
+        if row.get("level") is not None:
+            budget = row.get("level-budget")
+            bits.append(f"level {row['level']}"
+                        + (f"/{budget}" if budget else ""))
+        if row.get("frontier-rows") is not None:
+            bits.append(f"frontier {row['frontier-rows']} rows")
+        if row.get("state"):
+            bits.append(f"state={row['state']}")
+        bits.append("imbalance "
+                    + (f"{row['imbalance']:.2f}x"
+                       if row.get("imbalance") is not None else "n/a"))
+        bits.append("headroom "
+                    + (f"{100 * row['headroom']:.0f}%"
+                       if row.get("headroom") is not None else "n/a"))
+        bits.append(f"{row['spans']} span(s)")
+        lines.append(f"# fleet: {row['host']}: " + " | ".join(bits))
+    return lines

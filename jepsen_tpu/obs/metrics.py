@@ -47,6 +47,12 @@ def _escape(v: str) -> str:
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
 
+def _escape_help(v: str) -> str:
+    """HELP-line escaping per the text exposition format: backslash and
+    newline only (quotes are legal in help text)."""
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _fmt_labels(key: _LabelKey, extra: Iterable[Tuple[str, str]] = ()
                 ) -> str:
     parts = [f'{k}="{_escape(v)}"' for k, v in list(key) + list(extra)]
@@ -73,7 +79,7 @@ class _Metric:
         return [f"{self.name}{_fmt_labels(key)} {_fmt_num(val)}"]
 
     def expose(self) -> List[str]:
-        lines = [f"# HELP {self.name} {self.help}",
+        lines = [f"# HELP {self.name} {_escape_help(self.help)}",
                  f"# TYPE {self.name} {self.kind}"]
         for key in sorted(self._series):
             lines.extend(self._expose_series(key, self._series[key]))
@@ -96,6 +102,15 @@ class Counter(_Metric):
     def value(self, **labels) -> float:
         with self._lock:
             return float(self._series.get(_labels_key(labels), 0.0))
+
+    def total(self, **match) -> float:
+        """Sum across every series whose labels include ``match`` (all
+        series when empty) — e.g. cold compiles across kinds for the
+        ``# compile:`` attribution line."""
+        want = set(_labels_key(match))
+        with self._lock:
+            return float(sum(v for k, v in self._series.items()
+                             if want <= set(k)))
 
 
 class Gauge(_Metric):
@@ -165,6 +180,19 @@ class Histogram(_Metric):
             if s is None:
                 return None
             return {"buckets": list(s[:-2]), "sum": s[-2], "count": s[-1]}
+
+    def total(self, **match) -> Dict[str, float]:
+        """``{"sum", "count"}`` across every series whose labels include
+        ``match`` — e.g. all compile-phase device seconds regardless of
+        kind."""
+        want = set(_labels_key(match))
+        tot_sum, tot_count = 0.0, 0
+        with self._lock:
+            for k, s in self._series.items():
+                if want <= set(k):
+                    tot_sum += s[-2]
+                    tot_count += s[-1]
+        return {"sum": tot_sum, "count": tot_count}
 
     def _expose_series(self, key: _LabelKey, s: list) -> List[str]:
         lines = []

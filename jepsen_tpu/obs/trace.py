@@ -40,10 +40,17 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
+from jepsen_tpu.obs import metrics as obs_metrics
+
 log = logging.getLogger("jepsen.obs")
 
 #: The trace artifact's filename inside a run's store directory.
 TRACE_NAME = "trace.jsonl"
+
+_SPANS_DROPPED = obs_metrics.counter(
+    "jtpu_trace_spans_dropped_total",
+    "spans evicted from the bounded in-memory ring (raise "
+    "JTPU_TRACE_RING, or rely on trace.jsonl, which never drops)")
 
 DEFAULT_RING = 8192
 
@@ -150,6 +157,7 @@ class Tracer:
         self._ids = itertools.count(1)
         self.epoch_ns = time.monotonic_ns()
         self.recorded = 0
+        self.dropped = 0
         self.failed: Optional[str] = None
         self._f = None
         self.path: Optional[str] = None
@@ -186,7 +194,12 @@ class Tracer:
         if self._f is not None and self.failed is None:
             line = json.dumps(rec, separators=(",", ":"),
                               default=repr).encode("utf-8") + b"\n"
+        dropped = False
         with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                # the deque evicts its oldest record on this append
+                self.dropped += 1
+                dropped = True
             self._ring.append(rec)
             self.recorded += 1
             if line is not None and self._f is not None \
@@ -201,6 +214,8 @@ class Tracer:
                     log.warning(
                         "trace sink %s failed (%s); tracing continues "
                         "in-memory only", self.path, self.failed)
+        if dropped:
+            _SPANS_DROPPED.inc()
 
     # -- sink lifecycle -----------------------------------------------------
 

@@ -384,7 +384,12 @@ def supervised_check_packed(p: PackedHistory, kernel: KernelSpec,
       segment — the ``watch`` CLI and ``/live`` endpoint surface.
     """
     try:
-        out = _supervised_check_packed(p, kernel, **kwargs)
+        # Opt-in device profiling over the supervised search — the
+        # scoped jax.profiler capture whose device trace merges under
+        # these checker.segment spans (obs/profiler.py; no-op unless
+        # JTPU_PROF=1 and a run directory is armed).
+        with obs.profiler.capture():
+            out = _supervised_check_packed(p, kernel, **kwargs)
     except BaseException:
         # a raised search must not leave the observatory "searching"
         obs_observatory.finish(valid="error")
@@ -565,6 +570,7 @@ def _supervised_check_packed(p: PackedHistory, kernel: KernelSpec,
                 # spends deadline-sized time compiling.
                 with obs.span("checker.segment", phase=phase,
                               segment=seg_idx, level=lvl0,
+                              rung=[cap_eff, win, exp_eff],
                               backend=ctx["backend"]) as sp:
                     if obs.enabled():
                         # per-shape XLA cost model (memoized; lowering
@@ -677,10 +683,10 @@ def _supervised_check_packed(p: PackedHistory, kernel: KernelSpec,
                 seg_idx += 1
                 transients = 0
                 # success: mark the shape compiled, account the segment
+                # (wall histogram + cold-compile/cache-hit counters)
                 T._EXECUTED_SHAPES.add(shape_key)
                 device_s[phase] += seg_s
-                T._DEVICE_SECONDS.observe(seg_s, kind="segment",
-                                          phase=phase)
+                T._note_call_phase("segment", phase, seg_s)
                 lvl1 = int(carry[8])
                 seg_levels.append(lvl1 - lvl0)
                 alive = int(np.count_nonzero(np.asarray(carry[4])))

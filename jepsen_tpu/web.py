@@ -173,6 +173,9 @@ class Handler(BaseHTTPRequestHandler):
             if path.startswith("/live/"):
                 return self.live(path[len("/live/"):],
                                  query=url.query)
+            if path.startswith("/fleet/"):
+                return self.fleet(path[len("/fleet/"):],
+                                  query=url.query)
             if path.startswith("/trace/"):
                 return self.trace(path[len("/trace/"):])
             if path.startswith("/files/"):
@@ -284,6 +287,66 @@ class Handler(BaseHTTPRequestHandler):
         doc = {"state": _run_status(run_dir), "progress": progress}
         self._send(200, json.dumps(doc, default=repr).encode(),
                    ctype="application/json")
+
+    def fleet(self, rel: str, query: str = ""):
+        """``/fleet/<test>/<ts>`` — the multi-host view of one run:
+        host subdirectories carrying ``trace.jsonl`` / ``metrics.json``
+        / ``progress.json`` are merged (clock-aligned on the shared
+        anchor span, obs/fleet.py) and rendered side by side — per-host
+        search level, shard-imbalance and device headroom, the
+        straggler/OOM-risk signals. ``?format=json`` answers the raw
+        merge (summary + offsets; the trace stays on disk). A run
+        without host subdirectories renders as a one-host fleet."""
+        from jepsen_tpu.obs import fleet as fleet_ns
+        run_dir = os.path.join(self.root, rel.strip("/"))
+        if not _within(self.root, run_dir):
+            return self._page("403", "<p>Forbidden.</p>", code=403)
+        if not os.path.isdir(run_dir):
+            return self._page("404", "<p>No such run.</p>", code=404)
+        dirs = fleet_ns.discover_hosts(run_dir)
+        if not dirs:
+            return self._page(
+                "404", "<p>No host artifacts (trace.jsonl / "
+                       "metrics.json / progress.json) under this run "
+                       "(JTPU_TRACE=0?).</p>", code=404)
+        merged = fleet_ns.merge(dirs)
+        if query == "format=json":
+            doc = {k: merged[k] for k in ("hosts", "anchor", "offsets",
+                                          "summary", "progress")}
+            return self._send(200, json.dumps(doc, default=repr).encode(),
+                              ctype="application/json")
+        rows = []
+        for s in merged["summary"]:
+            level = (f"{s['level']}/{s['level-budget']}"
+                     if s.get("level") is not None
+                     and s.get("level-budget") else
+                     (str(s["level"]) if s.get("level") is not None
+                      else "—"))
+            imb = (f"{s['imbalance']:.2f}x"
+                   if s.get("imbalance") is not None else "—")
+            head = (f"{100 * s['headroom']:.0f}%"
+                    if s.get("headroom") is not None else "—")
+            rows.append(
+                "<tr>"
+                f"<td>{html.escape(str(s['host']))}</td>"
+                f"<td>{html.escape(str(s.get('state') or '—'))}</td>"
+                f"<td>{html.escape(level)}</td>"
+                f"<td>{html.escape(str(s.get('frontier-rows') if s.get('frontier-rows') is not None else '—'))}</td>"
+                f"<td>{html.escape(imb)}</td>"
+                f"<td>{html.escape(head)}</td>"
+                f"<td>{s['spans']}</td></tr>")
+        anchor = merged.get("anchor")
+        body = (f"<p>{len(merged['hosts'])} host(s); clocks "
+                + (f"aligned on <code>{html.escape(anchor)}</code>"
+                   if anchor else "unaligned (no shared anchor span)")
+                + "</p><table><tr><th>host</th><th>state</th>"
+                  "<th>level</th><th>frontier</th>"
+                  "<th>shard imbalance</th><th>headroom</th>"
+                  "<th>spans</th></tr>" + "".join(rows) + "</table>"
+                + "<p><code>python -m jepsen_tpu watch --fleet "
+                + " ".join(html.escape(d) for d in dirs)
+                + "</code></p>")
+        self._page(f"fleet {rel}", body)
 
     #: Spans rendered per waterfall page (deepest-first file order);
     #: beyond this the page says how many were elided.

@@ -45,6 +45,11 @@ def pytest_configure(config):
         "(tests/test_plan.py): bucket enumeration, zero-compile "
         "abstract evaluation, footprint math, the pre-search plan "
         "gate, and the JTPU_PLAN_GATE kill switch")
+    config.addinivalue_line(
+        "markers", "prof: device-profiling + fleet telemetry tests "
+        "(tests/test_prof.py): jax.profiler capture scoping, the "
+        "JTPU_PROF kill switch, device-trace parse/merge, kernel "
+        "rollups, compile-cache accounting, and the fleet merge")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -67,6 +67,45 @@ def _median(vals):
     return (vals[mid - 1] + vals[mid]) / 2
 
 
+#: Phase axes a regression is attributed to (dotted paths into the
+#: record; bench.py emits the `compile` sub-record and `transfer_mb`
+#: from the registry deltas around its cold+warm checks).
+ATTRIBUTION_AXES = ("compile_s", "execute_s", "transfer_mb",
+                    "compile.cold_compile_s", "compile.warm_execute_s")
+
+
+def _get_path(rec, path):
+    cur = rec
+    for part in path.split("."):
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(part)
+    return cur
+
+
+def _attribution(newest, priors):
+    """When the gate fires, say WHICH phase moved: newest vs the median
+    of priors for each attribution axis, ratio-sorted so the likely
+    driver (cold-compile, execute, or transfer) leads. Axes without a
+    numeric newest value or two comparable priors are skipped."""
+    rows = []
+    for name in ATTRIBUTION_AXES:
+        new_v = _get_path(newest, name)
+        prior_vals = [v for v in (_get_path(p, name) for p in priors)
+                      if isinstance(v, (int, float))]
+        if not isinstance(new_v, (int, float)) or len(prior_vals) < 2:
+            continue
+        med = _median(prior_vals)
+        if med > 0:
+            ratio = new_v / med
+        else:
+            ratio = float("inf") if new_v > 0 else 1.0
+        rows.append({"axis": name, "newest": round(new_v, 3),
+                     "median": round(med, 3), "ratio": round(ratio, 2)})
+    rows.sort(key=lambda r: -r["ratio"])
+    return rows
+
+
 def _check_axis(name, newest, priors, tolerance):
     """One comparison axis (value / cold_s). Returns a verdict dict;
     ``status`` is 'ok' | 'regression' | 'skipped'."""
@@ -120,6 +159,11 @@ def gate(root, tolerance=1.5, cold_tolerance=4.0):
         doc["checks"].append(check)
         if check["status"] == "regression":
             doc["ok"] = False
+    if not doc["ok"]:
+        # regression attribution: which phase moved — cold-compile,
+        # execute, or transfer — so the failure message names a
+        # suspect instead of just a wall-clock number
+        doc["attribution"] = _attribution(newest, priors)
     return doc
 
 
@@ -157,6 +201,11 @@ def main() -> int:
                       f"x{c['tolerance']} = {c['limit']}s limit")
         if doc.get("note"):
             print(f"# bench-gate: {doc['note']}")
+        for a in doc.get("attribution") or []:
+            moved = "moved" if a["ratio"] > 1.2 else "flat"
+            print(f"# bench-gate: attribution: {a['axis']} {moved} "
+                  f"{a['ratio']}x (median {a['median']} -> "
+                  f"{a['newest']})")
         print("# bench-gate: " + ("clean" if doc["ok"]
                                   else "FAILED — bench regression"))
     return 0 if doc["ok"] else 1

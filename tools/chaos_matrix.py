@@ -32,6 +32,11 @@ qualify a new accelerator image before trusting it with long runs):
                    dead, no 500), the `watch` CLI degrades to a
                    graceful status line, and recovery still renders
                    a verdict
+  prof-kill        SIGKILL a --profile (JTPU_PROF=1) localkv run while
+                   the device profiler is mid-capture: the partial
+                   capture reads tail-tolerantly, `recover` still
+                   renders a verdict, and `trace export` degrades to
+                   valid Chrome JSON
   plan-rejects     drive a real localkv history at an oversized
                    capacity (tiny JTPU_PLAN_BYTES_LIMIT) and at a
                    non-dividing mesh axis: the pre-search plan gate
@@ -594,6 +599,101 @@ def scenario_watched_kill(seed):
                 f"status={store.run_status(run_dir)}")
 
 
+def scenario_prof_kill(seed):
+    """SIGKILL a ``--profile`` localkv run MID-CAPTURE (the device
+    profiler is recording when the kill lands); assert the partial
+    capture is tail-tolerantly readable (read_profile never raises —
+    a killed capture may have written nothing, or a torn file),
+    `recover` still renders a verdict from the WAL, and `trace export`
+    degrades gracefully to valid Chrome JSON."""
+    import contextlib
+    import io
+    import tempfile
+
+    from jepsen_tpu import cli, store
+    from jepsen_tpu.obs import profiler
+
+    root = tempfile.mkdtemp(prefix="jepsen-chaos-profkill-")
+    run_dir = os.path.join(root, "local-kv", "run")
+    ports_file = os.path.join(root, "ports.json")
+    child_src = (
+        "import json, sys\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "from jepsen_tpu import core\n"
+        "from jepsen_tpu.suites.localkv import localkv_test\n"
+        "test = localkv_test({'time-limit': 8, 'nemesis-period': 3,\n"
+        "                     'backend': 'tpu'})\n"
+        f"test['store-dir'] = {run_dir!r}\n"
+        f"json.dump(test['localkv-ports'], open({ports_file!r}, 'w'))\n"
+        "core.run(test)\n")
+    # JTPU_PROF=1 arms the capture; 1-iteration segments stretch the
+    # checker phase over hundreds of device calls so the SIGKILL
+    # reliably lands while the profiler is recording.
+    env = dict(os.environ, JAX_PLATFORMS="cpu", JTPU_TRACE="1",
+               JTPU_PROF="1", JTPU_SEGMENT_ITERS="1")
+    proc = subprocess.Popen([sys.executable, "-c", child_src], env=env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    prof_dir = profiler.profile_dir(run_dir)
+    deadline = time.time() + 120
+    try:
+        # wait for the capture itself: the profile dir is created at
+        # jax.profiler.start_trace, i.e. the search is being profiled
+        while time.time() < deadline:
+            if os.path.isdir(prof_dir):
+                break
+            if proc.poll() is not None:
+                return False, (f"child exited rc={proc.returncode} "
+                               f"before any capture started")
+            time.sleep(0.05)
+        else:
+            return False, "capture never started within 120s"
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()
+    finally:
+        try:
+            with open(ports_file) as f:
+                _kill_kvnodes(json.load(f))
+        except OSError:
+            pass
+
+    # (1) the partial capture reads tail-tolerantly: whatever the kill
+    # left behind (nothing, xplane-only, or a torn trace.json.gz) must
+    # answer with records + stats, never an exception
+    records, pstats = profiler.read_profile(run_dir)
+    # (2) recover still renders a verdict from the WAL
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli.run(cli.default_commands(),
+                     ["recover", "--store-root", root])
+    out = buf.getvalue()
+    recovered = (rc == 0 and "# recovery:" in out
+                 and store.run_status(run_dir) == "recovered")
+    # (3) trace export degrades gracefully: rc 0, valid Chrome JSON
+    export = os.path.join(root, "chrome.json")
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        export_rc = cli.run(cli.default_commands(),
+                            ["trace", "export", "--store", run_dir,
+                             "-o", export])
+    export_ok = False
+    if export_rc == 0 and os.path.exists(export):
+        try:
+            with open(export) as f:
+                doc = json.load(f)
+            evs = doc.get("traceEvents")
+            export_ok = isinstance(evs, list) and len(evs) > 0 and \
+                all("name" in e and "ph" in e for e in evs)
+        except ValueError:
+            export_ok = False
+    ok = recovered and export_ok
+    return ok, (f"capture killed mid-flight: {pstats['files']} trace "
+                f"file(s), {len(records)} device record(s), "
+                f"{pstats['errors']} unreadable; recover rc={rc} "
+                f"status={store.run_status(run_dir)}; export "
+                f"rc={export_rc} valid-chrome={export_ok}")
+
+
 def scenario_plan_rejects(seed):
     """Drive a REAL localkv history into the pre-search plan gate with
     (1) an oversized explicit capacity under a tiny byte budget and
@@ -687,6 +787,7 @@ SCENARIOS = (
     ("malformed-history", scenario_malformed_history),
     ("trace-integrity", scenario_trace_integrity),
     ("watched-kill", scenario_watched_kill),
+    ("prof-kill", scenario_prof_kill),
     ("plan-rejects", scenario_plan_rejects),
 )
 
