@@ -175,6 +175,12 @@ def child_main(platform: str) -> int:
     rec["transfer_mb"] = round(
         (cold_comp["transfer-bytes"] + warm_comp["transfer-bytes"])
         / 1e6, 3)
+    # rebalance accounting (doc/resilience.md "Elastic fleet"): remesh/
+    # steal counts and the peak shard-imbalance ratio land in the bench
+    # record so tools/bench_gate.py attributes a rebalance regression
+    # the way it attributes the compile/execute phases. 0/0/1.0 on
+    # non-fleet non-sharded runs — the axes must exist to be gated.
+    rec["search"] = _search_axes([result, result2])
     print(json.dumps(rec))
     sys.stdout.flush()
     _search_line("10k headline", result2, warm)
@@ -237,6 +243,28 @@ def child_main(platform: str) -> int:
     return 0
 
 
+def _search_axes(results):
+    """Rebalance axes for the bench record: total remesh/steal counts
+    and the peak shard-imbalance ratio across the measured checks
+    (fleet results carry a ``fleet`` entry, sharded results a
+    ``shard-balance`` entry; plain runs gate at 0/0/1.0)."""
+    remesh = steal = 0
+    imb = 1.0
+    for r in results:
+        if not isinstance(r, dict):
+            continue
+        fl = r.get("fleet") or {}
+        remesh += int(fl.get("remesh-count") or 0)
+        steal += int(fl.get("steal-count") or 0)
+        for cand in (fl.get("peak-imbalance"),
+                     (r.get("shard-balance") or {}).get(
+                         "imbalance-ratio")):
+            if isinstance(cand, (int, float)):
+                imb = max(imb, float(cand))
+    return {"remesh_count": remesh, "steal_count": steal,
+            "imbalance_ratio": round(imb, 3)}
+
+
 def _search_line(label, result, wall_s):
     """One '# search:' stderr line attributing a check's wall-clock to
     compile/device/host phases, from the telemetry the supervised
@@ -265,6 +293,13 @@ def _search_line(label, result, wall_s):
         if bal:
             line += (f", shard-imbalance={bal['imbalance-ratio']}x "
                      f"over {bal['devices']} device(s)")
+        fl = result.get("fleet")
+        if fl:
+            line += (f", fleet {len(fl.get('live') or [])}/"
+                     f"{len(fl.get('hosts') or [])} host(s) "
+                     f"{fl.get('remesh-count', 0)} remesh(es) "
+                     f"{fl.get('steal-count', 0)} steal(s) "
+                     f"peak-imbalance={fl.get('peak-imbalance')}x")
         print(line, file=sys.stderr)
     except Exception as e:  # noqa: BLE001
         print(f"# search {label}: accounting failed: {e!r}",
@@ -913,7 +948,8 @@ def main() -> int:
         if rec is not None and rec.get("value") is not None:
             extras = {k: rec[k] for k in ("cold_s", "cold_vs_baseline",
                                           "compile_s", "execute_s",
-                                          "compile", "transfer_mb")
+                                          "compile", "transfer_mb",
+                                          "search")
                       if k in rec}
             # Second cold child: same measurement in a FRESH process —
             # its cold_s shows whether the persistent compilation cache
@@ -950,7 +986,8 @@ def main() -> int:
         if rec is not None and rec.get("value") is not None:
             extras = {k: rec[k] for k in ("cold_s", "cold_vs_baseline",
                                           "compile_s", "execute_s",
-                                          "compile", "transfer_mb")
+                                          "compile", "transfer_mb",
+                                          "search")
                       if k in rec}
             emit(rec["value"], rec["vs_baseline"], platform="cpu",
                  note="tpu unavailable; cpu-backend fallback", **extras)

@@ -828,6 +828,63 @@ def gate_sharded(p: PackedHistory, kernel, naxis: int, capacity: int,
     return _entry(report)
 
 
+def pad_for_axis(n: int, naxis: int) -> int:
+    """The smallest value >= ``n`` the mesh axis divides — how the
+    elastic fleet re-pads a pool when the mesh grows or shrinks (always
+    UP: padding adds dead rows; truncating would drop live frontier)."""
+    naxis = max(int(naxis), 1)
+    return -(-int(n) // naxis) * naxis
+
+
+def check_remesh(p, naxis: int, capacity: int, window: int,
+                 expand: Optional[int],
+                 bytes_limit: Optional[int] = None) -> Dict[str, Any]:
+    """Re-mesh validation for the elastic fleet layer
+    (:mod:`jepsen_tpu.fleet`): re-run the PLAN-SHARD-INDIVISIBLE /
+    PLAN-SHARD-SKEW / PLAN-OOM checks against a NEW mesh axis — the
+    host-loss / join path, where a failed validation must inform, not
+    abort, the surviving search.
+
+    Unlike :func:`gate_sharded` this NEVER raises: the capacity and
+    expand are first padded up so the axis divides them
+    (:func:`pad_for_axis` — re-meshing must not drop live rows), the
+    candidate is checked, and the caller gets the whole verdict::
+
+        {"ok": bool, "naxis", "capacity", "expand",  # post-padding
+         "per-device-bytes", "bytes-limit",
+         "issues": [{rule, severity, message, label}]}
+
+    ``p`` is a PackedHistory or a prebuilt PlanDims. ``ok`` is False
+    only on error-severity issues (a skew WARNING degrades, it does
+    not refuse a mesh that keeps the search alive)."""
+    dims = p if isinstance(p, PlanDims) else PlanDims.from_packed(p)
+    naxis = max(int(naxis), 1)
+    cap = pad_for_axis(capacity, naxis)
+    exp = None if expand is None else pad_for_axis(expand, naxis)
+    limit = bytes_limit if bytes_limit is not None else plan_bytes_limit()
+    crw = T._crash_width(dims.n_crashed)
+    if crw is None:
+        return {"ok": False, "naxis": naxis, "capacity": cap,
+                "expand": exp, "per-device-bytes": None,
+                "bytes-limit": limit,
+                "issues": [PlanIssue(
+                    "PLAN-CRASH-WIDTH", ERROR,
+                    f"{dims.n_crashed} crashed ops exceed the "
+                    f"crashed-set width {T.CRASH_MAX}").to_dict()]}
+    cand = Candidate(kind="sharded", capacity=cap, window=window,
+                     expand=exp, unroll=T._unroll_factor(),
+                     breq=T._bucket(max(dims.n_required, 1)), crw=crw,
+                     mesh_axis=naxis)
+    issues = check_candidate(cand, dims, limit)
+    fp = footprint(cand)
+    return {"ok": not any(i.severity == ERROR for i in issues),
+            "naxis": naxis, "capacity": cap, "expand": exp,
+            "per-device-bytes": fp.get("per-device-bytes",
+                                       fp["total-bytes"]),
+            "bytes-limit": limit,
+            "issues": [i.to_dict() for i in issues]}
+
+
 def seed_rung(capacity: int, window: int, expand: Optional[int],
               breq: int, crw: int, floor: int,
               kind: str = "segment"

@@ -938,22 +938,72 @@ def _jit_single(kernel_id: int, capacity: int, window: int,
 
 @functools.lru_cache(maxsize=64)
 def _jit_segment(kernel_id: int, capacity: int, window: int,
-                 expand: Optional[int] = None, unroll: int = 1):
+                 expand: Optional[int] = None, unroll: int = 1,
+                 shard_axis: Optional[str] = None):
     """One bounded-iteration device segment of the single-history search
     (the checkpointed mode jepsen_tpu.resilience drives): takes the packed
     columns, a traced per-call iteration bound, and the search carry;
     returns the updated carry. The bound is traced (not static), so
-    changing segment length never recompiles."""
+    changing segment length never recompiles. With ``shard_axis`` the
+    segment's pool/grids/sort rows are partitioned over the mesh axis
+    exactly like _jit_single's sharded mode — the segmented, checkpointed
+    flavor of check_packed_sharded (every segment boundary is the global
+    merge-sort barrier, so the host carry snapshot between segments IS a
+    consistent cross-host checkpoint)."""
     kernel = _KERNELS_BY_ID[kernel_id]
 
     def seg(f, v1, v2, ro, fr, inv, ret, sm, cf, cv1, cv2, cinv,
             cps, nr, ini, seg_iters, carry):
         search = _search_fn(kernel.step, f.shape[0], cf.shape[0],
-                            capacity, window, expand, unroll, segment=True)
+                            capacity, window, expand, unroll,
+                            shard_axis, segment=True)
         return search(f, v1, v2, ro, fr, inv, ret, sm, cf, cv1, cv2,
                       cinv, cps, nr, ini, seg_iters, carry)
 
     return jax.jit(seg)
+
+
+def _popcount32_host(a: np.ndarray) -> np.ndarray:
+    """Per-element population count of a uint32 array (the SWAR trick;
+    numpy grew bitwise_count only in 2.0, and the host merge below must
+    match the device's lax.population_count on older numpys too)."""
+    a = np.asarray(a, np.uint32).copy()
+    a = a - ((a >> np.uint32(1)) & np.uint32(0x55555555))
+    a = ((a & np.uint32(0x33333333))
+         + ((a >> np.uint32(2)) & np.uint32(0x33333333)))
+    a = (a + (a >> np.uint32(4))) & np.uint32(0x0F0F0F0F)
+    return ((a * np.uint32(0x01010101)) >> np.uint32(24)).astype(np.int64)
+
+
+def _pool_sort_host(k, mask, cmask, state, alive) -> np.ndarray:
+    """Host-side mirror of _search_fn's merge-sort lex order: the
+    permutation putting pool rows deepest-first (valid rows keyed
+    MAXK - depth, invalid rows sunk past MAXK, then k, mask words,
+    state, cmask popcount, cmask words — exactly the device ``terms``
+    sequence for tiebreak="lex").
+
+    This is the global merge-sort barrier's ordering exposed to host
+    code: the elastic fleet layer (jepsen_tpu.fleet) merges per-host
+    pool shards with it, so a host-side merge and the device sort agree
+    on which rows a truncation keeps and which rows a work-stealing
+    redistribution deals first."""
+    MAXK = np.int64(1 << 30)
+    k = np.asarray(k, np.int64)
+    mask = np.asarray(mask, np.uint32)
+    cmask = np.asarray(cmask, np.uint32)
+    state = np.asarray(state, np.int64)
+    alive = np.asarray(alive, bool)
+    MW = mask.shape[1] if mask.ndim == 2 else 1
+    MC = cmask.shape[1] if cmask.ndim == 2 else 1
+    mask = mask.reshape(k.shape[0], MW)
+    cmask = cmask.reshape(k.shape[0], MC)
+    depth = k + sum(_popcount32_host(mask[:, w]) for w in range(MW))
+    key1 = np.where(alive, MAXK - depth, MAXK + 1 + k)
+    pc = sum(_popcount32_host(cmask[:, w]) for w in range(MC))
+    terms = ([key1, k] + [mask[:, w] for w in range(MW)]
+             + [state, pc] + [cmask[:, w] for w in range(MC)])
+    # np.lexsort's LAST key is primary; the device sort's FIRST is
+    return np.lexsort(tuple(terms[::-1]))
 
 
 def _carry0_host(capacity: int, window: int, n_cr: int, init_state,
@@ -993,6 +1043,20 @@ def _summarize_carry(carry) -> tuple:
     lossy = lossy or (not done and bool(np.any(carry[4])))
     return (done, lossy, wovf, int(carry[9]), int(carry[8]),
             (carry[10], carry[11], carry[12]))
+
+
+def _fleet_hosts() -> int:
+    """The JTPU_FLEET opt-in: N >= 2 routes single-history searches
+    through the elastic fleet scheduler (jepsen_tpu.fleet) over an
+    N-host (simulated on CPU) mesh. 0, 1, absent, or malformed all mean
+    OFF — the single-host paths must stay byte-identical, the same
+    kill-switch discipline as JTPU_TRACE / JTPU_PLAN_GATE."""
+    v = _os_environ_get("JTPU_FLEET") or ""
+    try:
+        n = int(v.strip() or "0")
+    except ValueError:
+        return 0
+    return n if n >= 2 else 0
 
 
 def _segment_config(segment_iters: Optional[int]) -> Optional[int]:
@@ -1325,6 +1389,18 @@ def check_packed_tpu(p: PackedHistory, kernel: KernelSpec,
     0 forces the monolithic single-while_loop path."""
     if window is not None:
         _check_window(window)
+    nfleet = _fleet_hosts()
+    if nfleet:
+        # Elastic fleet opt-in (JTPU_FLEET=N, doc/resilience.md
+        # "Elastic fleet"): the search runs under the fleet scheduler —
+        # N logical hosts each owning a pool shard, merged at the
+        # global sort barrier, surviving host loss/join/skew. Off
+        # (0/absent), this branch is never taken and the single-host
+        # paths below are untouched.
+        from jepsen_tpu import fleet as fleet_mod
+        return fleet_mod.check_packed_fleet(
+            p, kernel, hosts=nfleet, capacity=capacity, window=window,
+            expand=expand, segment_iters=segment_iters)
     seg = _segment_config(segment_iters)
     if seg:
         from jepsen_tpu import resilience
@@ -1451,7 +1527,11 @@ def check_packed_sharded(p: PackedHistory, kernel: KernelSpec,
                          mesh: "jax.sharding.Mesh",
                          capacity: int = 4096,
                          window: Optional[int] = None,
-                         expand: Optional[int] = None) -> Dict[str, Any]:
+                         expand: Optional[int] = None,
+                         segment_iters: Optional[int] = None,
+                         checkpoint_path: Optional[str] = None,
+                         on_checkpoint=None,
+                         resume=None) -> Dict[str, Any]:
     """Check ONE packed history with its search pool sharded over a
     device mesh — single-history scale-out, the frontier-parallel WGL of
     SURVEY §2.5: while keyed batches data-parallelize across keys
@@ -1464,7 +1544,20 @@ def check_packed_sharded(p: PackedHistory, kernel: KernelSpec,
 
     The mesh axis must divide ``capacity`` and ``expand``; window=None
     picks the history's needed bucket. Returns the same result dict as
-    check_packed_tpu."""
+    check_packed_tpu.
+
+    With ``segment_iters`` the sharded search runs CHECKPOINTED: an
+    outer host loop of bounded device segments (the sharded flavor of
+    _jit_segment), snapshotting the carry to host after every segment —
+    every segment boundary is the global merge-sort barrier, so the
+    snapshot is a consistent cross-host checkpoint (gathered over DCN
+    on multi-host meshes). ``checkpoint_path`` / ``on_checkpoint``
+    persist/observe the :class:`jepsen_tpu.resilience.Checkpoint`;
+    ``resume`` continues one — including on a mesh of a DIFFERENT axis
+    size than the one that saved it (the carry is global state; the
+    axis only partitions its rows), which is what the elastic fleet
+    layer's re-meshing leans on. The body sequence is identical to the
+    monolithic sharded loop's, so verdicts and level counts match."""
     from jepsen_tpu import accel
     accel.ensure_usable("check_packed_sharded")
     naxis = mesh.shape[POOL_AXIS]
@@ -1495,6 +1588,11 @@ def check_packed_sharded(p: PackedHistory, kernel: KernelSpec,
         raise ValueError(
             f"the mesh axis ({naxis}) must divide capacity "
             f"({capacity}) and expand ({expand})")
+    if segment_iters:
+        return _check_sharded_segmented(
+            p, kernel, mesh, naxis, cols, capacity, window, expand,
+            int(segment_iters), checkpoint_path, on_checkpoint, resume,
+            plan_entry)
     fn = _jit_single(_kernel_key(kernel), capacity, window, expand,
                      _unroll_factor(), POOL_AXIS)
     with _mesh_context(mesh):
@@ -1539,6 +1637,85 @@ def check_packed_sharded(p: PackedHistory, kernel: KernelSpec,
                     unroll=_unroll_factor(), levels=int(levels),
                     axis=naxis, **cost)]
     out["pool-sharding"] = f"{POOL_AXIS}={naxis}"
+    if plan_entry is not None:
+        out["plan"] = plan_entry
+    return out
+
+
+def _check_sharded_segmented(p, kernel, mesh, naxis: int, cols: dict,
+                             capacity: int, window: int,
+                             expand: int, seg: int,
+                             checkpoint_path: Optional[str],
+                             on_checkpoint, resume,
+                             plan_entry) -> Dict[str, Any]:
+    """The checkpointed pool-sharded search: bounded sharded segments
+    with a host carry snapshot at every global merge-sort barrier (see
+    check_packed_sharded's docstring). Split out so the mesh context
+    wraps exactly the device work."""
+    unroll = _unroll_factor()
+    fn = _jit_segment(_kernel_key(kernel), capacity, window, expand,
+                      unroll, POOL_AXIS)
+    lmax = _level_budget(cols["f"].shape[0], cols["cf"].shape[0])
+    crw = _crash_width(p.n - p.n_required) or 0
+    if resume is not None:
+        carry = tuple(np.asarray(x) for x in resume.carry)
+        if int(carry[0].shape[0]) != capacity:
+            raise ValueError(
+                f"checkpoint capacity {int(carry[0].shape[0])} != "
+                f"requested {capacity}; re-embed the pool first "
+                f"(jepsen_tpu.fleet.repad_pool)")
+        seg_idx = int(resume.segment)
+    else:
+        carry = _carry0_host(capacity, window, cols["cf"].shape[0],
+                             cols["ini"], int(cols["nr"]))
+        seg_idx = 0
+    multiproc = jax.process_count() > 1
+    with _mesh_context(mesh):
+        while _carry_active(carry, lmax):
+            shape_key = ("sharded-segment", _kernel_key(kernel),
+                         capacity, window, expand, unroll, naxis,
+                         cols["f"].shape[0], cols["cf"].shape[0])
+            lvl0 = int(carry[8])
+            outs, _, _ = _timed_call(
+                "sharded", shape_key, fn,
+                [cols[c] for c in _COLS] + [np.int32(seg), carry],
+                rung=(capacity, window, expand), axis=naxis,
+                segment=seg_idx)
+            if multiproc:
+                # The carry's pool columns are row-sharded over DCN;
+                # the checkpoint must be the GLOBAL state, so gather
+                # them at the barrier (scalars are replicated already).
+                from jax.experimental import multihost_utils
+                carry = tuple(
+                    multihost_utils.process_allgather(x, tiled=True)
+                    if getattr(x, "ndim", 0) else np.asarray(x)
+                    for x in outs)
+            else:
+                carry = tuple(np.asarray(x) for x in outs)
+            seg_idx += 1
+            _LEVELS_TOTAL.inc(int(carry[8]) - lvl0)
+            _SEGMENTS_TOTAL.inc()
+            _FRONTIER_HWM.set_max(int(np.count_nonzero(carry[4])))
+            if checkpoint_path or on_checkpoint is not None:
+                from jepsen_tpu.resilience import Checkpoint
+                cp = Checkpoint(carry=carry,
+                                rung=(capacity, window, expand),
+                                window=window, expand_eff=expand,
+                                crash_width=crw, segment=seg_idx)
+                if checkpoint_path:
+                    cp.save(checkpoint_path)
+                if on_checkpoint is not None:
+                    on_checkpoint(cp)
+    done, lossy, wovf, best, levels, pool = _summarize_carry(carry)
+    out = _result(done, lossy, wovf, best, levels, p, pool=pool)
+    balance = _shard_balance(pool, naxis)
+    if balance is not None:
+        out["shard-balance"] = balance
+    out["pool-sharding"] = f"{POOL_AXIS}={naxis}"
+    out["rung"] = (capacity, window, expand)
+    out["crash-width"] = crw
+    out["segments"] = seg_idx
+    out["segment-iters"] = seg
     if plan_entry is not None:
         out["plan"] = plan_entry
     return out

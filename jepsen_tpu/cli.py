@@ -92,6 +92,13 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
                         "(level/frontier/ETA) to stderr while the "
                         "checker runs; `python -m jepsen_tpu watch` "
                         "follows another process's run instead")
+    p.add_argument("--fleet", type=int, default=None, metavar="N",
+                   help="run single-history device searches under the "
+                        "elastic fleet scheduler over N (simulated on "
+                        "CPU) hosts — host-loss re-meshing, "
+                        "work-stealing rebalance, join admission "
+                        "(equivalent to JTPU_FLEET=N; "
+                        "doc/resilience.md \"Elastic fleet\")")
     p.add_argument("--profile", action="store_true",
                    help="capture a jax.profiler device trace of the "
                         "checker's searches into <run>/profile/ "
@@ -144,7 +151,18 @@ def test_opt_fn(opts: Dict[str, Any]) -> Dict[str, Any]:
     opts["segment-iters"] = _apply_segment_iters(
         opts.pop("segment_iters", None))
     opts["profile"] = _apply_profile(opts.pop("profile", False))
+    opts["fleet"] = _apply_fleet(opts.pop("fleet", None))
     return opts
+
+
+def _apply_fleet(n):
+    """Deploy --fleet: the device checkers read the fleet opt-in from
+    JTPU_FLEET (jepsen_tpu.fleet), so the flag exports it for every
+    check this process runs."""
+    if n is not None:
+        import os
+        os.environ["JTPU_FLEET"] = str(n)
+    return n
 
 
 def _apply_segment_iters(seg):
@@ -561,9 +579,12 @@ def watch_cmd() -> dict:
 
         from jepsen_tpu.obs import fleet
         dirs = list(opts["fleet"])
-        missing = [d for d in dirs if not _os.path.isdir(d)]
-        if missing:
-            print(f"no such host directory: {missing[0]}",
+        # ALL dirs missing at start is a typo'd invocation; SOME
+        # missing (or vanishing mid-poll) is a dead host, which the
+        # fleet view renders as a host=dead row instead of exiting —
+        # the whole point of watching a fleet is seeing hosts die
+        if not any(_os.path.isdir(d) for d in dirs):
+            print(f"no such host directory: {dirs[0]}",
                   file=sys.stderr)
             return INVALID_ARGS
         while True:

@@ -45,8 +45,21 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from jepsen_tpu.obs import trace as obs_trace
 
-#: The per-host artifacts a fleet merge consumes.
-HOST_ARTIFACTS = ("trace.jsonl", "metrics.json", "progress.json")
+#: The per-host artifacts a fleet merge consumes. heartbeat.json is the
+#: elastic fleet worker's liveness beacon (jepsen_tpu.fleet writes it
+#: next to the observatory artifacts); its age drives the host=dead
+#: rendering below.
+HOST_ARTIFACTS = ("trace.jsonl", "metrics.json", "progress.json",
+                  "heartbeat.json")
+
+#: The heartbeat artifact's filename (duplicated from
+#: jepsen_tpu.fleet.HEARTBEAT_NAME — this module stays jax-free and
+#: must not import the fleet scheduler).
+HEARTBEAT_NAME = "heartbeat.json"
+
+#: Heartbeat age (seconds) past which a host renders as dead in the
+#: fleet view (matches jepsen_tpu.fleet's JTPU_FLEET_DEAD_S default).
+HEARTBEAT_DEAD_S = 10.0
 
 #: Anchor span names tried in order; the first present in EVERY host's
 #: trace wins. The cross-host device launches are true barriers; the
@@ -56,18 +69,25 @@ DEFAULT_ANCHORS = ("checker.device.sharded", "checker.device.batch",
 
 
 def is_host_dir(d: str) -> bool:
-    return any(os.path.exists(os.path.join(d, a))
-               for a in HOST_ARTIFACTS)
+    try:
+        return any(os.path.exists(os.path.join(d, a))
+                   for a in HOST_ARTIFACTS)
+    except OSError:  # dir vanished mid-probe
+        return False
 
 
 def discover_hosts(run_dir: str) -> List[str]:
     """Host artifact directories under a run directory: immediate
     subdirectories carrying any host artifact, else the run directory
-    itself (a single-host run is a one-host fleet)."""
-    if not os.path.isdir(run_dir):
+    itself (a single-host run is a one-host fleet). Tolerates the run
+    dir vanishing mid-scan (a dead fleet is rendered, not raised)."""
+    try:
+        entries = (os.listdir(run_dir) if os.path.isdir(run_dir)
+                   else [])
+    except OSError:
         return []
     subs = sorted(
-        os.path.join(run_dir, e) for e in os.listdir(run_dir)
+        os.path.join(run_dir, e) for e in entries
         if os.path.isdir(os.path.join(run_dir, e))
         and not os.path.islink(os.path.join(run_dir, e))
         and is_host_dir(os.path.join(run_dir, e)))
@@ -78,28 +98,50 @@ def discover_hosts(run_dir: str) -> List[str]:
 
 def read_host(d: str, host: Optional[str] = None) -> Dict[str, Any]:
     """One host's artifact set: ``{"host", "dir", "trace",
-    "trace-stats", "metrics", "progress"}`` with absent artifacts as
-    empty/None."""
+    "trace-stats", "metrics", "progress", "heartbeat", "missing"}``
+    with absent artifacts as empty/None.
+
+    A host dir that has VANISHED (the host died and its scratch was
+    reaped, or an NFS mount dropped) or goes torn mid-poll must come
+    back as a ``missing`` host, never an exception — the fleet view's
+    whole job is rendering dead hosts next to live ones."""
     host = host or os.path.basename(os.path.normpath(d)) or d
     out: Dict[str, Any] = {"host": host, "dir": d, "trace": [],
                            "trace-stats": None, "metrics": None,
-                           "progress": None}
-    tpath = os.path.join(d, obs_trace.TRACE_NAME)
-    if os.path.exists(tpath):
-        try:
-            out["trace"], out["trace-stats"] = obs_trace.read_trace(tpath)
-        except OSError:
-            pass
-    mpath = os.path.join(d, "metrics.json")
+                           "progress": None, "heartbeat": None,
+                           "missing": False}
     try:
-        with open(mpath) as f:
-            doc = json.load(f)
-        if isinstance(doc, dict):
-            out["metrics"] = doc
-    except (OSError, ValueError):
-        pass
-    from jepsen_tpu.obs import observatory
-    out["progress"] = observatory.read_progress(d)
+        if not os.path.isdir(d):
+            out["missing"] = True
+            return out
+        tpath = os.path.join(d, obs_trace.TRACE_NAME)
+        if os.path.exists(tpath):
+            try:
+                out["trace"], out["trace-stats"] = \
+                    obs_trace.read_trace(tpath)
+            except (OSError, ValueError):
+                pass
+        mpath = os.path.join(d, "metrics.json")
+        try:
+            with open(mpath) as f:
+                doc = json.load(f)
+            if isinstance(doc, dict):
+                out["metrics"] = doc
+        except (OSError, ValueError):
+            pass
+        hpath = os.path.join(d, "heartbeat.json")
+        try:
+            with open(hpath) as f:
+                hb = json.load(f)
+            if isinstance(hb, dict):
+                out["heartbeat"] = hb
+        except (OSError, ValueError):
+            pass
+        from jepsen_tpu.obs import observatory
+        out["progress"] = observatory.read_progress(d)
+    except OSError:
+        # the dir went away between the isdir probe and a read
+        out["missing"] = True
     return out
 
 
@@ -219,21 +261,40 @@ def merge(dirs: List[str],
                 for r in h["trace"]]
         recs.sort(key=lambda r: (r.get("tid", 0), r["ts"]))
         merged_trace.extend(recs)
+    import time as _time
     summary = []
     for h in hosts:
         p = h.get("progress") or {}
-        summary.append({
+        state = p.get("state")
+        hb_age = None
+        hb = h.get("heartbeat")
+        if hb and isinstance(hb.get("ts"), (int, float)):
+            hb_age = round(max(_time.time() - hb["ts"], 0.0), 1)
+        if h.get("missing"):
+            # the artifact dir itself vanished: the host is dead, and
+            # the fleet view must say so, not raise
+            state = "dead"
+        elif hb_age is not None and hb_age > HEARTBEAT_DEAD_S \
+                and state not in ("done",):
+            state = "dead"
+        row = {
             "host": h["host"],
-            "state": p.get("state"),
+            "state": state,
             "level": p.get("level"),
             "level-budget": p.get("level-budget"),
             "frontier-rows": p.get("frontier-rows"),
-            "imbalance": _gauge_value(h.get("metrics"),
-                                      "jtpu_shard_imbalance_ratio"),
+            "imbalance": (p.get("imbalance")
+                          if p.get("imbalance") is not None else
+                          _gauge_value(h.get("metrics"),
+                                       "jtpu_shard_imbalance_ratio")),
             "headroom": _gauge_value(h.get("metrics"),
                                      "jtpu_device_headroom_ratio"),
             "spans": len(h["trace"]),
-        })
+            "missing": bool(h.get("missing")),
+        }
+        if hb_age is not None:
+            row["heartbeat-age-s"] = hb_age
+        summary.append(row)
     return {"hosts": [h["host"] for h in hosts],
             "anchor": anchor, "offsets": offsets,
             "trace": merged_trace,
@@ -282,14 +343,22 @@ def format_fleet(merged: Dict[str, Any]) -> List[str]:
                     else ", clocks unaligned (no shared anchor span)"))
     for row in merged.get("summary", []):
         bits = []
+        if row.get("missing"):
+            lines.append(f"# fleet: {row['host']}: host=dead "
+                         f"(artifact dir vanished)")
+            continue
+        if row.get("state") == "dead":
+            bits.append("host=dead")
         if row.get("level") is not None:
             budget = row.get("level-budget")
             bits.append(f"level {row['level']}"
                         + (f"/{budget}" if budget else ""))
         if row.get("frontier-rows") is not None:
             bits.append(f"frontier {row['frontier-rows']} rows")
-        if row.get("state"):
+        if row.get("state") and row["state"] != "dead":
             bits.append(f"state={row['state']}")
+        if row.get("heartbeat-age-s") is not None:
+            bits.append(f"heartbeat {row['heartbeat-age-s']:g}s ago")
         bits.append("imbalance "
                     + (f"{row['imbalance']:.2f}x"
                        if row.get("imbalance") is not None else "n/a"))

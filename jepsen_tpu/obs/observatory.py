@@ -131,14 +131,22 @@ class Observatory:
                 seg_seconds: float, levels_delta: int, expansions: int,
                 rung=None, backend: Optional[str] = None,
                 headroom: Optional[float] = None,
-                warmup: bool = False) -> None:
+                warmup: bool = False,
+                imbalance: Optional[float] = None,
+                fleet: Optional[Dict[str, Any]] = None) -> None:
         """One segment boundary's worth of progress. ``expansions`` is
         the candidate configurations explored this segment (levels x
         expanded rows) — the configs-explored/s numerator. ``warmup``
         marks a segment whose wall time included XLA compilation: its
         level/ETA still publish, but it is excluded from the rate EWMA
         (a compile-inflated denominator would poison the ETA for many
-        segments of smoothing)."""
+        segments of smoothing). ``imbalance`` is the live
+        jtpu_shard_imbalance_ratio (max/mean live rows per shard) so
+        skew is visible DURING a sharded/fleet run, not only on bench's
+        ``# search:`` line; ``fleet`` is the elastic-fleet heartbeat
+        ({hosts, remeshes, steals} — jepsen_tpu.fleet piggybacks its
+        per-round state on this publication, which is exactly what the
+        fleet supervisor's host-loss detection reads back)."""
         if warmup:
             inst = einst = None
         else:
@@ -168,6 +176,10 @@ class Observatory:
                 p["backend"] = backend
             if headroom is not None:
                 p["headroom"] = round(float(headroom), 4)
+            if imbalance is not None:
+                p["imbalance"] = round(float(imbalance), 3)
+            if fleet is not None:
+                p["fleet"] = dict(fleet)
             p["levels-per-s"] = (round(self._rate, 3)
                                  if self._rate else None)
             p["configs-per-s"] = (round(self._exp_rate, 1)
@@ -314,6 +326,16 @@ def format_status(p: Optional[Dict[str, Any]]) -> str:
         bits.append(f"eta {p['eta-s']:g}s")
     if p.get("headroom") is not None:
         bits.append(f"headroom {100 * p['headroom']:.0f}%")
+    if p.get("imbalance") is not None:
+        bits.append(f"imbalance {p['imbalance']:.2f}x")
+    fl = p.get("fleet")
+    if fl:
+        fbit = f"fleet {fl.get('hosts')} host(s)"
+        if fl.get("remeshes"):
+            fbit += f" {fl['remeshes']} remesh(es)"
+        if fl.get("steals"):
+            fbit += f" {fl['steals']} steal(s)"
+        bits.append(fbit)
     if p.get("backend") and p["backend"] != "default":
         bits.append(str(p["backend"]))
     return "# watch: " + " | ".join(bits)

@@ -78,6 +78,11 @@ _PREEMPT_TOTAL = obs_metrics.counter(
     "jtpu_search_preemptive_halve_total",
     "pool halvings triggered by low device-memory headroom BEFORE any "
     "OOM fired (see JTPU_HEADROOM_MIN)")
+_DCN_TOTAL = obs_metrics.counter(
+    "jtpu_search_dcn_retries_total",
+    "cross-host collective / interconnect faults retried from their "
+    "checkpoint (the DCN failure class — distinct from OOM/wedge so a "
+    "slow interconnect degrades instead of wedging)")
 
 # ---------------------------------------------------------------------------
 # Failure taxonomy
@@ -93,6 +98,13 @@ WEDGE = "wedge"
 #: Plausibly-recoverable runtime errors (preemption, RPC resets,
 #: UNAVAILABLE): retry the same segment with jittered backoff.
 TRANSIENT = "transient"
+#: A cross-host collective that timed out or aborted mid-flight (DCN
+#: gather/all-reduce, distributed-runtime barrier, NCCL ring): retried
+#: like a transient (bounded, jittered) but CLASSIFIED apart from
+#: OOM/wedge so a slow interconnect degrades visibly instead of being
+#: mistaken for a sick chip — the elastic fleet layer
+#: (jepsen_tpu.fleet) keys its per-host retry budget on this class.
+DCN = "dcn"
 #: Everything else — a programming error or corrupted state: rethrow.
 FATAL = "fatal"
 
@@ -110,9 +122,20 @@ _TRANSIENT_MARKERS = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED",
                       "CANCELLED", "preempt", "Connection reset",
                       "Socket closed", "temporarily unavailable")
 
+#: Substrings marking a cross-host collective / interconnect fault
+#: (checked BEFORE the transient markers: "all-reduce DEADLINE_EXCEEDED"
+#: is a DCN event, not a generic transient). The jax distributed
+#: runtime and the XLA collective layer surface these as text.
+_DCN_MARKERS = ("collective", "all-reduce", "all_reduce", "all-gather",
+                "all_gather", "AllReduce", "AllGather", "NCCL",
+                "DCN", "cross-host", "cross_host", "barrier timed out",
+                "coordination service", "distributed runtime",
+                "heartbeat")
+
 
 def classify_failure(exc: BaseException) -> str:
-    """Map an exception to its failure class (OOM/WEDGE/TRANSIENT/FATAL).
+    """Map an exception to its failure class
+    (OOM/WEDGE/DCN/TRANSIENT/FATAL).
 
     Works on error *text* as well as types: the jax runtime surfaces
     device faults as XlaRuntimeError with a status-code prefix, and this
@@ -124,6 +147,8 @@ def classify_failure(exc: BaseException) -> str:
     text = f"{type(exc).__name__}: {exc}"
     if any(m in text for m in _OOM_MARKERS):
         return OOM
+    if any(m in text for m in _DCN_MARKERS):
+        return DCN
     if isinstance(exc, (ConnectionError, TimeoutError, InterruptedError)):
         return TRANSIENT
     if any(m in text for m in _TRANSIENT_MARKERS):
@@ -647,11 +672,11 @@ def _supervised_check_packed(p: PackedHistory, kernel: KernelSpec,
                         "%.2fs)", int(carry[8]), cap_eff, delay)
                     _BACKOFF_SECONDS.inc(delay)
                     time.sleep(delay)
-                elif cls == TRANSIENT:
+                elif cls in (TRANSIENT, DCN):
                     transients += 1
-                    _TRANSIENT_TOTAL.inc()
+                    (_DCN_TOTAL if cls == DCN else _TRANSIENT_TOTAL).inc()
                     if transients > policy.max_retries:
-                        trail.append({**ctx, "event": TRANSIENT,
+                        trail.append({**ctx, "event": cls,
                                       "outcome": "retries-exhausted",
                                       "error": _errstr(e)})
                         try:
@@ -660,14 +685,14 @@ def _supervised_check_packed(p: PackedHistory, kernel: KernelSpec,
                             pass
                         raise
                     delay = policy.delay(transients)
-                    trail.append({**ctx, "event": TRANSIENT,
+                    trail.append({**ctx, "event": cls,
                                   "outcome": f"retry-{transients}",
                                   "backoff-s": round(delay, 3),
                                   "error": _errstr(e)})
                     log.warning(
-                        "transient device failure (%s); retrying the "
+                        "%s device failure (%s); retrying the "
                         "segment from its checkpoint in %.2fs",
-                        _errstr(e), delay)
+                        cls, _errstr(e), delay)
                     _BACKOFF_SECONDS.inc(delay)
                     time.sleep(delay)
                 else:

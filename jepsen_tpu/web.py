@@ -326,10 +326,15 @@ class Handler(BaseHTTPRequestHandler):
                    if s.get("imbalance") is not None else "—")
             head = (f"{100 * s['headroom']:.0f}%"
                     if s.get("headroom") is not None else "—")
+            state = str(s.get("state") or "—")
+            if s.get("missing"):
+                state = "dead (dir vanished)"
+            elif s.get("heartbeat-age-s") is not None:
+                state += f" (hb {s['heartbeat-age-s']:g}s ago)"
             rows.append(
                 "<tr>"
                 f"<td>{html.escape(str(s['host']))}</td>"
-                f"<td>{html.escape(str(s.get('state') or '—'))}</td>"
+                f"<td>{html.escape(state)}</td>"
                 f"<td>{html.escape(level)}</td>"
                 f"<td>{html.escape(str(s.get('frontier-rows') if s.get('frontier-rows') is not None else '—'))}</td>"
                 f"<td>{html.escape(imb)}</td>"
@@ -499,6 +504,11 @@ def _progress_strip_html(rel: str) -> str:
         "==null?'?':p['frontier-rows'])+' rows','seg '+p.segments];\n"
         " if(p['levels-per-s'])bits.push(p['levels-per-s']+"
         "' levels/s');\n"
+        " if(p.imbalance!=null)bits.push('imbalance '+p.imbalance+"
+        "'x');\n"
+        " if(p.fleet)bits.push('fleet '+p.fleet.hosts+' host(s)'+"
+        "(p.fleet.remeshes?' '+p.fleet.remeshes+' remesh(es)':'')+"
+        "(p.fleet.steals?' '+p.fleet.steals+' steal(s)':''));\n"
         " if(p['eta-s']!=null&&p.state!=='done')bits.push('eta '+"
         "p['eta-s']+'s');\n"
         " if(p.state==='done')bits.push('done valid='+p.valid);\n"

@@ -50,6 +50,12 @@ def pytest_configure(config):
         "(tests/test_prof.py): jax.profiler capture scoping, the "
         "JTPU_PROF kill switch, device-trace parse/merge, kernel "
         "rollups, compile-cache accounting, and the fleet merge")
+    config.addinivalue_line(
+        "markers", "fleet: elastic fleet layer tests "
+        "(tests/test_fleet.py): pool split/merge at the merge-sort "
+        "barrier, host-loss re-meshing, work-stealing rebalance, "
+        "join admission, the DCN failure class, changed-mesh "
+        "checkpoint resume, and the JTPU_FLEET kill switch")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -69,9 +69,14 @@ def _median(vals):
 
 #: Phase axes a regression is attributed to (dotted paths into the
 #: record; bench.py emits the `compile` sub-record and `transfer_mb`
-#: from the registry deltas around its cold+warm checks).
+#: from the registry deltas around its cold+warm checks, and the
+#: `search` sub-record's rebalance axes — remesh/steal counts and the
+#: peak shard-imbalance ratio — so an elastic-fleet regression is
+#: attributed like the compile/execute phases are).
 ATTRIBUTION_AXES = ("compile_s", "execute_s", "transfer_mb",
-                    "compile.cold_compile_s", "compile.warm_execute_s")
+                    "compile.cold_compile_s", "compile.warm_execute_s",
+                    "search.remesh_count", "search.steal_count",
+                    "search.imbalance_ratio")
 
 
 def _get_path(rec, path):

@@ -43,6 +43,12 @@ qualify a new accelerator image before trusting it with long runs):
                    rejects each with PLAN-OOM / PLAN-SHARD-INDIVISIBLE
                    BEFORE any jit factory is invoked; the clean
                    configuration still checks valid
+  fleet-host-kill  SIGKILL one host of a 2-process (CPU-simulated DCN)
+                   elastic-fleet pool-sharded search mid-rung: the
+                   survivor re-meshes at the merge barrier
+                   (remesh-to-1-hosts trail event), finishes the
+                   search, and the verdict matches the single-host
+                   baseline AND the CPU oracle
 
 Usage: python tools/chaos_matrix.py [--seed N] [--only NAME ...]
 Exit code 0 iff every selected scenario passes — nonzero on any
@@ -777,6 +783,63 @@ def scenario_plan_rejects(seed):
     return ok, ("; ".join(details) + f" over {len(h)} ops")
 
 
+def scenario_fleet_host_kill(seed):
+    """SIGKILL one worker of a 2-process elastic-fleet search (the
+    CPU-simulated DCN mesh: each host is a real OS process running
+    shard segments over a file protocol) mid-rung. The survivor must
+    detect the loss (dead pid / stale heartbeat), re-mesh at the merge
+    barrier with a ``remesh-to-1-hosts`` trail event, and finish with
+    a verdict identical to the uninterrupted single-host baseline and
+    the CPU oracle."""
+    import signal
+    import tempfile
+
+    from jepsen_tpu import fleet
+
+    p, kernel = _packed(seed)
+    base = supervised_check_packed(p, kernel, segment_iters=4)
+    oracle = check_packed(p, kernel)
+    if base["valid"] != oracle["valid"]:
+        return False, "single-host baseline disagrees with the oracle"
+    d = tempfile.mkdtemp(prefix="jtpu-fleet-")
+    hosts = [fleet.ProcHost("w0", os.path.join(d, "w0")),
+             fleet.ProcHost("w1", os.path.join(d, "w1"))]
+    killed = []
+
+    def chaos(round_idx, fl):
+        if round_idx == 2 and fl.hosts[1].state == "live":
+            os.kill(fl.hosts[1].pid, signal.SIGKILL)
+            killed.append(fl.hosts[1].pid)
+
+    # SIGKILL detection rides the pid poll (instant), not heartbeat
+    # staleness, so the default JTPU_FLEET_DEAD_S stays — a loaded CI
+    # box must not misread a slow-beating survivor as a second death
+    out = fleet.check_packed_fleet(p, kernel, hosts=hosts,
+                                   segment_iters=2, on_round=chaos)
+    if not killed:
+        return False, "search finished before the kill round"
+    evs = [e.get("outcome") for e in out.get("attempts", [])]
+    details = []
+    ok = True
+    if out.get("valid") != base["valid"]:
+        ok = False
+        details.append(f"verdict {out.get('valid')!r} != baseline "
+                       f"{base['valid']!r}")
+    else:
+        details.append(f"verdict {out['valid']} == single-host "
+                       f"baseline == oracle")
+    if "remesh-to-1-hosts" not in evs:
+        ok = False
+        details.append(f"no remesh-to-1-hosts event in {evs}")
+    else:
+        details.append("remesh-to-1-hosts after SIGKILL")
+    lost = (out.get("fleet") or {}).get("hosts-lost")
+    if lost != 1:
+        ok = False
+        details.append(f"hosts-lost={lost}, want 1")
+    return ok, "; ".join(details)
+
+
 SCENARIOS = (
     ("oom", scenario_oom),
     ("wedge", scenario_wedge),
@@ -789,6 +852,7 @@ SCENARIOS = (
     ("watched-kill", scenario_watched_kill),
     ("prof-kill", scenario_prof_kill),
     ("plan-rejects", scenario_plan_rejects),
+    ("fleet-host-kill", scenario_fleet_host_kill),
 )
 
 
