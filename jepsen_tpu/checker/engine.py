@@ -1,0 +1,357 @@
+"""Explicit search-executable engine: the warm heart of `jtpu serve`.
+
+Before this module the compiled search executables lived in three
+``functools.lru_cache``'d factories inside :mod:`jepsen_tpu.checker.tpu`
+(``_jit_single`` / ``_jit_segment`` / ``_jit_batch``) — adequate for a
+one-shot CLI process, but invisible and unmanageable for a long-lived
+daemon: no way to enumerate what is warm, warm a shape ahead of the
+first tenant request, persist compilations across restarts, or evict.
+BENCH_r02 measured the stake: 271 s of cold XLA warm-up against an
+8.85 s check.
+
+The :class:`Engine` makes the executable cache an explicit object:
+
+* **Same keying, same executables** — :meth:`jit_single` /
+  :meth:`jit_segment` / :meth:`jit_batch` take exactly the arguments the
+  lru_cache'd factories took and build exactly the same ``jax.jit``
+  closures; the tpu-module functions now delegate here, so every
+  existing call site (resilience, fleet, plan's zero-compile probes,
+  chaos monkeypatches) is unchanged in behavior.
+* **Shape buckets** — :meth:`bucket_key` names the padded-shape bucket a
+  packed history lands in (required-width bucket, crashed width, window
+  bucket): the unit of warming, of the serve daemon's circuit breaker,
+  and of the P-compositionality argument for sharing one warm
+  executable across many tenants' histories.
+* **Ahead-of-time warming** — :meth:`warm` compiles a bucket's
+  escalation ladder before any request needs it: ``lower().compile()``
+  per rung (feeding XLA's persistent compilation cache when one is
+  configured) plus one trivially-complete execution (``n_required=0``
+  finishes at level 0) so the in-process jit cache is hot too and later
+  timed calls account as ``jtpu_compile_cache_hit_total``, not cold.
+  The bucket universe comes from :mod:`jepsen_tpu.checker.plan`'s
+  deterministic enumeration — the daemon warms exactly what the search
+  could run.
+* **Persistent on-disk compilation cache** —
+  :func:`enable_persistent_cache` points ``jax_compilation_cache_dir``
+  at a directory, so a SIGKILLed daemon restarts into warm compiles
+  instead of re-paying XLA (`jtpu_persistent_cache_hit_total` proves
+  it moved).
+
+Nothing here compiles at import time, and a process that never touches
+the daemon sees identical behavior to the lru_cache era (asserted by
+tests/test_serve.py's kill-switch identity tests).
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from jepsen_tpu.checker import tpu as T
+from jepsen_tpu.obs import metrics as obs_metrics
+
+log = logging.getLogger("jepsen.engine")
+
+_WARMED_SHAPES = obs_metrics.counter(
+    "jtpu_engine_warmed_shapes_total",
+    "executable shapes warmed ahead of time by an Engine (AOT "
+    "lower().compile() + trivial execution)")
+_WARM_SECONDS = obs_metrics.counter(
+    "jtpu_engine_warm_seconds_total",
+    "wall seconds spent in ahead-of-time Engine warming")
+_ENGINE_BUILDS = obs_metrics.counter(
+    "jtpu_engine_builds_total",
+    "jit closures constructed by an Engine (first use of a cache key)")
+_ENGINE_HITS = obs_metrics.counter(
+    "jtpu_engine_cache_hits_total",
+    "Engine executable-cache hits (the explicit table that replaced "
+    "the lru_cache'd factories)")
+
+#: Default executable-table capacity — matches the lru_cache(maxsize=64)
+#: the factories used, so eviction behavior is unchanged for CLI runs.
+DEFAULT_MAX_ENTRIES = 64
+
+
+class Engine:
+    """An explicit, thread-safe cache of compiled search executables.
+
+    One Engine per process is the normal shape (:func:`default_engine`);
+    the serve daemon constructs its own so tests can assert warm/cold
+    accounting in isolation. Entries are LRU-evicted past
+    ``max_entries`` exactly like the ``functools.lru_cache(maxsize=64)``
+    they replace.
+    """
+
+    def __init__(self, name: str = "default",
+                 max_entries: int = DEFAULT_MAX_ENTRIES):
+        self.name = name
+        self.max_entries = int(max_entries)
+        self._lock = threading.Lock()
+        self._fns: "collections.OrderedDict[tuple, Any]" = \
+            collections.OrderedDict()
+        #: bucket_key -> {"shapes", "seconds", "ts"} for warmed buckets.
+        self._warm: Dict[tuple, Dict[str, Any]] = {}
+        self.builds = 0
+        self.hits = 0
+
+    def __repr__(self):
+        return (f"<Engine {self.name!r} entries={len(self._fns)} "
+                f"builds={self.builds} hits={self.hits} "
+                f"warm-buckets={len(self._warm)}>")
+
+    # -- executable cache ---------------------------------------------------
+
+    def _get(self, key: tuple, build: Callable[[], Any]):
+        with self._lock:
+            fn = self._fns.get(key)
+            if fn is not None:
+                self._fns.move_to_end(key)
+                self.hits += 1
+                _ENGINE_HITS.inc()
+                return fn
+        built = build()          # outside the lock: jit() is cheap but
+        with self._lock:         # must not serialize unrelated lookups
+            fn = self._fns.get(key)
+            if fn is None:
+                self._fns[key] = fn = built
+                self.builds += 1
+                _ENGINE_BUILDS.inc()
+                while len(self._fns) > self.max_entries:
+                    self._fns.popitem(last=False)
+            else:
+                self.hits += 1
+                _ENGINE_HITS.inc()
+        return fn
+
+    def jit_single(self, kernel_id: int, capacity: int, window: int,
+                   expand: Optional[int] = None, unroll: int = 1,
+                   shard_axis: Optional[str] = None):
+        """The monolithic single-history executable (one while_loop to
+        a verdict) — body identical to the pre-Engine ``_jit_single``."""
+        import jax
+        kernel = T._KERNELS_BY_ID[kernel_id]
+
+        def build():
+            def single(f, v1, v2, ro, fr, inv, ret, sm, cf, cv1, cv2,
+                       cinv, cps, nr, ini):
+                search = T._search_fn(kernel.step, f.shape[0],
+                                      cf.shape[0], capacity, window,
+                                      expand, unroll, shard_axis)
+                return search(f, v1, v2, ro, fr, inv, ret, sm, cf, cv1,
+                              cv2, cinv, cps, nr, ini)
+
+            return jax.jit(single)
+
+        return self._get(("single", kernel_id, capacity, window, expand,
+                          unroll, shard_axis), build)
+
+    def jit_segment(self, kernel_id: int, capacity: int, window: int,
+                    expand: Optional[int] = None, unroll: int = 1,
+                    shard_axis: Optional[str] = None):
+        """One bounded-iteration checkpointed segment (the supervised
+        mode's executable; traced seg_iters, so changing segment length
+        never recompiles) — body identical to ``_jit_segment``."""
+        import jax
+        kernel = T._KERNELS_BY_ID[kernel_id]
+
+        def build():
+            def seg(f, v1, v2, ro, fr, inv, ret, sm, cf, cv1, cv2, cinv,
+                    cps, nr, ini, seg_iters, carry):
+                search = T._search_fn(kernel.step, f.shape[0],
+                                      cf.shape[0], capacity, window,
+                                      expand, unroll, shard_axis,
+                                      segment=True)
+                return search(f, v1, v2, ro, fr, inv, ret, sm, cf, cv1,
+                              cv2, cinv, cps, nr, ini, seg_iters, carry)
+
+            return jax.jit(seg)
+
+        return self._get(("segment", kernel_id, capacity, window,
+                          expand, unroll, shard_axis), build)
+
+    def jit_batch(self, kernel_id: int, capacity: int, window: int,
+                  expand: Optional[int] = None, unroll: int = 1,
+                  tiebreak: str = "lex"):
+        """The vmapped keyed-batch executable — body identical to
+        ``_jit_batch``."""
+        import jax
+        kernel = T._KERNELS_BY_ID[kernel_id]
+
+        def build():
+            def batched(f, v1, v2, ro, fr, inv, ret, sm, cf, cv1, cv2,
+                        cinv, cps, nr, ini):
+                search = T._search_fn(kernel.step, f.shape[1],
+                                      cf.shape[1], capacity, window,
+                                      expand, unroll, tiebreak=tiebreak)
+                return jax.vmap(search)(
+                    f, v1, v2, ro, fr, inv, ret, sm, cf, cv1, cv2, cinv,
+                    cps, nr, ini)
+
+            return jax.jit(batched)
+
+        return self._get(("batch", kernel_id, capacity, window, expand,
+                          unroll, tiebreak), build)
+
+    # -- shape buckets ------------------------------------------------------
+
+    @staticmethod
+    def bucket_key(p, kernel=None) -> tuple:
+        """The padded-shape bucket a packed history lands in:
+        ``(kernel-name, breq, crash-width, window-bucket)``. Histories
+        in one bucket compile to (and share) the same executables —
+        the P-compositionality sharing the serve daemon leans on. The
+        crashed-set-overflow case (crash width None) gets its own
+        sentinel bucket; nothing compiles for it anyway."""
+        nr = max(int(p.n_required), 1)
+        breq = T._bucket(nr)
+        crw = T._crash_width(p.n - p.n_required)
+        wb = T._window_bucket(max(T._window_needed(p), 1)) \
+            if p.n_required else 32
+        kname = getattr(kernel, "name", None) or "kernel"
+        return (str(kname), breq, -1 if crw is None else crw, wb)
+
+    def warm_info(self, bucket: tuple) -> Optional[Dict[str, Any]]:
+        """Warm record for a bucket ({"shapes", "seconds", "ts"}), or
+        None when never warmed through this Engine."""
+        with self._lock:
+            rec = self._warm.get(bucket)
+            return dict(rec) if rec else None
+
+    def warm_buckets(self) -> list:
+        """The buckets this Engine has warmed, insertion-ordered."""
+        with self._lock:
+            return list(self._warm)
+
+    # -- ahead-of-time warming ---------------------------------------------
+
+    def warm(self, p, kernel, rungs: Optional[int] = None,
+             segment_iters: Optional[int] = None) -> Dict[str, Any]:
+        """Warm the escalation ladder for this history's shape bucket.
+
+        For each rung of the bucket universe (the same ladder
+        ``check_packed_tpu`` / the supervised search would escalate
+        through — :func:`jepsen_tpu.checker.tpu._ladder_for` at the
+        history's needed window, i.e. exactly the candidates
+        :func:`jepsen_tpu.checker.plan.enumerate_candidates` prices):
+
+        1. ``fn.lower(...).compile()`` — the ahead-of-time compile.
+           With a persistent compilation cache configured
+           (:func:`enable_persistent_cache`) this also writes the
+           executable to disk, so a restarted process re-warms from
+           cache instead of from XLA.
+        2. one trivially-complete execution (``n_required=0`` finishes
+           at level 0) — populates the in-process jit dispatch cache
+           and marks the shape executed, so the first real request in
+           the bucket accounts as ``jtpu_compile_cache_hit_total``.
+
+        Returns ``{"bucket", "shapes", "seconds", "already-warm"}``.
+        Idempotent per bucket: a warm bucket returns immediately."""
+        import jax
+        bucket = self.bucket_key(p, kernel)
+        with self._lock:
+            rec = self._warm.get(bucket)
+        if rec is not None:
+            return dict(rec, bucket=bucket, **{"already-warm": True})
+        t0 = time.perf_counter()
+        shapes = 0
+        cr = T._crash_width(p.n - p.n_required)
+        cols = (None if cr is None or p.n_required == 0
+                else T._split_packed(p, T._bucket(p.n_required), cr,
+                                     kernel))
+        if cols is not None:
+            cols = dict(cols)
+            cols["nr"] = np.int32(0)
+            full = T._ladder_for(T._window_needed(p))
+            ladder = full[:rungs] if rungs else full
+            seg = (segment_iters if segment_iters is not None
+                   else T._segment_config(None))
+            kid = T._kernel_key(kernel)
+            unroll = T._unroll_factor()
+            for cap, win, exp in ladder:
+                if seg:
+                    fn = self.jit_segment(kid, cap, win, exp, unroll)
+                    carry = T._carry0_host(cap, win, cols["cf"].shape[0],
+                                           cols["ini"], 0)
+                    args = ([cols[c] for c in T._COLS]
+                            + [np.int32(seg), carry])
+                    shape_key = ("segment", kid, cap, win, exp, unroll,
+                                 cols["f"].shape[0], cols["cf"].shape[0])
+                else:
+                    fn = self.jit_single(kid, cap, win, exp, unroll)
+                    args = [cols[c] for c in T._COLS]
+                    shape_key = ("single", kid, cap, win, exp, unroll,
+                                 cols["f"].shape[0], cols["cf"].shape[0])
+                try:
+                    # AOT compile: feeds the persistent cache; cheap to
+                    # follow with the trivial execution, which fills the
+                    # in-process dispatch cache for real calls.
+                    fn.lower(*args).compile()
+                except Exception:  # noqa: BLE001 — AOT is best-effort;
+                    pass           # the execution below still warms
+                jax.block_until_ready(fn(*args))
+                # the compile phase was just paid here: later timed
+                # calls at this shape are steady-state cache hits
+                T._EXECUTED_SHAPES.add(shape_key)
+                shapes += 1
+                _WARMED_SHAPES.inc()
+        secs = time.perf_counter() - t0
+        _WARM_SECONDS.inc(secs)
+        rec = {"shapes": shapes, "seconds": round(secs, 6),
+               "ts": time.time()}
+        with self._lock:
+            self._warm.setdefault(bucket, rec)
+        log.info("engine %s: warmed bucket %s (%d shape(s), %.2fs)",
+                 self.name, bucket, shapes, secs)
+        return dict(rec, bucket=bucket, **{"already-warm": False})
+
+
+# ---------------------------------------------------------------------------
+# Persistent on-disk compilation cache
+# ---------------------------------------------------------------------------
+
+
+def enable_persistent_cache(path: str) -> Optional[str]:
+    """Point XLA's persistent compilation cache at ``path`` so compiled
+    executables survive process death — the serve daemon's
+    restart-without-recompile story. Thresholds are dropped to zero so
+    even the fast CPU test kernels persist (the default min-compile-time
+    filter would skip them). Best-effort: returns the path on success,
+    None when this jax build has no persistent cache (the daemon then
+    still warms, just per-process)."""
+    try:
+        import jax
+        jax.config.update("jax_compilation_cache_dir", path)
+    except Exception as e:  # noqa: BLE001 — optional facility
+        log.warning("persistent compilation cache unavailable: %s", e)
+        return None
+    for knob, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                      ("jax_persistent_cache_min_entry_size_bytes", 0)):
+        try:
+            jax.config.update(knob, val)
+        except Exception:  # noqa: BLE001 — knob names vary by version
+            pass
+    return path
+
+
+# ---------------------------------------------------------------------------
+# The process-default engine (what the tpu-module factories delegate to)
+# ---------------------------------------------------------------------------
+
+_DEFAULT: Optional[Engine] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def default_engine() -> Engine:
+    """The process-global Engine behind ``_jit_single`` / ``_jit_segment``
+    / ``_jit_batch``. Created lazily — importing this module compiles
+    nothing."""
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        if _DEFAULT is None:
+            _DEFAULT = Engine("default")
+        return _DEFAULT

@@ -915,3 +915,19 @@ def seed_rung(capacity: int, window: int, expand: Optional[int],
     if cap != capacity:
         _PLAN_SEEDED.inc()
     return cap, exp, pred, limit
+
+
+def request_footprint(dims: PlanDims,
+                      kind: str = "segment") -> Optional[int]:
+    """Predicted device bytes of the CHEAPEST rung the supervised search
+    would run for these dims — the serve daemon's admission-control
+    unit: queued + in-flight request footprints are summed against the
+    device byte budget (:func:`plan_bytes_limit`), and a request that
+    would push the sum past it is answered 429 instead of being allowed
+    to OOM a shared fleet. None when the dims cannot plan at all
+    (crashed-set overflow — such a request goes UNKNOWN without device
+    time, so it costs no budget)."""
+    cands = enumerate_candidates(dims, kinds=(kind,))
+    if not cands:
+        return None
+    return int(footprint(cands[0])["total-bytes"])

@@ -46,7 +46,6 @@ caller finally falls back to the exact CPU search.
 
 from __future__ import annotations
 
-import functools
 import time as _hosttime
 from typing import Any, Dict, Optional, Sequence
 
@@ -920,23 +919,23 @@ def _unroll_factor(default: int = _UNROLL) -> int:
     return int(_os_environ_get("JTPU_UNROLL") or "0") or default
 
 
-@functools.lru_cache(maxsize=64)
+def _engine():
+    """The process-default executable Engine (checker/engine.py). The
+    lru_cache'd factories this module used to carry became Engine
+    methods — same keys, same jit closures — so a long-lived daemon can
+    enumerate, warm, and persist what these functions silently cached.
+    Imported lazily: importing this module must not build an Engine."""
+    from jepsen_tpu.checker import engine as engine_mod
+    return engine_mod.default_engine()
+
+
 def _jit_single(kernel_id: int, capacity: int, window: int,
                 expand: Optional[int] = None, unroll: int = 1,
                 shard_axis: Optional[str] = None):
-    kernel = _KERNELS_BY_ID[kernel_id]
-
-    def single(f, v1, v2, ro, fr, inv, ret, sm, cf, cv1, cv2, cinv,
-               cps, nr, ini):
-        search = _search_fn(kernel.step, f.shape[0], cf.shape[0],
-                            capacity, window, expand, unroll, shard_axis)
-        return search(f, v1, v2, ro, fr, inv, ret, sm, cf, cv1, cv2,
-                      cinv, cps, nr, ini)
-
-    return jax.jit(single)
+    return _engine().jit_single(kernel_id, capacity, window, expand,
+                                unroll, shard_axis)
 
 
-@functools.lru_cache(maxsize=64)
 def _jit_segment(kernel_id: int, capacity: int, window: int,
                  expand: Optional[int] = None, unroll: int = 1,
                  shard_axis: Optional[str] = None):
@@ -950,17 +949,8 @@ def _jit_segment(kernel_id: int, capacity: int, window: int,
     flavor of check_packed_sharded (every segment boundary is the global
     merge-sort barrier, so the host carry snapshot between segments IS a
     consistent cross-host checkpoint)."""
-    kernel = _KERNELS_BY_ID[kernel_id]
-
-    def seg(f, v1, v2, ro, fr, inv, ret, sm, cf, cv1, cv2, cinv,
-            cps, nr, ini, seg_iters, carry):
-        search = _search_fn(kernel.step, f.shape[0], cf.shape[0],
-                            capacity, window, expand, unroll,
-                            shard_axis, segment=True)
-        return search(f, v1, v2, ro, fr, inv, ret, sm, cf, cv1, cv2,
-                      cinv, cps, nr, ini, seg_iters, carry)
-
-    return jax.jit(seg)
+    return _engine().jit_segment(kernel_id, capacity, window, expand,
+                                 unroll, shard_axis)
 
 
 def _popcount32_host(a: np.ndarray) -> np.ndarray:
@@ -1075,22 +1065,11 @@ def _segment_config(segment_iters: Optional[int]) -> Optional[int]:
     return DEFAULT_SEGMENT_ITERS
 
 
-@functools.lru_cache(maxsize=64)
 def _jit_batch(kernel_id: int, capacity: int, window: int,
                expand: Optional[int] = None, unroll: int = 1,
                tiebreak: str = "lex"):
-    kernel = _KERNELS_BY_ID[kernel_id]
-
-    def batched(f, v1, v2, ro, fr, inv, ret, sm, cf, cv1, cv2, cinv,
-                cps, nr, ini):
-        search = _search_fn(kernel.step, f.shape[1], cf.shape[1],
-                            capacity, window, expand, unroll,
-                            tiebreak=tiebreak)
-        return jax.vmap(search)(
-            f, v1, v2, ro, fr, inv, ret, sm, cf, cv1, cv2, cinv, cps,
-            nr, ini)
-
-    return jax.jit(batched)
+    return _engine().jit_batch(kernel_id, capacity, window, expand,
+                               unroll, tiebreak)
 
 
 #: Max crashed ('info') ops per key (four crashed-mask words). Crash-
@@ -1743,44 +1722,13 @@ def warm_ladder(p: PackedHistory, kernel: KernelSpec,
                 rungs: Optional[int] = None) -> None:
     """Compile (and once-execute) every escalation rung for this history's
     padded shape, so a later timed check pays no compile cost regardless
-    of how far it escalates."""
+    of how far it escalates. Now a thin wrapper over
+    :meth:`jepsen_tpu.checker.engine.Engine.warm` — the Engine also
+    does the ahead-of-time ``lower().compile()`` (persistent-cache feed)
+    and records the bucket as warm."""
     from jepsen_tpu import accel
     accel.ensure_usable("warm_ladder")
-    cr = _crash_width(p.n - p.n_required)
-    cols = (None if cr is None
-            else _split_packed(p, _bucket(p.n_required), cr, kernel))
-    if cols is None:
-        return
-    # n_required=0 completes at level 0: the call compiles (and caches)
-    # the rung for this padded shape without paying a full search.
-    cols = dict(cols)
-    cols["nr"] = np.int32(0)
-    full = _ladder_for(_window_needed(p))
-    ladder = full[:rungs] if rungs else full
-    seg = _segment_config(None)
-    for cap, win, exp in ladder:
-        unroll = _unroll_factor()
-        if seg:
-            # warm the checkpointed-segment executable — the path a
-            # default (segmented) check actually runs
-            fn = _jit_segment(_kernel_key(kernel), cap, win, exp,
-                              unroll)
-            carry = _carry0_host(cap, win, cols["cf"].shape[0],
-                                 cols["ini"], 0)
-            jax.block_until_ready(
-                fn(*(cols[c] for c in _COLS), np.int32(seg), carry))
-            # the compile phase was just paid here: a later timed call
-            # at this shape is steady-state, and must be labeled so
-            _EXECUTED_SHAPES.add(
-                ("segment", _kernel_key(kernel), cap, win, exp, unroll,
-                 cols["f"].shape[0], cols["cf"].shape[0]))
-        else:
-            fn = _jit_single(_kernel_key(kernel), cap, win, exp,
-                             unroll)
-            jax.block_until_ready(fn(*(cols[c] for c in _COLS)))
-            _EXECUTED_SHAPES.add(
-                ("single", _kernel_key(kernel), cap, win, exp, unroll,
-                 cols["f"].shape[0], cols["cf"].shape[0]))
+    _engine().warm(p, kernel, rungs=rungs)
 
 
 def check_history_tpu(history: History, model: Model,

@@ -233,24 +233,95 @@ def single_test_cmd(test_fn: Callable[[dict], dict],
 
 
 def serve_cmd() -> dict:
-    """The 'serve' subcommand (cli.clj:278-293)."""
+    """The 'serve' subcommand (cli.clj:278-293): the results browser,
+    plus — with ``--check-daemon`` (or ``JTPU_SERVE=1``) — the
+    multi-tenant check daemon (:mod:`jepsen_tpu.serve`, doc/serve.md):
+    POST /check, GET /check/<id>, /healthz, /drain mounted on the same
+    server, with warm engines, an on-disk request journal, admission
+    control and per-bucket circuit breakers. Without the flag the
+    behavior is byte-identical to the pre-daemon serve command."""
 
     def build_parser():
-        p = Parser(prog="serve", description="Serve the results browser.")
+        p = Parser(prog="serve", description="Serve the results browser "
+                                             "(and, opted in, the check "
+                                             "daemon).")
         p.add_argument("-b", "--host", default="0.0.0.0")
         p.add_argument("-p", "--port", type=int, default=8080)
         p.add_argument("--store-root", default="store")
+        p.add_argument("--check-daemon", action="store_true",
+                       help="mount the multi-tenant check daemon "
+                            "(POST /check; equivalent to JTPU_SERVE=1; "
+                            "doc/serve.md)")
+        p.add_argument("--serve-dir", default=None, metavar="DIR",
+                       help="daemon directory: request journal, result "
+                            "files, heartbeat (default: "
+                            "<store-root>/serve)")
+        p.add_argument("--workers", type=int, default=None,
+                       help="check worker threads (JTPU_SERVE_WORKERS)")
+        p.add_argument("--queue-max", type=int, default=None,
+                       help="bounded-queue depth past which POST /check "
+                            "answers 429 (JTPU_SERVE_QUEUE)")
+        p.add_argument("--tenant-max", type=int, default=None,
+                       help="per-tenant queued-request quota "
+                            "(JTPU_SERVE_TENANT_MAX)")
+        p.add_argument("--deadline-s", type=float, default=None,
+                       help="default per-request deadline; an overrun "
+                            "returns :info/timeout "
+                            "(JTPU_SERVE_DEADLINE_S)")
+        p.add_argument("--compile-cache", default=None, metavar="DIR",
+                       help="persistent XLA compilation cache dir, so a "
+                            "restarted daemon re-warms from disk "
+                            "(JTPU_COMPILE_CACHE)")
+        p.add_argument("--serve-backend", default=None,
+                       choices=["cpu", "tpu"],
+                       help="checker backend for daemon requests "
+                            "(default: tpu — the warm device path)")
         return p
 
     def run(opts) -> int:
+        from jepsen_tpu import serve as serve_ns
         from jepsen_tpu import web
-        server = web.serve(host=opts["host"], port=opts["port"],
-                           root=opts["store_root"])
-        print(f"Listening on http://{opts['host']}:{server.server_port}/")
+        if not (opts.get("check_daemon") or serve_ns.serve_enabled()):
+            server = web.serve(host=opts["host"], port=opts["port"],
+                               root=opts["store_root"])
+            print(f"Listening on "
+                  f"http://{opts['host']}:{server.server_port}/")
+            try:
+                server.serve_forever()
+            except KeyboardInterrupt:
+                pass
+            return OK
+        import os as _os
+        cfg = serve_ns.ServeConfig(
+            root=opts.get("serve_dir")
+            or _os.path.join(opts["store_root"], "serve"))
+        if opts.get("workers") is not None:
+            cfg.workers = opts["workers"]
+        if opts.get("queue_max") is not None:
+            cfg.queue_max = opts["queue_max"]
+        if opts.get("tenant_max") is not None:
+            cfg.tenant_max = opts["tenant_max"]
+        if opts.get("deadline_s") is not None:
+            cfg.deadline_s = opts["deadline_s"] or None
+        if opts.get("compile_cache") is not None:
+            cfg.compile_cache = opts["compile_cache"]
+        if opts.get("serve_backend") is not None:
+            cfg.backend = opts["serve_backend"]
+        daemon, server = serve_ns.run_daemon(
+            cfg, host=opts["host"], port=opts["port"],
+            store_root=opts["store_root"])
+        print(f"Listening on http://{opts['host']}:{server.server_port}/"
+              f" (check daemon: POST /check, GET /check/<id>, /healthz, "
+              f"/drain)", flush=True)
         try:
-            server.serve_forever()
+            # graceful drain: POST /drain finishes in-flight work,
+            # leaves the queued remainder journaled, and releases this
+            # wait — the daemon exits 0 (the drain contract)
+            daemon.drained.wait()
         except KeyboardInterrupt:
-            pass
+            daemon.drain(timeout_s=30.0)
+        server.shutdown()
+        daemon.stop()
         return OK
 
     return {"serve": {"parser": build_parser, "run": run}}

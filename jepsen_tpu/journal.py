@@ -110,15 +110,18 @@ def batch_window_s() -> float:
         return DEFAULT_BATCH_MS / 1000.0
 
 
-def encode_record(op: Op) -> bytes:
-    """One WAL line for an op: crc-prefixed compact JSON."""
-    payload = json.dumps(op.to_dict(), separators=(",", ":"),
+def encode_json_record(doc: dict) -> bytes:
+    """One CRC'd WAL line for an arbitrary JSON document — the generic
+    flavor of :func:`encode_record` (the serve daemon's request journal
+    shares the op WAL's exact framing and torn-tail semantics)."""
+    payload = json.dumps(doc, separators=(",", ":"),
                          default=_json_default).encode("utf-8")
     return b"%08x " % (zlib.crc32(payload) & 0xFFFFFFFF) + payload + b"\n"
 
 
-def decode_record(line: bytes) -> Optional[Op]:
-    """One WAL line back to an Op; None if the line is torn/corrupt."""
+def decode_json_record(line: bytes) -> Optional[dict]:
+    """One CRC'd WAL line back to its JSON document; None when the line
+    is torn or corrupt (CRC mismatch, malformed JSON, non-dict)."""
     if len(line) < 10 or line[8:9] != b" ":
         return None
     crc, payload = line[:8], line[9:]
@@ -126,8 +129,49 @@ def decode_record(line: bytes) -> Optional[Op]:
         if int(crc, 16) != (zlib.crc32(payload) & 0xFFFFFFFF):
             return None
         d = json.loads(payload)
-        if not isinstance(d, dict) or "type" not in d:
-            return None
+    except (ValueError, TypeError):
+        return None
+    return d if isinstance(d, dict) else None
+
+
+def read_json_records(path: str) -> Tuple[list, dict]:
+    """Torn-tail-tolerant reader for a generic CRC'd-record journal:
+    returns ``(records, stats)`` with the same torn/corrupt contract as
+    :func:`read_wal` — an undecodable unterminated final line is the
+    crash-loss bound (``torn``), anything earlier is ``corrupt``."""
+    stats = {"records": 0, "torn": 0, "corrupt": 0}
+    with open(path, "rb") as f:
+        data = f.read()
+    lines = data.split(b"\n")
+    terminated = data.endswith(b"\n")
+    if lines and lines[-1] == b"":
+        lines.pop()
+    out = []
+    for i, line in enumerate(lines):
+        d = decode_json_record(line)
+        if d is not None:
+            out.append(d)
+            stats["records"] += 1
+        elif i == len(lines) - 1 and not terminated:
+            stats["torn"] += 1
+        else:
+            stats["corrupt"] += 1
+            log.warning("journal %s: dropping corrupt record at line %d",
+                        path, i + 1)
+    return out, stats
+
+
+def encode_record(op: Op) -> bytes:
+    """One WAL line for an op: crc-prefixed compact JSON."""
+    return encode_json_record(op.to_dict())
+
+
+def decode_record(line: bytes) -> Optional[Op]:
+    """One WAL line back to an Op; None if the line is torn/corrupt."""
+    d = decode_json_record(line)
+    if d is None or "type" not in d:
+        return None
+    try:
         return Op.from_dict(d)
     except (ValueError, TypeError, KeyError):
         return None

@@ -305,6 +305,23 @@ def format_status(p: Optional[Dict[str, Any]]) -> str:
     and the run --watch stderr ticker."""
     if not p:
         return "# watch: no search progress published yet"
+    if p.get("serve") is not None:
+        # the check daemon's heartbeat (jepsen_tpu.serve publishes the
+        # same progress.json shape into its own directory, so `watch
+        # --store <serve-dir>` and /live/<serve-dir> follow the queue
+        # the way they follow a search)
+        s = p["serve"]
+        bits = [f"queue {s.get('queue-depth', 0)}",
+                f"inflight {s.get('inflight', 0)}",
+                f"done {s.get('completed', 0)}",
+                f"rejected {s.get('rejected', 0)}"]
+        if s.get("breakers-open"):
+            bits.append(f"breakers-open {s['breakers-open']}")
+        if s.get("warm-buckets") is not None:
+            bits.append(f"warm {s['warm-buckets']} bucket(s)")
+        if p.get("state") and p["state"] != "serving":
+            bits.append(str(p["state"]))
+        return "# serve: " + " | ".join(bits)
     budget = p.get("level-budget") or 0
     level = p.get("level") or 0
     pct = f" ({100 * level // budget}%)" if budget else ""

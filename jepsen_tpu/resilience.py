@@ -156,6 +156,28 @@ def classify_failure(exc: BaseException) -> str:
     return FATAL
 
 
+def result_failure_class(result: Optional[Dict[str, Any]]
+                         ) -> Optional[str]:
+    """The dominant failure class of a FINISHED check result — the seam
+    the serve daemon's per-bucket circuit breaker classifies through
+    (doc/serve.md): raised checks carry ``error-class`` (check_safe),
+    supervised searches that aborted record a ``gave-up`` trail event
+    with its class, and a clean (or merely escalated) result is None.
+    Retried-and-survived OOMs deliberately do NOT count: the taxonomy's
+    whole point is that those degrade instead of failing."""
+    if not isinstance(result, dict):
+        return None
+    cls = result.get("error-class")
+    if cls in (OOM, WEDGE, DCN, TRANSIENT, FATAL):
+        return cls
+    for ev in reversed(result.get("attempts") or []):
+        if isinstance(ev, dict) and ev.get("outcome") == "gave-up":
+            c = ev.get("event")
+            if c in (OOM, WEDGE, DCN, TRANSIENT, FATAL):
+                return c
+    return None
+
+
 def _env_float(name: str, default: float) -> float:
     v = os.environ.get(name)
     if not v:

@@ -1,0 +1,916 @@
+"""``jtpu serve`` — a crash-safe, multi-tenant checker daemon.
+
+ROADMAP item 1 ("checker-as-a-service"): today every ``run`` /
+``recover`` / ``analyze`` pays cold XLA compiles that dwarf the search
+itself (BENCH_r02: 271 s warm-up vs 8.85 s check). This module keeps one
+process alive with **warm engines** — an explicit
+:class:`jepsen_tpu.checker.engine.Engine` whose executables (and, with a
+persistent compilation cache, whose XLA binaries) outlive any single
+request — and lets many tenants POST histories at it over HTTP, the
+long-lived front-end Jepsen's own ``serve-cmd`` (SURVEY §1 L6) is the
+precedent for. *Faster linearizability checking via P-compositionality*
+(arXiv:1504.00204) is why sharing works: independent histories of one
+shape bucket are independent sub-problems for the same warm executable.
+
+Robustness is the headline, piece by piece:
+
+* **Crash safety** — every accepted request is journaled to an on-disk
+  WAL (``serve.wal``, the CRC'd line format of
+  :mod:`jepsen_tpu.journal`, fsync per record) BEFORE it is queued. A
+  SIGKILLed daemon restarts, replays the journal, and re-runs every
+  accepted-but-unfinished request; verdicts are identical to the
+  offline ``analyze`` path because execution IS that path
+  (``linearizable`` + ``check_safe`` on the reconstructed history).
+* **Admission control + backpressure** — a bounded queue (429 +
+  ``Retry-After`` past ``queue_max``), per-tenant quotas (one tenant
+  cannot fill the queue), and a byte budget: each request's
+  plan-predicted footprint (:func:`jepsen_tpu.checker.plan.
+  request_footprint`) is summed over queued + in-flight work against
+  the PR-5 device byte budget (:func:`~jepsen_tpu.checker.plan.
+  plan_bytes_limit`), and live device headroom below the floor rejects
+  too — the daemon refuses work it would OOM on, instead of accepting
+  and dying.
+* **Fair dequeue** — round-robin across tenants, FIFO within one: a
+  tenant posting dense 10k-op histories cannot starve the tutorial
+  tenant behind it.
+* **Per-request deadlines** — a request that overruns its deadline
+  returns ``{"valid": "unknown", "error": ":info/timeout"}`` (the
+  worker is abandoned exactly like a wedged device segment) instead of
+  hanging its tenant and everyone queued behind it.
+* **Per-bucket circuit breaker** — repeated OOM/wedge-class failures
+  (classified via :mod:`jepsen_tpu.resilience`'s taxonomy) on one shape
+  bucket trip that bucket open: new requests in it get 503 +
+  ``Retry-After`` while every other bucket keeps serving. After a
+  jittered cooldown the breaker goes half-open and admits one probe;
+  success closes it, failure re-opens with doubled cooldown.
+* **Graceful drain** — ``POST /drain`` stops admission, finishes
+  in-flight work, leaves the still-queued remainder journaled for the
+  next incarnation, and lets the CLI exit 0.
+
+HTTP API (grown onto :mod:`jepsen_tpu.web`'s ThreadingHTTPServer — the
+results browser, ``/metrics``, ``/live`` and ``/trace`` stay mounted):
+
+* ``POST /check`` — body ``{"tenant", "model", "history": [op dicts],
+  "deadline-s"?}``; 202 ``{"id", "state"}``, 400 (malformed history /
+  unknown model), 429 (+``Retry-After``: queue, quota, footprint,
+  headroom), 503 (+``Retry-After``: breaker open, draining).
+* ``GET /check/<id>`` — ``{"state": queued|running|done, "result"?}``.
+* ``POST /drain`` — finish in-flight, journal the rest, report counts.
+* ``GET /healthz`` — queue depth, tenants, breakers, engine warm state.
+
+Kill switch: nothing in this module runs unless the daemon is started
+(``python -m jepsen_tpu serve --check-daemon`` or ``JTPU_SERVE=1``);
+with it unused every existing CLI path is byte-identical (asserted by
+tests/test_serve.py).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import random
+import threading
+import time
+from collections import OrderedDict, deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+from jepsen_tpu import journal as journal_ns
+from jepsen_tpu.history import History
+from jepsen_tpu.obs import metrics as obs_metrics
+
+log = logging.getLogger("jepsen.serve")
+
+#: The request journal's filename inside the daemon directory.
+WAL_NAME = "serve.wal"
+
+#: The daemon's heartbeat artifact (same shape as a run's progress.json,
+#: so `watch --store <dir>` and /live/<dir> follow the queue).
+PROGRESS_NAME = "progress.json"
+
+_QUEUE_DEPTH = obs_metrics.gauge(
+    "jtpu_serve_queue_depth",
+    "requests queued (all tenants) in the check daemon")
+_INFLIGHT = obs_metrics.gauge(
+    "jtpu_serve_inflight", "requests currently being checked")
+_ADMITTED = obs_metrics.counter(
+    "jtpu_serve_admitted_total",
+    "requests accepted past admission control, labeled tenant")
+_REJECTED = obs_metrics.counter(
+    "jtpu_serve_rejected_total",
+    "requests refused by admission control, labeled reason "
+    "(queue-full|tenant-quota|footprint|headroom|breaker-open|draining"
+    "|malformed|bad-request)")
+_COMPLETED = obs_metrics.counter(
+    "jtpu_serve_completed_total",
+    "requests checked to a verdict, labeled valid")
+_TIMEOUTS = obs_metrics.counter(
+    "jtpu_serve_deadline_timeouts_total",
+    "requests answered :info/timeout by the per-request deadline")
+_REPLAYED = obs_metrics.counter(
+    "jtpu_serve_replayed_total",
+    "journaled requests re-queued by restart replay")
+_BREAKERS_OPEN = obs_metrics.gauge(
+    "jtpu_serve_breakers_open",
+    "shape-bucket circuit breakers currently open")
+_QUEUE_WAIT = obs_metrics.histogram(
+    "jtpu_serve_queue_wait_seconds",
+    "seconds a request spent queued before a worker picked it up",
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+             60.0, 300.0))
+
+
+def serve_enabled() -> bool:
+    """The JTPU_SERVE opt-in: truthy values mount the check daemon on
+    the `serve` subcommand without the --check-daemon flag. Default
+    off — the results browser alone, byte-identical to the pre-daemon
+    CLI."""
+    return os.environ.get("JTPU_SERVE", "").strip().lower() in (
+        "1", "true", "yes", "on")
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    if not v:
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    if not v:
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        return default
+
+
+@dataclass
+class ServeConfig:
+    """The daemon's knob set (doc/serve.md has the operator table).
+    Every default reads its JTPU_SERVE_* env twin so deployments tune
+    without code."""
+
+    root: str = "store/serve"          # WAL + results + heartbeat dir
+    workers: int = field(
+        default_factory=lambda: _env_int("JTPU_SERVE_WORKERS", 1))
+    queue_max: int = field(
+        default_factory=lambda: _env_int("JTPU_SERVE_QUEUE", 64))
+    tenant_max: int = field(
+        default_factory=lambda: _env_int("JTPU_SERVE_TENANT_MAX", 16))
+    deadline_s: Optional[float] = field(
+        default_factory=lambda: _env_float(
+            "JTPU_SERVE_DEADLINE_S", 0.0) or None)
+    breaker_fails: int = field(
+        default_factory=lambda: _env_int("JTPU_SERVE_BREAKER_FAILS", 3))
+    breaker_cooldown_s: float = field(
+        default_factory=lambda: _env_float(
+            "JTPU_SERVE_BREAKER_COOLDOWN_S", 5.0))
+    bytes_budget: Optional[int] = field(
+        default_factory=lambda: _env_int(
+            "JTPU_SERVE_BYTES_BUDGET", 0) or None)
+    headroom_min: float = field(
+        default_factory=lambda: _env_float(
+            "JTPU_SERVE_HEADROOM_MIN", 0.02))
+    warm: bool = field(
+        default_factory=lambda: os.environ.get(
+            "JTPU_SERVE_WARM", "1").strip() not in ("0", "false", "no"))
+    warm_rungs: int = field(
+        default_factory=lambda: _env_int("JTPU_SERVE_WARM_RUNGS", 1))
+    compile_cache: Optional[str] = field(
+        default_factory=lambda: os.environ.get(
+            "JTPU_COMPILE_CACHE") or None)
+    backend: str = field(
+        default_factory=lambda: os.environ.get(
+            "JTPU_SERVE_BACKEND", "tpu"))
+
+
+@dataclass
+class CheckRequest:
+    """One tenant's queued history. ``history`` stays raw op dicts so
+    the journal record IS the request — replay needs nothing else."""
+
+    id: str
+    tenant: str
+    model: str
+    history: list
+    deadline_s: Optional[float] = None
+    state: str = "queued"              # queued | running | done
+    submitted: float = field(default_factory=time.time)
+    queued_at: float = field(default_factory=time.monotonic)
+    result: Optional[Dict[str, Any]] = None
+    bucket: Optional[tuple] = None
+    footprint: Optional[int] = None
+    probe: bool = False                # half-open breaker probe
+
+    def public(self) -> Dict[str, Any]:
+        doc = {"id": self.id, "tenant": self.tenant,
+               "model": self.model, "state": self.state,
+               "submitted": self.submitted}
+        if self.bucket is not None:
+            doc["bucket"] = list(self.bucket)
+        if self.footprint is not None:
+            doc["predicted-bytes"] = self.footprint
+        if self.result is not None:
+            doc["result"] = self.result
+        return doc
+
+
+class CircuitBreaker:
+    """Per-shape-bucket breaker: ``closed`` serves, ``open`` rejects
+    with the remaining cooldown as ``Retry-After``, ``half-open`` admits
+    exactly one probe. Only capacity/health failure classes trip it —
+    OOM, wedge (and the daemon's own deadline timeouts, which it files
+    as wedge) — per the resilience taxonomy; a tenant's merely-invalid
+    history is a verdict, not a fault."""
+
+    #: cooldown growth cap (doublings stop here).
+    MAX_COOLDOWN_S = 300.0
+
+    def __init__(self, fails: int, cooldown_s: float,
+                 rng: Optional[random.Random] = None):
+        self.fails = max(1, int(fails))
+        self.cooldown_s = float(cooldown_s)
+        self._rng = rng or random.Random()
+        self._lock = threading.Lock()
+        #: bucket -> {"state", "fails", "until", "cooldown", "probing"}
+        self._b: Dict[tuple, Dict[str, Any]] = {}
+
+    def _rec(self, bucket: tuple) -> Dict[str, Any]:
+        rec = self._b.get(bucket)
+        if rec is None:
+            rec = self._b[bucket] = {
+                "state": "closed", "fails": 0, "until": 0.0,
+                "cooldown": self.cooldown_s, "probing": False}
+        return rec
+
+    def allow(self, bucket: Optional[tuple]
+              ) -> Tuple[bool, Optional[float], bool]:
+        """(admit?, retry_after_s, is_probe) for a new request in this
+        bucket. Open breakers whose (jittered) cooldown elapsed move to
+        half-open and admit ONE probe."""
+        if bucket is None:
+            return True, None, False
+        now = time.monotonic()
+        with self._lock:
+            rec = self._rec(bucket)
+            if rec["state"] == "closed":
+                return True, None, False
+            if rec["state"] == "open":
+                if now < rec["until"]:
+                    return False, max(rec["until"] - now, 0.1), False
+                rec["state"] = "half-open"
+                rec["probing"] = False
+            # half-open: one probe at a time
+            if rec["probing"]:
+                return False, rec["cooldown"] / 2, False
+            rec["probing"] = True
+            return True, None, True
+
+    def record(self, bucket: Optional[tuple], failure_class: Optional[str],
+               probe: bool) -> None:
+        """Account one finished request: a capacity/health failure
+        counts toward the trip threshold (and re-opens a half-open
+        breaker with doubled cooldown); success resets."""
+        if bucket is None:
+            return
+        from jepsen_tpu.resilience import OOM, WEDGE
+        failed = failure_class in (OOM, WEDGE)
+        now = time.monotonic()
+        with self._lock:
+            rec = self._rec(bucket)
+            if failed:
+                rec["fails"] += 1
+                if rec["state"] == "half-open" or \
+                        rec["fails"] >= self.fails:
+                    if rec["state"] == "half-open":
+                        rec["cooldown"] = min(rec["cooldown"] * 2,
+                                              self.MAX_COOLDOWN_S)
+                    # jittered cooldown: synchronized tenants must not
+                    # stampede the half-open probe slot
+                    jit = 0.75 + self._rng.random() / 2
+                    rec.update(state="open", probing=False,
+                               until=now + rec["cooldown"] * jit)
+                    log.warning("breaker OPEN for bucket %s (%s, "
+                                "cooldown %.1fs)", bucket, failure_class,
+                                rec["cooldown"])
+            else:
+                if rec["state"] in ("half-open",) or probe:
+                    log.info("breaker CLOSED for bucket %s (probe "
+                             "succeeded)", bucket)
+                rec.update(state="closed", fails=0, probing=False,
+                           cooldown=self.cooldown_s, until=0.0)
+            open_n = sum(1 for r in self._b.values()
+                         if r["state"] == "open")
+        _BREAKERS_OPEN.set(open_n)
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        now = time.monotonic()
+        with self._lock:
+            return {"/".join(str(x) for x in b): {
+                        "state": r["state"], "fails": r["fails"],
+                        "cooldown-s": round(r["cooldown"], 3),
+                        "retry-in-s": (round(max(r["until"] - now, 0), 3)
+                                       if r["state"] == "open" else None)}
+                    for b, r in self._b.items()}
+
+    def open_count(self) -> int:
+        with self._lock:
+            return sum(1 for r in self._b.values()
+                       if r["state"] == "open")
+
+
+class RequestJournal:
+    """Append-only CRC'd request WAL (``serve.wal``) — the op journal's
+    exact framing (:mod:`jepsen_tpu.journal`), fsync per record:
+    requests are orders of magnitude rarer than ops, so per-accept
+    durability is cheap and makes the 202 a real promise."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._f = open(path, "ab", buffering=0)
+
+    def append(self, doc: dict) -> None:
+        line = journal_ns.encode_json_record(doc)
+        with self._lock:
+            if self._f is None:
+                return
+            self._f.write(line)
+            os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+    @staticmethod
+    def replay(path: str) -> Tuple[list, dict]:
+        """The unfinished requests a previous incarnation journaled:
+        ``accepted`` records with no matching ``done``/``dropped``, in
+        acceptance order, plus reader stats."""
+        if not os.path.exists(path):
+            return [], {"records": 0, "torn": 0, "corrupt": 0}
+        records, stats = journal_ns.read_json_records(path)
+        accepted: "OrderedDict[str, dict]" = OrderedDict()
+        for r in records:
+            ev, rid = r.get("event"), r.get("id")
+            if not rid:
+                continue
+            if ev == "accepted":
+                accepted[rid] = r
+            elif ev in ("done", "dropped"):
+                accepted.pop(rid, None)
+        return list(accepted.values()), stats
+
+
+class CheckDaemon:
+    """The queue, the workers, the journal, and the admission logic —
+    everything behind the HTTP handler. Start with :meth:`start`
+    (replays the WAL first), stop with :meth:`drain` + :meth:`stop`."""
+
+    def __init__(self, config: Optional[ServeConfig] = None):
+        from jepsen_tpu.checker import engine as engine_mod
+        self.config = config or ServeConfig()
+        os.makedirs(self.config.root, exist_ok=True)
+        if self.config.compile_cache:
+            engine_mod.enable_persistent_cache(self.config.compile_cache)
+        # the PROCESS-default engine, deliberately: the check path
+        # (check_packed_tpu -> _jit_*) routes through it, so warming
+        # here is warming the executables requests actually run on
+        self.engine = engine_mod.default_engine()
+        self.journal = RequestJournal(
+            os.path.join(self.config.root, WAL_NAME))
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._queues: Dict[str, deque] = {}
+        self._rr: deque = deque()            # tenant round-robin order
+        self._by_id: Dict[str, CheckRequest] = {}
+        self._inflight: Dict[str, CheckRequest] = {}
+        self._seq = 0
+        self._depth = 0
+        self._footprint_committed = 0        # queued+inflight bytes
+        self.draining = False
+        self.drained = threading.Event()
+        self._stop = threading.Event()
+        self._threads: list = []
+        self._started = time.time()
+        self._service_ewma: Optional[float] = None
+        self.stats = {"admitted": 0, "rejected": 0, "completed": 0,
+                      "timeouts": 0, "replayed": 0}
+        self.replay_stats: Dict[str, Any] = {}
+        self.breaker = CircuitBreaker(self.config.breaker_fails,
+                                      self.config.breaker_cooldown_s)
+        self._progress_last = 0.0
+
+    # -- model / planning helpers -------------------------------------------
+
+    @staticmethod
+    def _models() -> Dict[str, Any]:
+        from jepsen_tpu.cli import _model_registry
+        return _model_registry()
+
+    def _plan_request(self, model_name: str, h: History
+                      ) -> Tuple[Optional[tuple], Optional[int]]:
+        """(shape bucket, predicted footprint bytes) for a request —
+        None/None when the model has no integer kernel (the CPU object
+        search serves it; no device budget is committed)."""
+        from jepsen_tpu.checker import plan as plan_mod
+        from jepsen_tpu.models.core import kernel_spec_for
+        from jepsen_tpu.ops.encode import pack_with_init
+        model = self._models()[model_name]()
+        try:
+            pk = pack_with_init(h, model)
+        except ValueError:
+            return None, None
+        if pk is None:
+            return None, None
+        packed, kernel = pk
+        bucket = self.engine.bucket_key(packed, kernel)
+        dims = plan_mod.PlanDims.from_packed(packed)
+        fp = plan_mod.request_footprint(dims)
+        return bucket, fp
+
+    def _budget(self) -> Optional[int]:
+        from jepsen_tpu.checker import plan as plan_mod
+        return self.config.bytes_budget or plan_mod.plan_bytes_limit()
+
+    def _retry_after(self) -> float:
+        """Backpressure hint: expected seconds until a queue slot frees
+        (service-time EWMA x depth, clamped to [1, 60])."""
+        with self._lock:
+            depth = self._depth + len(self._inflight)
+            ewma = self._service_ewma
+        est = (ewma or 1.0) * max(depth, 1) / max(
+            self.config.workers, 1)
+        return float(min(max(est, 1.0), 60.0))
+
+    # -- admission ----------------------------------------------------------
+
+    def submit(self, doc: Dict[str, Any], replayed: bool = False
+               ) -> Tuple[int, Dict[str, Any], Dict[str, str]]:
+        """Admission-controlled enqueue. Returns ``(http_status, body,
+        extra_headers)``; 202 means journaled AND queued."""
+        def reject(code: int, reason: str, retry: Optional[float] = None,
+                   **extra):
+            if not replayed:
+                _REJECTED.inc(reason=reason)
+                self.stats["rejected"] += 1
+            hdrs = {}
+            if retry is not None:
+                hdrs["Retry-After"] = str(max(1, int(round(retry))))
+            body = {"error": reason, **extra}
+            if retry is not None:
+                body["retry-after-s"] = round(retry, 3)
+            return code, body, hdrs
+
+        if self.draining:
+            return reject(503, "draining", retry=30.0)
+        tenant = str(doc.get("tenant") or "default")
+        model_name = str(doc.get("model") or "cas-register")
+        ops = doc.get("history")
+        if model_name not in self._models():
+            return reject(400, "bad-request",
+                          detail=f"unknown model {model_name!r}")
+        if not isinstance(ops, list) or not ops:
+            return reject(400, "bad-request",
+                          detail="history must be a non-empty list of "
+                                 "op dicts")
+        deadline = doc.get("deadline-s", self.config.deadline_s)
+        try:
+            deadline = float(deadline) if deadline else None
+        except (TypeError, ValueError):
+            return reject(400, "bad-request", detail="bad deadline-s")
+        # Structural gate BEFORE journaling: a malformed history must be
+        # a 400 with rule ids now, not an UNKNOWN verdict later (the
+        # same pre-search contract as every other checker entry).
+        try:
+            h = History.of(ops)
+        except (TypeError, ValueError, KeyError) as e:
+            return reject(400, "malformed", detail=str(e))
+        from jepsen_tpu.analysis import summarize
+        from jepsen_tpu.analysis.history_lint import errors, lint_history
+        errs = errors(lint_history(h))
+        if errs:
+            return reject(400, "malformed",
+                          lint=summarize(errs),
+                          detail=errs[0].format())
+        bucket, footprint = None, None
+        try:
+            bucket, footprint = self._plan_request(model_name, h)
+        except Exception as e:  # noqa: BLE001 — planning is advisory
+            log.warning("request planning failed (%s); admitting on "
+                        "depth alone", e)
+        # breaker: a tripped bucket rejects up front (half-open admits
+        # one probe). Replayed requests bypass — they were admitted by
+        # a previous incarnation and are owed a verdict.
+        probe = False
+        if not replayed:
+            ok, retry, probe = self.breaker.allow(bucket)
+            if not ok:
+                return reject(503, "breaker-open", retry=retry,
+                              bucket=list(bucket))
+            with self._lock:
+                depth = self._depth
+                tdepth = len(self._queues.get(tenant, ()))
+                committed = self._footprint_committed
+            if depth >= self.config.queue_max:
+                return reject(429, "queue-full",
+                              retry=self._retry_after(), depth=depth)
+            if tdepth >= self.config.tenant_max:
+                return reject(429, "tenant-quota",
+                              retry=self._retry_after(), tenant=tenant,
+                              depth=tdepth)
+            budget = self._budget()
+            if budget and footprint and \
+                    committed + footprint > budget:
+                return reject(429, "footprint",
+                              retry=self._retry_after(),
+                              **{"predicted-bytes": footprint,
+                                 "committed-bytes": committed,
+                                 "budget-bytes": budget})
+            if self.config.headroom_min > 0:
+                from jepsen_tpu.obs import devices as obs_devices
+                head = obs_devices.headroom_ratio()
+                if head is not None and head < self.config.headroom_min:
+                    return reject(429, "headroom",
+                                  retry=self._retry_after(),
+                                  headroom=round(head, 4))
+        with self._lock:
+            self._seq += 1
+            rid = doc.get("id") if replayed else None
+            rid = rid or f"r{self._seq:06d}-{os.getpid()}"
+        req = CheckRequest(id=rid, tenant=tenant, model=model_name,
+                           history=ops, deadline_s=deadline,
+                           bucket=bucket, footprint=footprint,
+                           probe=probe)
+        if not replayed:
+            self.journal.append({
+                "event": "accepted", "id": req.id, "tenant": tenant,
+                "model": model_name, "deadline-s": deadline,
+                "ts": req.submitted, "history": ops})
+        with self._work:
+            q = self._queues.get(tenant)
+            if q is None:
+                q = self._queues[tenant] = deque()
+                self._rr.append(tenant)
+            q.append(req)
+            self._by_id[req.id] = req
+            self._depth += 1
+            if footprint:
+                self._footprint_committed += footprint
+            self._work.notify()
+        _QUEUE_DEPTH.set(self._depth)
+        if not replayed:
+            _ADMITTED.inc(tenant=tenant)
+            self.stats["admitted"] += 1
+        self._publish()
+        body = {"id": req.id, "state": "queued", "tenant": tenant}
+        if bucket is not None:
+            body["bucket"] = list(bucket)
+        return 202, body, {}
+
+    # -- worker side --------------------------------------------------------
+
+    def _dequeue(self) -> Optional[CheckRequest]:
+        """Fair dequeue: rotate the tenant ring, FIFO within a tenant.
+        Blocks until work arrives or stop/drain."""
+        with self._work:
+            while True:
+                # drain/stop wins over queued work: the drain contract
+                # is finish IN-FLIGHT only — the queued remainder stays
+                # journaled for the next incarnation
+                if self._stop.is_set() or self.draining:
+                    return None
+                for _ in range(len(self._rr)):
+                    t = self._rr[0]
+                    self._rr.rotate(-1)
+                    q = self._queues.get(t)
+                    if q:
+                        req = q.popleft()
+                        self._depth -= 1
+                        req.state = "running"
+                        self._inflight[req.id] = req
+                        _QUEUE_DEPTH.set(self._depth)
+                        _INFLIGHT.set(len(self._inflight))
+                        return req
+                self._work.wait(timeout=0.5)
+
+    def _check(self, req: CheckRequest) -> Dict[str, Any]:
+        """Run one request through EXACTLY the offline analyze path
+        (``linearizable`` + ``check_safe``) so a daemon verdict and an
+        offline re-check of the journaled history are the same
+        computation — the crash-safety proof's equality leg."""
+        from jepsen_tpu.checker import check_safe
+        from jepsen_tpu.checker.wgl import linearizable
+        model = self._models()[req.model]()
+        checker = linearizable(model, backend=self.config.backend)
+        h = History.of(req.history)
+        if self.config.warm and req.bucket is not None:
+            try:
+                from jepsen_tpu.ops.encode import pack_with_init
+                pk = pack_with_init(h, model)
+                if pk is not None:
+                    self.engine.warm(pk[0], pk[1],
+                                     rungs=self.config.warm_rungs)
+            except Exception as e:  # noqa: BLE001 — warming is advisory
+                log.warning("bucket warm failed (%s); checking cold", e)
+        return check_safe(checker, {"name": f"serve-{req.id}"}, h)
+
+    def _run_one(self, req: CheckRequest) -> None:
+        from jepsen_tpu.resilience import WEDGE, result_failure_class
+        _QUEUE_WAIT.observe(time.monotonic() - req.queued_at)
+        t0 = time.monotonic()
+        box: Dict[str, Any] = {}
+        timed_out = False
+        if req.deadline_s:
+            worker = threading.Thread(
+                target=lambda: box.update(r=self._check(req)),
+                daemon=True, name=f"jtpu-serve-check-{req.id}")
+            worker.start()
+            worker.join(req.deadline_s)
+            if worker.is_alive():
+                # the worker is abandoned like a wedged device segment;
+                # its late result (if any) is discarded below
+                timed_out = True
+        else:
+            box["r"] = self._check(req)
+        if timed_out:
+            result = {"valid": "unknown", "error": ":info/timeout",
+                      "deadline-s": req.deadline_s,
+                      "error-class": WEDGE}
+            _TIMEOUTS.inc()
+            self.stats["timeouts"] += 1
+        else:
+            result = box.get("r") or {"valid": "unknown",
+                                      "error": "worker died"}
+        secs = time.monotonic() - t0
+        result = dict(result)
+        result["serve"] = {"id": req.id, "tenant": req.tenant,
+                           "seconds": round(secs, 6),
+                           "timed-out": timed_out}
+        self.breaker.record(req.bucket, result_failure_class(result),
+                            req.probe)
+        self._finish(req, result, secs)
+
+    def _finish(self, req: CheckRequest, result: Dict[str, Any],
+                secs: float) -> None:
+        # result file first (tmp+replace), then the done journal record:
+        # a crash between them re-runs the request, never loses it
+        path = os.path.join(self.config.root, f"{req.id}.json")
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(result, f, default=repr)
+            os.replace(tmp, path)
+        except OSError as e:
+            log.warning("couldn't persist result for %s: %s", req.id, e)
+        self.journal.append({"event": "done", "id": req.id,
+                             "valid": repr(result.get("valid")),
+                             "seconds": round(secs, 6)})
+        with self._work:
+            req.result = result
+            req.state = "done"
+            self._inflight.pop(req.id, None)
+            if req.footprint:
+                self._footprint_committed = max(
+                    0, self._footprint_committed - req.footprint)
+            self._service_ewma = (secs if self._service_ewma is None
+                                  else 0.3 * secs
+                                  + 0.7 * self._service_ewma)
+            self._work.notify_all()
+        _INFLIGHT.set(len(self._inflight))
+        _COMPLETED.inc(valid=str(result.get("valid")))
+        self.stats["completed"] += 1
+        self._publish()
+
+    def _worker_loop(self) -> None:
+        while not self._stop.is_set():
+            req = self._dequeue()
+            if req is None:
+                return
+            try:
+                self._run_one(req)
+            except Exception:  # noqa: BLE001 — a worker must never die
+                log.exception("worker crashed on %s", req.id)
+                self._finish(req, {"valid": "unknown",
+                                   "error": "serve worker crashed"},
+                             0.0)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "CheckDaemon":
+        """Replay the request journal, then start the worker pool."""
+        pending, stats = RequestJournal.replay(self.journal.path)
+        self.replay_stats = dict(stats, requeued=len(pending))
+        for doc in pending:
+            code, body, _ = self.submit(doc, replayed=True)
+            if code == 202:
+                _REPLAYED.inc()
+                self.stats["replayed"] += 1
+            else:
+                # journaled but no longer admissible (e.g. the history
+                # decodes malformed after a corrupt WAL line): record a
+                # terminal drop so the next restart stops retrying it
+                self.journal.append({"event": "dropped",
+                                     "id": doc.get("id"),
+                                     "reason": body.get("error")})
+        for i in range(max(1, self.config.workers)):
+            t = threading.Thread(target=self._worker_loop, daemon=True,
+                                 name=f"jtpu-serve-worker-{i}")
+            t.start()
+            self._threads.append(t)
+        self._publish(force=True)
+        log.info("check daemon up: %d worker(s), %d replayed request(s)",
+                 len(self._threads), self.stats["replayed"])
+        return self
+
+    def drain(self, timeout_s: float = 600.0) -> Dict[str, Any]:
+        """Stop admission, let in-flight requests finish, leave the
+        queued remainder journaled for the next incarnation."""
+        with self._work:
+            self.draining = True
+            queued = self._depth
+            self._work.notify_all()
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._inflight:
+                    break
+            time.sleep(0.05)
+        with self._lock:
+            inflight = len(self._inflight)
+        self._publish(force=True, state="drained")
+        self.drained.set()
+        return {"drained": True, "was-queued": queued,
+                "inflight-remaining": inflight,
+                "completed": self.stats["completed"]}
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._work:
+            self._work.notify_all()
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self.journal.close()
+        self._publish(force=True, state="stopped")
+
+    # -- introspection ------------------------------------------------------
+
+    def status(self, rid: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            req = self._by_id.get(rid)
+            return req.public() if req else None
+
+    def healthz(self) -> Dict[str, Any]:
+        with self._lock:
+            tenants = {t: len(q) for t, q in self._queues.items() if q}
+            depth = self._depth
+            inflight = len(self._inflight)
+            committed = self._footprint_committed
+        return {
+            "ok": True,
+            "state": "draining" if self.draining else "serving",
+            "uptime-s": round(time.time() - self._started, 3),
+            "queue-depth": depth, "queue-max": self.config.queue_max,
+            "inflight": inflight, "workers": len(self._threads),
+            "tenants": tenants, "tenant-max": self.config.tenant_max,
+            "committed-bytes": committed,
+            "budget-bytes": self._budget(),
+            "stats": dict(self.stats),
+            "replay": dict(self.replay_stats),
+            "breakers": self.breaker.snapshot(),
+            "engine": {
+                "builds": self.engine.builds,
+                "cache-hits": self.engine.hits,
+                "warm-buckets": [
+                    "/".join(str(x) for x in b)
+                    for b in self.engine.warm_buckets()],
+                "persistent-cache": self.config.compile_cache,
+            },
+        }
+
+    def _publish(self, force: bool = False,
+                 state: Optional[str] = None) -> None:
+        """Heartbeat: the daemon's queue/breaker/warm state as a
+        progress.json in its own directory — tmp+replace, throttled —
+        so `watch --store <dir>` and the web `/live/<dir>` endpoint
+        follow the daemon the way they follow a search."""
+        now = time.monotonic()
+        if not force and now - self._progress_last < 0.1:
+            return
+        self._progress_last = now
+        with self._lock:
+            doc = {
+                "state": state or ("draining" if self.draining
+                                   else "serving"),
+                "ts": time.time(),
+                "serve": {
+                    "queue-depth": self._depth,
+                    "inflight": len(self._inflight),
+                    "admitted": self.stats["admitted"],
+                    "rejected": self.stats["rejected"],
+                    "completed": self.stats["completed"],
+                    "timeouts": self.stats["timeouts"],
+                    "breakers-open": self.breaker.open_count(),
+                    "warm-buckets": len(self.engine.warm_buckets()),
+                },
+            }
+        path = os.path.join(self.config.root, PROGRESS_NAME)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, path)
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# HTTP front-end: the daemon mounted on web.py's results server
+# ---------------------------------------------------------------------------
+
+
+def make_handler(daemon: CheckDaemon, root: str = "store"):
+    """A web.Handler subclass with the check-daemon routes mounted —
+    the results browser, /metrics, /live and /trace keep working on the
+    same port (one scrape target, one operator URL)."""
+    from jepsen_tpu import web
+
+    class ServeHandler(web.Handler):
+        pass
+
+    ServeHandler.root = root
+    ServeHandler.daemon = daemon
+
+    def _json(self, code: int, doc: Dict[str, Any],
+              headers: Optional[Dict[str, str]] = None):
+        self._send(code, json.dumps(doc, default=repr).encode(),
+                   ctype="application/json", headers=headers or {})
+
+    def do_POST(self):  # noqa: N802 (stdlib naming)
+        from urllib.parse import urlparse
+        path = urlparse(self.path).path
+        try:
+            if path == "/check":
+                try:
+                    n = int(self.headers.get("Content-Length") or 0)
+                    doc = json.loads(self.rfile.read(n) or b"{}")
+                    if not isinstance(doc, dict):
+                        raise ValueError("body must be a JSON object")
+                except (ValueError, TypeError) as e:
+                    return _json(self, 400, {"error": "bad-request",
+                                             "detail": str(e)})
+                code, body, hdrs = self.daemon.submit(doc)
+                return _json(self, code, body, hdrs)
+            if path == "/drain":
+                return _json(self, 200, self.daemon.drain())
+            return _json(self, 404, {"error": "not-found"})
+        except BrokenPipeError:
+            pass
+
+    def do_GET(self):  # noqa: N802
+        from urllib.parse import unquote, urlparse
+        path = unquote(urlparse(self.path).path)
+        if path == "/healthz":
+            return _json(self, 200, self.daemon.healthz())
+        if path.startswith("/check/"):
+            rid = path[len("/check/"):].strip("/")
+            doc = self.daemon.status(rid)
+            if doc is None:
+                return _json(self, 404, {"error": "no such request",
+                                         "id": rid})
+            return _json(self, 200, doc)
+        return web.Handler.do_GET(self)
+
+    ServeHandler.do_POST = do_POST
+    ServeHandler.do_GET = do_GET
+    return ServeHandler
+
+
+def run_daemon(config: Optional[ServeConfig] = None,
+               host: str = "127.0.0.1", port: int = 8080,
+               store_root: str = "store", quiet: bool = False):
+    """Start the daemon + HTTP server; returns ``(daemon, server)``.
+    The caller (the serve CLI) waits on ``daemon.drained`` — set by
+    POST /drain — then shuts the server down and exits 0."""
+    from jepsen_tpu import web
+    daemon = CheckDaemon(config)
+    daemon.start()
+    handler = make_handler(daemon, root=store_root)
+    server = web.serve(host=host, port=port, root=store_root,
+                       handler_cls=handler)
+    t = threading.Thread(target=server.serve_forever, daemon=True,
+                         name="jtpu-serve-http")
+    t.start()
+    if not quiet:
+        log.info("jtpu serve: check daemon on http://%s:%s/ "
+                 "(POST /check, GET /check/<id>, /healthz, /drain)",
+                 host, server.server_port)
+    return daemon, server

@@ -239,10 +239,13 @@ def parse_file_time(t):
         return None
     import datetime
     t = t.strip().replace(",", ".")
-    # `date -u -Ins` appends +00:00; fromisoformat handles it (trim the
-    # nanosecond tail to microseconds first)
+    # `date -u -Ins` appends +00:00; fromisoformat handles it. Python
+    # < 3.11 only accepts exactly 3 or 6 fractional digits, so normalize
+    # the fraction to microseconds: trim nanosecond tails AND right-pad
+    # short fractions like ".5" (comma-locale dates) to six digits.
     import re as _re
-    t = _re.sub(r"\.(\d{6})\d*", r".\1", t)
+    t = _re.sub(r"\.(\d+)",
+                lambda m: "." + m.group(1)[:6].ljust(6, "0"), t, count=1)
     return datetime.datetime.fromisoformat(t).timestamp()
 
 

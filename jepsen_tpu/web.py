@@ -592,10 +592,15 @@ def _waterfall_html(records, stats, cap: int = 2000) -> str:
 
 
 def serve(host: str = "127.0.0.1", port: int = 8080,
-          root: str = "store") -> ThreadingHTTPServer:
+          root: str = "store",
+          handler_cls: Optional[type] = None) -> ThreadingHTTPServer:
     """Start the results server (web.clj:315-320); caller runs
-    serve_forever (or uses serve_background)."""
-    handler = type("BoundHandler", (Handler,), {"root": root})
+    serve_forever (or uses serve_background). ``handler_cls`` lets the
+    check daemon (:mod:`jepsen_tpu.serve`) mount its POST /check /
+    /healthz / /drain routes on the same server; None keeps the plain
+    results browser — byte-identical to the pre-daemon behavior."""
+    base = handler_cls or Handler
+    handler = type("BoundHandler", (base,), {"root": root})
     return ThreadingHTTPServer((host, port), handler)
 
 

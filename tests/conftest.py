@@ -56,6 +56,13 @@ def pytest_configure(config):
         "barrier, host-loss re-meshing, work-stealing rebalance, "
         "join admission, the DCN failure class, changed-mesh "
         "checkpoint resume, and the JTPU_FLEET kill switch")
+    config.addinivalue_line(
+        "markers", "serve: check-daemon + engine tests "
+        "(tests/test_serve.py): the explicit executable Engine and its "
+        "warm-cache accounting, the request WAL + restart replay, "
+        "admission control / backpressure / fair dequeue, per-bucket "
+        "circuit breakers, per-request deadlines, drain, and the "
+        "JTPU_SERVE kill-switch identity")
 
 
 def pytest_collection_modifyitems(config, items):

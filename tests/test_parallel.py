@@ -149,11 +149,40 @@ class TestPoolSharded:
                                   capacity=8 * naxis + 1)
 
 
+#: Error-text markers meaning "this host/backend cannot run multi-
+#: process computations at all" — a capability gap of the CI image
+#: (single-host CPU jaxlibs refuse cross-process programs), not a
+#: regression in the DCN seam under test.
+_DCN_INCAPABLE_MARKERS = (
+    "Multiprocess computations aren't implemented on the CPU backend",
+    "multiprocess computations aren't implemented",
+    "UNIMPLEMENTED",
+    "distributed module is not available",
+)
+
+
+def _skip_if_dcn_incapable(exc: BaseException) -> None:
+    """Skip (not fail) when the failure text says the backend cannot do
+    multi-process execution — the proper capability guard for the
+    two-process DCN test on single-host CPU CI."""
+    import pytest
+    text = f"{type(exc).__name__}: {exc}"
+    if any(m in text for m in _DCN_INCAPABLE_MARKERS):
+        pytest.skip("multi-process (DCN) computations unsupported on "
+                    "this backend/host: " + text.splitlines()[-1][:200])
+
+
 class TestDCN:
     def test_two_process_dcn_keyed_check(self):
         """Two OS processes join one JAX cluster over a localhost
         coordinator (the DCN seam) and run a keyed check sharded across
         both processes' devices — certifies parallel.py's multi-host
-        claim (same jitted program SPMD per host)."""
+        claim (same jitted program SPMD per host). Skips, rather than
+        fails, on hosts whose backend cannot run multi-process
+        computations at all (single-host CPU CI images)."""
         import __graft_entry__ as g
-        g.dryrun_dcn(n_procs=2, devices_per_proc=1)
+        try:
+            g.dryrun_dcn(n_procs=2, devices_per_proc=1)
+        except RuntimeError as e:
+            _skip_if_dcn_incapable(e)
+            raise

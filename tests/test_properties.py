@@ -7,7 +7,13 @@ checks that would be minutes on the Python search.
 """
 
 import pytest
-from hypothesis import given, settings, strategies as st
+
+# hypothesis is an optional dev dependency: without it the module must
+# still COLLECT cleanly (a collection error fails tier-1 outright; a
+# skip is the contract for missing optional tooling).
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="property tests need the hypothesis package")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from jepsen_tpu.checker import UNKNOWN
 from jepsen_tpu.checker.native import available, check_history_native

@@ -1,0 +1,641 @@
+"""Check-daemon + engine tests (the `serve` marker, doc/serve.md).
+
+Covers the explicit executable Engine (warm-cache accounting: a second
+check in the same shape bucket pays ZERO cold compiles), the CRC'd
+request WAL and restart replay, admission control (bounded queue /
+tenant quota / footprint budget → 429 + Retry-After), fair per-tenant
+dequeue, the per-bucket circuit breaker (trip, half-open probe, close),
+per-request deadlines (:info/timeout), graceful drain, the HTTP API
+end-to-end, and the JTPU_SERVE kill-switch identity contract.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from jepsen_tpu import serve as serve_ns
+from jepsen_tpu.checker import tpu as T
+from jepsen_tpu.checker.engine import Engine, default_engine
+from jepsen_tpu.history import History
+from jepsen_tpu.models import CASRegister
+from jepsen_tpu.ops.encode import pack_with_init
+
+pytestmark = pytest.mark.serve
+
+
+def _ops(n_pairs=2, value=1):
+    """A small valid register history as raw op dicts (what tenants
+    POST)."""
+    rows = []
+    t = 0
+    for i in range(n_pairs):
+        rows.append({"type": "invoke", "f": "write", "value": value + i,
+                     "process": 0, "time": t})
+        rows.append({"type": "ok", "f": "write", "value": value + i,
+                     "process": 0, "time": t + 1})
+        rows.append({"type": "invoke", "f": "read", "value": None,
+                     "process": 1, "time": t + 2})
+        rows.append({"type": "ok", "f": "read", "value": value + i,
+                     "process": 1, "time": t + 3})
+        t += 4
+    return rows
+
+
+def _packed(ops=None):
+    return pack_with_init(History.of(ops or _ops()), CASRegister())
+
+
+def _daemon(tmp_path, start=False, **cfg):
+    cfg.setdefault("root", str(tmp_path / "serve"))
+    cfg.setdefault("backend", "tpu")
+    d = serve_ns.CheckDaemon(serve_ns.ServeConfig(**cfg))
+    if start:
+        d.start()
+    return d
+
+
+def _wait_done(daemon, rid, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        doc = daemon.status(rid)
+        if doc and doc["state"] == "done":
+            return doc
+        time.sleep(0.02)
+    raise AssertionError(f"request {rid} never finished: "
+                         f"{daemon.status(rid)}")
+
+
+# ---------------------------------------------------------------------------
+# Engine
+# ---------------------------------------------------------------------------
+
+
+class TestEngine:
+    def test_factories_route_through_engine_and_memoize(self):
+        p, kernel = _packed()
+        kid = T._kernel_key(kernel)
+        f1 = T._jit_segment(kid, 32, 32, 4, 1)
+        f2 = T._jit_segment(kid, 32, 32, 4, 1)
+        assert f1 is f2  # the explicit table, same contract as lru_cache
+        eng = default_engine()
+        assert eng.builds >= 1 and eng.hits >= 1
+
+    def test_lru_eviction_bounds_the_table(self):
+        p, kernel = _packed()
+        kid = T._kernel_key(kernel)
+        eng = Engine("evict-test", max_entries=2)
+        for cap in (8, 16, 32):
+            eng.jit_single(kid, cap, 32, 4, 1)
+        assert len(eng._fns) == 2
+        assert eng.builds == 3
+
+    def test_bucket_key_groups_shapes(self):
+        p1, kernel = _packed(_ops(2))
+        p2, _ = _packed(_ops(2, value=7))     # same shape, other values
+        p3, _ = _packed(_ops(40))             # bigger required bucket
+        assert Engine.bucket_key(p1, kernel) == \
+            Engine.bucket_key(p2, kernel)
+        assert Engine.bucket_key(p1, kernel) != \
+            Engine.bucket_key(p3, kernel)
+
+    def test_warm_then_same_bucket_checks_pay_zero_cold(self):
+        """The warm-path satellite + acceptance proof: after Engine.warm
+        a check in the bucket performs no cold compile (cold counter
+        delta 0) and accounts as cache hits; a SECOND history in the
+        same bucket rides the same executables."""
+        from jepsen_tpu.resilience import supervised_check_packed
+        eng = default_engine()
+        p1, kernel = _packed(_ops(3))
+        p2, _ = _packed(_ops(3, value=5))
+        assert eng.bucket_key(p1, kernel) == eng.bucket_key(p2, kernel)
+        eng.warm(p1, kernel)
+        before = T.compile_snapshot()
+        r1 = supervised_check_packed(p1, kernel)
+        d1 = T.compile_delta(before)
+        assert r1["valid"] is True
+        assert d1["cold"] == 0, f"warm bucket cold-compiled: {d1}"
+        assert d1["cache-hits"] >= 1
+        mid = T.compile_snapshot()
+        r2 = supervised_check_packed(p2, kernel)
+        d2 = T.compile_delta(mid)
+        assert r2["valid"] is True
+        assert d2["cold"] == 0, f"second same-bucket check went cold: {d2}"
+        assert d2["cache-hits"] >= 1
+
+    def test_warm_is_idempotent_per_bucket(self):
+        eng = default_engine()
+        p, kernel = _packed(_ops(3))
+        first = eng.warm(p, kernel)
+        again = eng.warm(p, kernel)
+        assert again["already-warm"] is True
+        assert eng.warm_info(eng.bucket_key(p, kernel)) is not None
+        assert first["shapes"] >= 1 or first["already-warm"]
+
+    def test_enable_persistent_cache_best_effort(self, tmp_path):
+        from jepsen_tpu.checker import engine as engine_mod
+        out = engine_mod.enable_persistent_cache(str(tmp_path / "xc"))
+        assert out in (None, str(tmp_path / "xc"))
+
+
+# ---------------------------------------------------------------------------
+# Request journal
+# ---------------------------------------------------------------------------
+
+
+class TestRequestJournal:
+    def test_replay_returns_only_unfinished(self, tmp_path):
+        path = str(tmp_path / "serve.wal")
+        j = serve_ns.RequestJournal(path)
+        j.append({"event": "accepted", "id": "a", "history": _ops()})
+        j.append({"event": "accepted", "id": "b", "history": _ops()})
+        j.append({"event": "done", "id": "a", "valid": "True"})
+        j.close()
+        pending, stats = serve_ns.RequestJournal.replay(path)
+        assert [r["id"] for r in pending] == ["b"]
+        assert stats["records"] == 3 and stats["corrupt"] == 0
+
+    def test_torn_tail_tolerated(self, tmp_path):
+        path = str(tmp_path / "serve.wal")
+        j = serve_ns.RequestJournal(path)
+        j.append({"event": "accepted", "id": "a", "history": _ops()})
+        j.close()
+        with open(path, "ab") as f:
+            f.write(b"deadbeef {torn-mid-wri")  # no newline: torn tail
+        pending, stats = serve_ns.RequestJournal.replay(path)
+        assert [r["id"] for r in pending] == ["a"]
+        assert stats["torn"] == 1
+
+    def test_dropped_records_are_terminal(self, tmp_path):
+        path = str(tmp_path / "serve.wal")
+        j = serve_ns.RequestJournal(path)
+        j.append({"event": "accepted", "id": "a", "history": _ops()})
+        j.append({"event": "dropped", "id": "a", "reason": "malformed"})
+        j.close()
+        pending, _ = serve_ns.RequestJournal.replay(path)
+        assert pending == []
+
+
+# ---------------------------------------------------------------------------
+# Admission control + backpressure (no workers: requests stay queued)
+# ---------------------------------------------------------------------------
+
+
+class TestAdmission:
+    def test_bounded_queue_429_with_retry_after(self, tmp_path):
+        d = _daemon(tmp_path, queue_max=2, tenant_max=10)
+        for _ in range(2):
+            code, _, _ = d.submit({"model": "cas-register",
+                                   "history": _ops()})
+            assert code == 202
+        code, body, hdrs = d.submit({"model": "cas-register",
+                                     "history": _ops()})
+        assert code == 429
+        assert body["error"] == "queue-full"
+        assert int(hdrs["Retry-After"]) >= 1
+        d.stop()
+
+    def test_tenant_quota_protects_other_tenants(self, tmp_path):
+        d = _daemon(tmp_path, queue_max=10, tenant_max=1)
+        code, _, _ = d.submit({"tenant": "greedy",
+                               "model": "cas-register",
+                               "history": _ops()})
+        assert code == 202
+        code, body, hdrs = d.submit({"tenant": "greedy",
+                                     "model": "cas-register",
+                                     "history": _ops()})
+        assert code == 429 and body["error"] == "tenant-quota"
+        assert "Retry-After" in hdrs
+        code, _, _ = d.submit({"tenant": "modest",
+                               "model": "cas-register",
+                               "history": _ops()})
+        assert code == 202  # the quota is per tenant, not global
+        d.stop()
+
+    def test_footprint_budget_rejects_past_admission_bytes(self, tmp_path):
+        d = _daemon(tmp_path, queue_max=10, bytes_budget=512)
+        code, body, hdrs = d.submit({"model": "cas-register",
+                                     "history": _ops()})
+        assert code == 429 and body["error"] == "footprint"
+        assert body["predicted-bytes"] > 512 == body["budget-bytes"]
+        assert "Retry-After" in hdrs
+        d.stop()
+
+    def test_malformed_history_400_with_rule_ids(self, tmp_path):
+        d = _daemon(tmp_path)
+        bad = [{"type": "invoke", "f": "write", "value": 1,
+                "process": 0, "time": 0},
+               {"type": "invoke", "f": "write", "value": 2,
+                "process": 0, "time": 1}]  # process reuse
+        code, body, _ = d.submit({"model": "cas-register",
+                                  "history": bad})
+        assert code == 400 and body["error"] == "malformed"
+        assert body.get("lint")
+        d.stop()
+
+    def test_unknown_model_and_empty_history_400(self, tmp_path):
+        d = _daemon(tmp_path)
+        assert d.submit({"model": "nope", "history": _ops()})[0] == 400
+        assert d.submit({"model": "cas-register",
+                         "history": []})[0] == 400
+        d.stop()
+
+    def test_draining_503(self, tmp_path):
+        d = _daemon(tmp_path)
+        d.draining = True
+        code, body, hdrs = d.submit({"model": "cas-register",
+                                     "history": _ops()})
+        assert code == 503 and body["error"] == "draining"
+        assert "Retry-After" in hdrs
+        d.stop()
+
+
+class TestFairDequeue:
+    def test_round_robin_across_tenants(self, tmp_path):
+        d = _daemon(tmp_path, queue_max=32, tenant_max=32)
+        for i in range(3):
+            d.submit({"tenant": "t1", "model": "cas-register",
+                      "history": _ops(value=i + 1)})
+        d.submit({"tenant": "t2", "model": "cas-register",
+                  "history": _ops(value=9)})
+        order = [d._dequeue().tenant for _ in range(4)]
+        # one t2 request interleaves within the first two slots instead
+        # of waiting behind all of t1's backlog
+        assert "t2" in order[:2], order
+        assert sorted(order) == ["t1", "t1", "t1", "t2"]
+        d.stop()
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker
+# ---------------------------------------------------------------------------
+
+
+class TestCircuitBreaker:
+    BUCKET = ("cas-register", 16, 0, 32)
+
+    def test_trip_halfopen_probe_close(self):
+        import random as _random
+        from jepsen_tpu.resilience import OOM
+        br = serve_ns.CircuitBreaker(2, 0.05, rng=_random.Random(3))
+        ok, _, probe = br.allow(self.BUCKET)
+        assert ok and not probe
+        br.record(self.BUCKET, OOM, probe=False)
+        br.record(self.BUCKET, OOM, probe=False)
+        ok, retry, _ = br.allow(self.BUCKET)
+        assert not ok and retry > 0
+        time.sleep(0.08)
+        ok, _, probe = br.allow(self.BUCKET)
+        assert ok and probe            # half-open: exactly one probe
+        ok2, _, _ = br.allow(self.BUCKET)
+        assert not ok2                 # second concurrent probe refused
+        br.record(self.BUCKET, None, probe=True)
+        ok, _, probe = br.allow(self.BUCKET)
+        assert ok and not probe        # closed again
+
+    def test_probe_failure_doubles_cooldown(self):
+        import random as _random
+        from jepsen_tpu.resilience import WEDGE
+        br = serve_ns.CircuitBreaker(1, 0.05, rng=_random.Random(5))
+        br.record(self.BUCKET, WEDGE, probe=False)
+        time.sleep(0.08)
+        ok, _, probe = br.allow(self.BUCKET)
+        assert ok and probe
+        br.record(self.BUCKET, WEDGE, probe=True)
+        snap = br.snapshot()
+        rec = list(snap.values())[0]
+        assert rec["state"] == "open"
+        assert rec["cooldown-s"] == pytest.approx(0.1)
+
+    def test_invalid_verdicts_do_not_trip(self):
+        br = serve_ns.CircuitBreaker(1, 0.05)
+        br.record(self.BUCKET, None, probe=False)   # clean check
+        ok, _, _ = br.allow(self.BUCKET)
+        assert ok
+
+    def test_daemon_breaker_rejects_then_probes(self, tmp_path,
+                                                monkeypatch):
+        d = _daemon(tmp_path, start=True, queue_max=16,
+                    breaker_fails=2, breaker_cooldown_s=0.1)
+        monkeypatch.setattr(
+            serve_ns.CheckDaemon, "_check",
+            lambda self, req: {"valid": "unknown",
+                               "error": "RESOURCE_EXHAUSTED (fake)",
+                               "error-class": "oom"})
+        for _ in range(2):
+            code, body, _ = d.submit({"model": "cas-register",
+                                      "history": _ops()})
+            assert code == 202
+            _wait_done(d, body["id"])
+        code, body, hdrs = d.submit({"model": "cas-register",
+                                     "history": _ops()})
+        assert code == 503 and body["error"] == "breaker-open"
+        assert "Retry-After" in hdrs
+        time.sleep(0.2)                # past the jittered cooldown
+        monkeypatch.setattr(serve_ns.CheckDaemon, "_check",
+                            lambda self, req: {"valid": True})
+        code, body, _ = d.submit({"model": "cas-register",
+                                  "history": _ops()})
+        assert code == 202             # the half-open probe
+        _wait_done(d, body["id"])
+        code, _, _ = d.submit({"model": "cas-register",
+                               "history": _ops()})
+        assert code == 202             # probe success closed the breaker
+        d.stop()
+
+
+# ---------------------------------------------------------------------------
+# Deadlines
+# ---------------------------------------------------------------------------
+
+
+class TestDeadline:
+    def test_overrun_returns_info_timeout(self, tmp_path, monkeypatch):
+        d = _daemon(tmp_path, start=True, deadline_s=0.15)
+
+        def slow(self, req):
+            time.sleep(1.5)
+            return {"valid": True}
+
+        monkeypatch.setattr(serve_ns.CheckDaemon, "_check", slow)
+        code, body, _ = d.submit({"model": "cas-register",
+                                  "history": _ops()})
+        assert code == 202
+        doc = _wait_done(d, body["id"])
+        assert doc["result"]["valid"] == "unknown"
+        assert doc["result"]["error"] == ":info/timeout"
+        assert doc["result"]["serve"]["timed-out"] is True
+        assert d.stats["timeouts"] == 1
+        d.stop()
+
+    def test_per_request_deadline_overrides_default(self, tmp_path,
+                                                    monkeypatch):
+        d = _daemon(tmp_path, start=True, deadline_s=None)
+        monkeypatch.setattr(
+            serve_ns.CheckDaemon, "_check",
+            lambda self, req: (time.sleep(0.5), {"valid": True})[1])
+        code, body, _ = d.submit({"model": "cas-register",
+                                  "history": _ops(), "deadline-s": 0.1})
+        assert code == 202
+        doc = _wait_done(d, body["id"])
+        assert doc["result"]["error"] == ":info/timeout"
+        d.stop()
+
+
+# ---------------------------------------------------------------------------
+# Crash safety: journal replay re-runs unfinished work, verdicts match
+# the offline path
+# ---------------------------------------------------------------------------
+
+
+class TestCrashReplay:
+    def test_killed_daemon_replays_and_matches_offline(self, tmp_path):
+        # incarnation 1: accepts (journals) two requests but is "killed"
+        # before any worker ran them
+        d1 = _daemon(tmp_path, queue_max=8)
+        for v in (1, 5):
+            code, _, _ = d1.submit({"tenant": "t", "model":
+                                    "cas-register",
+                                    "history": _ops(value=v)})
+            assert code == 202
+        d1.journal.close()             # SIGKILL: nothing else persisted
+
+        # incarnation 2 replays the WAL and finishes the work
+        d2 = _daemon(tmp_path, start=True, queue_max=8)
+        assert d2.replay_stats["requeued"] == 2
+        assert d2.stats["replayed"] == 2
+        with d2._lock:
+            rids = list(d2._by_id)
+        docs = [_wait_done(d2, rid) for rid in rids]
+        d2.stop()
+
+        # verdicts identical to the offline analyze path
+        from jepsen_tpu.checker import check_safe
+        from jepsen_tpu.checker.wgl import linearizable
+        for doc, v in zip(sorted(docs, key=lambda x: x["id"]), (1, 5)):
+            offline = check_safe(
+                linearizable(CASRegister(), backend="tpu"),
+                {"name": "offline"}, History.of(_ops(value=v)))
+            assert doc["result"]["valid"] is offline["valid"] is True
+
+    def test_drain_finishes_inflight_leaves_queued_journaled(
+            self, tmp_path, monkeypatch):
+        """The drain contract: in-flight work completes, queued work is
+        NOT started — it stays journaled for the next incarnation."""
+        d = _daemon(tmp_path, start=True)
+        running = threading.Event()
+
+        def slowish(self, req):
+            running.set()
+            time.sleep(0.4)
+            return {"valid": True}
+
+        monkeypatch.setattr(serve_ns.CheckDaemon, "_check", slowish)
+        code, b1, _ = d.submit({"model": "cas-register",
+                                "history": _ops()})
+        assert code == 202
+        assert running.wait(timeout=5)      # b1 is in flight
+        code, b2, _ = d.submit({"model": "cas-register",
+                                "history": _ops(value=7)})
+        assert code == 202                  # b2 queued behind it
+        out = d.drain(timeout_s=10)
+        assert out["drained"] is True
+        assert d.status(b1["id"])["state"] == "done"
+        assert d.status(b2["id"])["state"] == "queued"
+        d.stop()
+        pending, _ = serve_ns.RequestJournal.replay(d.journal.path)
+        assert [r["id"] for r in pending] == [b2["id"]]
+
+    def test_finished_requests_are_not_replayed(self, tmp_path):
+        d1 = _daemon(tmp_path, start=True)
+        code, body, _ = d1.submit({"model": "cas-register",
+                                   "history": _ops()})
+        assert code == 202
+        _wait_done(d1, body["id"])
+        d1.stop()
+        d2 = _daemon(tmp_path)
+        pending, _ = serve_ns.RequestJournal.replay(d2.journal.path)
+        assert pending == []
+        d2.stop()
+
+
+# ---------------------------------------------------------------------------
+# HTTP API end-to-end
+# ---------------------------------------------------------------------------
+
+
+def _post(port, path, doc):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(doc).encode() if doc is not None else b"",
+        method="POST")
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.load(r), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.load(e), dict(e.headers)
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}") as r:
+            return r.status, json.load(r)
+    except urllib.error.HTTPError as e:
+        return e.code, json.load(e)
+
+
+class TestHTTP:
+    def test_check_poll_healthz_drain(self, tmp_path):
+        cfg = serve_ns.ServeConfig(root=str(tmp_path / "serve"),
+                                   backend="tpu")
+        daemon, server = serve_ns.run_daemon(
+            cfg, host="127.0.0.1", port=0,
+            store_root=str(tmp_path / "store"))
+        port = server.server_port
+        try:
+            code, body, _ = _post(port, "/check",
+                                  {"tenant": "http", "model":
+                                   "cas-register", "history": _ops()})
+            assert code == 202 and body["state"] == "queued"
+            rid = body["id"]
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                code, doc = _get(port, f"/check/{rid}")
+                if doc["state"] == "done":
+                    break
+                time.sleep(0.05)
+            assert doc["state"] == "done"
+            assert doc["result"]["valid"] is True
+            # the result also persisted as a file
+            assert os.path.exists(os.path.join(cfg.root, f"{rid}.json"))
+
+            code, health = _get(port, "/healthz")
+            assert code == 200 and health["ok"] is True
+            assert health["stats"]["completed"] >= 1
+            assert health["engine"]["warm-buckets"]
+
+            code, doc = _get(port, "/check/nope")
+            assert code == 404
+
+            code, drained, _ = _post(port, "/drain", None)
+            assert code == 200 and drained["drained"] is True
+            assert daemon.drained.wait(timeout=5)
+        finally:
+            server.shutdown()
+            daemon.stop()
+
+    def test_saturated_queue_http_429_retry_after(self, tmp_path):
+        cfg = serve_ns.ServeConfig(root=str(tmp_path / "serve"),
+                                   queue_max=0)
+        daemon, server = serve_ns.run_daemon(
+            cfg, host="127.0.0.1", port=0,
+            store_root=str(tmp_path / "store"))
+        try:
+            code, body, hdrs = _post(
+                server.server_port, "/check",
+                {"model": "cas-register", "history": _ops()})
+            assert code == 429 and body["error"] == "queue-full"
+            assert int(hdrs["Retry-After"]) >= 1
+        finally:
+            server.shutdown()
+            daemon.stop()
+
+    def test_bad_json_400_and_results_browser_still_mounted(self,
+                                                            tmp_path):
+        cfg = serve_ns.ServeConfig(root=str(tmp_path / "serve"))
+        daemon, server = serve_ns.run_daemon(
+            cfg, host="127.0.0.1", port=0,
+            store_root=str(tmp_path / "store"))
+        port = server.server_port
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/check", data=b"{not json",
+                method="POST")
+            try:
+                with urllib.request.urlopen(req) as r:
+                    code = r.status
+            except urllib.error.HTTPError as e:
+                code = e.code
+            assert code == 400
+            # the grown handler still serves the results browser + the
+            # Prometheus exposition (one port, one scrape target)
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics") as r:
+                text = r.read().decode()
+            assert "jtpu_serve_queue_depth" in text
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/") as r:
+                assert r.status == 200
+        finally:
+            server.shutdown()
+            daemon.stop()
+
+
+# ---------------------------------------------------------------------------
+# Observability surfacing
+# ---------------------------------------------------------------------------
+
+
+class TestServeObservability:
+    def test_heartbeat_feeds_watch_and_live(self, tmp_path):
+        from jepsen_tpu.obs import observatory
+        d = _daemon(tmp_path, start=True)
+        code, body, _ = d.submit({"model": "cas-register",
+                                  "history": _ops()})
+        assert code == 202
+        _wait_done(d, body["id"])
+        d._publish(force=True)
+        p = observatory.read_progress(d.config.root)
+        assert p is not None and p["serve"]["completed"] >= 1
+        line = observatory.format_status(p)
+        assert line.startswith("# serve: ")
+        assert "queue" in line and "done" in line
+        d.stop()
+
+
+# ---------------------------------------------------------------------------
+# Kill switch: daemon unused == identical behavior
+# ---------------------------------------------------------------------------
+
+
+class TestKillSwitch:
+    def test_env_alone_changes_no_verdicts(self, monkeypatch):
+        p, kernel = _packed(_ops(3))
+        monkeypatch.delenv("JTPU_SERVE", raising=False)
+        r_off = T.check_packed_tpu(p, kernel)
+        monkeypatch.setenv("JTPU_SERVE", "1")
+        r_on = T.check_packed_tpu(p, kernel)
+        for key in ("valid", "levels", "rung", "work", "crash-width"):
+            assert r_off.get(key) == r_on.get(key)
+
+    def test_plain_serve_handler_has_no_daemon_routes(self):
+        from jepsen_tpu import web
+        server = web.serve(host="127.0.0.1", port=0, root="store")
+        try:
+            handler = server.RequestHandlerClass
+            assert not hasattr(handler, "daemon")
+            assert "do_POST" not in dir(web.Handler) or \
+                not hasattr(web.Handler, "do_POST")
+        finally:
+            server.server_close()
+
+    def test_serve_cli_defaults_keep_daemon_off(self, monkeypatch):
+        from jepsen_tpu import cli
+        monkeypatch.delenv("JTPU_SERVE", raising=False)
+        spec = cli.serve_cmd()["serve"]
+        ns = spec["parser"]().parse_args([])
+        assert ns.check_daemon is False
+        assert serve_ns.serve_enabled() is False
+
+    def test_importing_serve_leaves_checks_identical(self):
+        import jepsen_tpu.serve  # noqa: F401 — the import IS the test
+        p, kernel = _packed(_ops(3))
+        r1 = T.check_packed_tpu(p, kernel)
+        r2 = T.check_packed_tpu(p, kernel)
+        for key in ("valid", "levels", "rung", "work"):
+            assert r1.get(key) == r2.get(key)

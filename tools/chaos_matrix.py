@@ -49,6 +49,11 @@ qualify a new accelerator image before trusting it with long runs):
                    (remesh-to-1-hosts trail event), finishes the
                    search, and the verdict matches the single-host
                    baseline AND the CPU oracle
+  serve-kill       SIGKILL the check daemon (`jtpu serve`) with one
+                   request in-flight and one queued: a restarted
+                   daemon replays its request journal (serve.wal),
+                   re-checks both, and both verdicts are identical to
+                   the offline analyze path
 
 Usage: python tools/chaos_matrix.py [--seed N] [--only NAME ...]
 Exit code 0 iff every selected scenario passes — nonzero on any
@@ -840,6 +845,141 @@ def scenario_fleet_host_kill(seed):
     return ok, "; ".join(details)
 
 
+def scenario_serve_kill(seed):
+    """SIGKILL the check daemon (`jtpu serve`) with one request
+    IN-FLIGHT and one QUEUED. A restarted daemon must replay its
+    request journal (serve.wal), re-run both requests, and render
+    verdicts identical to the offline analyze path — the serve layer's
+    crash-safety proof (doc/serve.md)."""
+    import tempfile
+    import urllib.request
+
+    from jepsen_tpu import serve as serve_ns
+    from jepsen_tpu.checker import check_safe
+    from jepsen_tpu.checker.wgl import linearizable
+    from jepsen_tpu.history import History
+    from jepsen_tpu.testing import simulate_register_history
+
+    root = tempfile.mkdtemp(prefix="jepsen-chaos-servekill-")
+    serve_dir = os.path.join(root, "serve")
+    port_file = os.path.join(root, "port.json")
+    # req1: dense enough that a cold child process is still checking it
+    # when the kill lands; req2: small, stays queued behind it
+    h1 = simulate_register_history(300, n_procs=5, n_vals=4, seed=seed)
+    h2 = simulate_register_history(40, n_procs=3, n_vals=3,
+                                   seed=seed + 1)
+    ops1 = [o.to_dict() for o in h1]
+    ops2 = [o.to_dict() for o in h2]
+
+    child_src = (
+        "import json, sys\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "from jepsen_tpu import serve as S\n"
+        f"cfg = S.ServeConfig(root={serve_dir!r}, backend='tpu', "
+        "workers=1)\n"
+        f"d, srv = S.run_daemon(cfg, host='127.0.0.1', port=0, "
+        f"store_root={root!r})\n"
+        f"json.dump({{'port': srv.server_port}}, "
+        f"open({port_file!r}, 'w'))\n"
+        "d.drained.wait()\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen([sys.executable, "-c", child_src], env=env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+
+    def post(port, doc):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/check",
+            data=json.dumps(doc).encode(), method="POST")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.load(r)
+
+    def get_state(port, rid):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/check/{rid}", timeout=10) as r:
+            return json.load(r)["state"]
+
+    try:
+        deadline = time.time() + 60
+        port = None
+        while time.time() < deadline:
+            if os.path.exists(port_file):
+                try:
+                    with open(port_file) as f:
+                        port = json.load(f)["port"]
+                    break
+                except (OSError, ValueError):
+                    pass
+            if proc.poll() is not None:
+                return False, f"daemon exited rc={proc.returncode} at boot"
+            time.sleep(0.1)
+        if port is None:
+            return False, "daemon never published its port"
+        r1 = post(port, {"tenant": "a", "model": "cas-register",
+                         "history": ops1})
+        r2 = post(port, {"tenant": "b", "model": "cas-register",
+                         "history": ops2})
+        # wait for the exact crash window: req1 in flight, req2 queued
+        while time.time() < deadline:
+            s1 = get_state(port, r1["id"])
+            if s1 == "done":
+                return False, ("req1 finished before the kill — make "
+                               "it denser")
+            if s1 == "running" and get_state(port, r2["id"]) == "queued":
+                break
+            time.sleep(0.02)
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    # restart (in-process incarnation on the same journal)
+    d2 = serve_ns.CheckDaemon(
+        serve_ns.ServeConfig(root=serve_dir, backend="tpu", workers=1))
+    d2.start()
+    details = []
+    ok = True
+    if d2.replay_stats.get("requeued") != 2:
+        d2.stop()
+        return False, (f"replay requeued "
+                       f"{d2.replay_stats.get('requeued')}, want 2 "
+                       f"(stats {d2.replay_stats})")
+    details.append("replayed 2 journaled request(s) after SIGKILL")
+    with d2._lock:
+        rids = list(d2._by_id)
+    deadline = time.time() + 120
+    docs = {}
+    for rid in rids:
+        while time.time() < deadline:
+            doc = d2.status(rid)
+            if doc and doc["state"] == "done":
+                docs[rid] = doc
+                break
+            time.sleep(0.05)
+    d2.drain(timeout_s=10)
+    d2.stop()
+    if len(docs) != 2:
+        return False, f"re-checked {len(docs)}/2 replayed requests"
+    # both verdicts must match the offline analyze path
+    for doc, ops in zip(
+            (docs[r] for r in sorted(docs, key=lambda x: docs[x][
+                "tenant"])),
+            (ops1, ops2)):
+        offline = check_safe(
+            linearizable(CASRegister(), backend="tpu"),
+            {"name": "chaos-serve-offline"}, History.of(ops))
+        got = doc["result"].get("valid")
+        if got != offline.get("valid"):
+            ok = False
+            details.append(f"tenant {doc['tenant']}: served {got!r} != "
+                           f"offline {offline.get('valid')!r}")
+        else:
+            details.append(f"tenant {doc['tenant']}: verdict {got} == "
+                           f"offline")
+    return ok, "; ".join(details)
+
+
 SCENARIOS = (
     ("oom", scenario_oom),
     ("wedge", scenario_wedge),
@@ -853,6 +993,7 @@ SCENARIOS = (
     ("prof-kill", scenario_prof_kill),
     ("plan-rejects", scenario_plan_rejects),
     ("fleet-host-kill", scenario_fleet_host_kill),
+    ("serve-kill", scenario_serve_kill),
 )
 
 

@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""CI serve gate: stand the check daemon up, POST a REAL localkv
+history at it over HTTP, poll the verdict, drain, and exit — inside a
+wall-clock bound (default 30 s, run next to lint_gate / prof_gate /
+bench_gate in CI).
+
+The daemon path (`python -m jepsen_tpu serve --check-daemon`,
+doc/serve.md) crosses five layers — the HTTP front-end, admission
+control, the request journal, the warm-engine check execution, and
+drain — and a regression in any of them only surfaces on a real
+served request. This gate IS that request:
+
+* a real localkv suite (real daemons, real sockets) produces a real
+  history;
+* the daemon admits it (202 + id), checks it on the warm device path,
+  and the polled verdict must be ``valid: true`` AND identical to the
+  offline ``analyze``-path verdict computed in-process;
+* ``/healthz`` must report the completed request and a warm bucket;
+* ``POST /drain`` must finish in-flight work and release the daemon
+  (exit-0 contract).
+
+Usage: python tools/serve_gate.py [--budget SECONDS] [--time-limit S]
+Exit code 0 iff the served verdict matches offline within the budget.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _post(port, path, doc):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(doc).encode() if doc is not None else b"",
+        method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.load(r)
+    except urllib.error.HTTPError as e:
+        return e.code, json.load(e)
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return r.status, json.load(r)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--budget", type=float, default=30.0,
+                    help="wall-clock bound for the whole gate (s)")
+    ap.add_argument("--time-limit", type=int, default=3,
+                    help="localkv workload seconds")
+    args = ap.parse_args()
+    t0 = time.time()
+
+    from jepsen_tpu import core, serve as serve_ns
+    from jepsen_tpu.suites.localkv import localkv_test
+
+    # 1. a REAL history from a real localkv run
+    root = tempfile.mkdtemp(prefix="jepsen-serve-gate-")
+    test = localkv_test({"time-limit": args.time_limit,
+                         "nemesis-period": 2})
+    test["store-dir"] = os.path.join(root, "local-kv", "run")
+    test = core.run(test)
+    history = [op.to_dict() for op in test["history"]]
+    if not history:
+        print("# serve-gate: FAILED — localkv produced no history",
+              file=sys.stderr)
+        return 1
+
+    # 2. the daemon, on a real port
+    cfg = serve_ns.ServeConfig(root=os.path.join(root, "serve"),
+                               backend="tpu")
+    daemon, server = serve_ns.run_daemon(
+        cfg, host="127.0.0.1", port=0, store_root=root)
+    port = server.server_port
+    problems = []
+    verdict = None
+    try:
+        code, body = _post(port, "/check",
+                           {"tenant": "gate", "model": "cas-register",
+                            "history": history})
+        if code != 202:
+            problems.append(f"POST /check answered {code}: {body}")
+        else:
+            rid = body["id"]
+            deadline = time.time() + args.budget
+            doc = {}
+            while time.time() < deadline:
+                _, doc = _get(port, f"/check/{rid}")
+                if doc.get("state") == "done":
+                    break
+                time.sleep(0.1)
+            if doc.get("state") != "done":
+                problems.append(f"request never finished: {doc}")
+            else:
+                verdict = doc["result"].get("valid")
+                if verdict is not True:
+                    problems.append(
+                        f"served verdict {verdict!r}, want True")
+                # the crash-safety equality leg: served == offline
+                from jepsen_tpu.checker import check_safe
+                from jepsen_tpu.checker.wgl import linearizable
+                from jepsen_tpu.history import History
+                from jepsen_tpu.models import CASRegister
+                offline = check_safe(
+                    linearizable(CASRegister(), backend="tpu"),
+                    {"name": "serve-gate-offline"},
+                    History.of(history))
+                if offline.get("valid") != verdict:
+                    problems.append(
+                        f"served verdict {verdict!r} != offline "
+                        f"{offline.get('valid')!r}")
+        _, health = _get(port, "/healthz")
+        if not health.get("stats", {}).get("completed"):
+            problems.append(f"healthz reports no completed request: "
+                            f"{health.get('stats')}")
+        if not health.get("engine", {}).get("warm-buckets"):
+            problems.append("healthz reports no warm bucket")
+        code, drained = _post(port, "/drain", None)
+        if code != 200 or not drained.get("drained"):
+            problems.append(f"drain answered {code}: {drained}")
+        if not daemon.drained.wait(timeout=5):
+            problems.append("drain did not release the daemon")
+    finally:
+        server.shutdown()
+        daemon.stop()
+
+    wall = time.time() - t0
+    if wall > args.budget:
+        problems.append(f"gate overran its {args.budget:.0f}s budget "
+                        f"({wall:.1f}s)")
+    print(f"# serve-gate: {len(history)} op(s) served, verdict="
+          f"{verdict!r}, {wall:.1f}s")
+    if problems:
+        for p in problems:
+            print(f"# serve-gate: FAILED — {p}", file=sys.stderr)
+        return 1
+    print("# serve-gate: served verdict matches the offline path; "
+          "drain released the daemon")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
