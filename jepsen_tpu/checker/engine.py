@@ -71,10 +71,26 @@ _ENGINE_HITS = obs_metrics.counter(
     "jtpu_engine_cache_hits_total",
     "Engine executable-cache hits (the explicit table that replaced "
     "the lru_cache'd factories)")
+_ENGINE_EVICTIONS = obs_metrics.counter(
+    "jtpu_engine_evictions_total",
+    "warm shape buckets LRU-evicted past the max-warm-buckets cap "
+    "(JTPU_ENGINE_MAX_BUCKETS / --engine-max-buckets)")
 
 #: Default executable-table capacity — matches the lru_cache(maxsize=64)
 #: the factories used, so eviction behavior is unchanged for CLI runs.
 DEFAULT_MAX_ENTRIES = 64
+
+
+def _env_max_warm_buckets() -> int:
+    """JTPU_ENGINE_MAX_BUCKETS: cap on warmed shape buckets per Engine
+    (LRU past it); 0 / absent / malformed mean unbounded — the pre-cap
+    behavior, byte-identical."""
+    import os
+    try:
+        return max(0, int(os.environ.get("JTPU_ENGINE_MAX_BUCKETS")
+                          or "0"))
+    except ValueError:
+        return 0
 
 
 class Engine:
@@ -88,14 +104,23 @@ class Engine:
     """
 
     def __init__(self, name: str = "default",
-                 max_entries: int = DEFAULT_MAX_ENTRIES):
+                 max_entries: int = DEFAULT_MAX_ENTRIES,
+                 max_warm_buckets: Optional[int] = None):
         self.name = name
         self.max_entries = int(max_entries)
         self._lock = threading.Lock()
         self._fns: "collections.OrderedDict[tuple, Any]" = \
             collections.OrderedDict()
-        #: bucket_key -> {"shapes", "seconds", "ts"} for warmed buckets.
-        self._warm: Dict[tuple, Dict[str, Any]] = {}
+        #: bucket_key -> {"shapes", "seconds", "ts"} for warmed buckets,
+        #: LRU-ordered (warm() touches; past max_warm_buckets the
+        #: stalest bucket's warm claim is dropped and re-warms on next
+        #: use — the serve daemon's warm-state eviction policy).
+        self._warm: "collections.OrderedDict[tuple, Dict[str, Any]]" = \
+            collections.OrderedDict()
+        self.max_warm_buckets = (_env_max_warm_buckets()
+                                 if max_warm_buckets is None
+                                 else max(0, int(max_warm_buckets)))
+        self.evictions = 0
         self.builds = 0
         self.hits = 0
 
@@ -197,6 +222,40 @@ class Engine:
         return self._get(("batch", kernel_id, capacity, window, expand,
                           unroll, tiebreak), build)
 
+    def jit_batch_segment(self, kernel_id: int, capacity: int,
+                          window: int, expand: Optional[int] = None,
+                          unroll: int = 1):
+        """One bounded-iteration checkpointed segment vmapped over a
+        GANG of same-bucket histories — the serve daemon's concurrent-
+        batching executable (doc/serve.md "Concurrent batching"). The
+        packed columns and the search carry gain a leading gang axis;
+        ``seg_iters`` stays shared. The per-lane body is the same
+        ``_search_fn(..., segment=True)`` closure :meth:`jit_segment`
+        builds, so a gang lane computes exactly the serial segmented
+        search — the P-compositionality equality the batching layer's
+        serial-equivalence assertions lean on. A lane whose carry is
+        done (or whose pool has no live rows) no-ops inside the vmapped
+        while_loop, which is what lets the host cancel one member at a
+        segment barrier without aborting its cohort."""
+        import jax
+        kernel = T._KERNELS_BY_ID[kernel_id]
+
+        def build():
+            def gang_seg(f, v1, v2, ro, fr, inv, ret, sm, cf, cv1, cv2,
+                         cinv, cps, nr, ini, seg_iters, carry):
+                search = T._search_fn(kernel.step, f.shape[1],
+                                      cf.shape[1], capacity, window,
+                                      expand, unroll, segment=True)
+                return jax.vmap(
+                    search, in_axes=(0,) * 15 + (None, 0))(
+                    f, v1, v2, ro, fr, inv, ret, sm, cf, cv1, cv2,
+                    cinv, cps, nr, ini, seg_iters, carry)
+
+            return jax.jit(gang_seg)
+
+        return self._get(("batch-segment", kernel_id, capacity, window,
+                          expand, unroll), build)
+
     # -- shape buckets ------------------------------------------------------
 
     @staticmethod
@@ -223,9 +282,30 @@ class Engine:
             return dict(rec) if rec else None
 
     def warm_buckets(self) -> list:
-        """The buckets this Engine has warmed, insertion-ordered."""
+        """The buckets this Engine has warmed, LRU order (stalest
+        first — the next eviction victim leads)."""
         with self._lock:
             return list(self._warm)
+
+    def _trim_warm_locked(self) -> None:
+        while 0 < self.max_warm_buckets < len(self._warm):
+            b, _ = self._warm.popitem(last=False)
+            self.evictions += 1
+            _ENGINE_EVICTIONS.inc()
+            log.info("engine %s: evicted warm bucket %s (cap %d)",
+                     self.name, b, self.max_warm_buckets)
+
+    def set_max_warm_buckets(self, n: int) -> None:
+        """(Re)cap the warm-bucket table — the serve daemon wires
+        ``--engine-max-buckets`` here. 0 = unbounded. Shrinking below
+        the current population evicts stalest-first immediately. Only
+        the warm CLAIM is dropped (the bucket re-warms on next use);
+        the compiled executables live in the separately-bounded
+        ``max_entries`` jit table, which per-rung keys share across
+        buckets and which was always LRU."""
+        with self._lock:
+            self.max_warm_buckets = max(0, int(n))
+            self._trim_warm_locked()
 
     # -- ahead-of-time warming ---------------------------------------------
 
@@ -255,6 +335,10 @@ class Engine:
         bucket = self.bucket_key(p, kernel)
         with self._lock:
             rec = self._warm.get(bucket)
+            if rec is not None:
+                # LRU touch: a bucket in active use must not be the
+                # eviction victim while a cold one survives
+                self._warm.move_to_end(bucket)
         if rec is not None:
             return dict(rec, bucket=bucket, **{"already-warm": True})
         t0 = time.perf_counter()
@@ -305,6 +389,8 @@ class Engine:
                "ts": time.time()}
         with self._lock:
             self._warm.setdefault(bucket, rec)
+            self._warm.move_to_end(bucket)
+            self._trim_warm_locked()
         log.info("engine %s: warmed bucket %s (%d shape(s), %.2fs)",
                  self.name, bucket, shapes, secs)
         return dict(rec, bucket=bucket, **{"already-warm": False})

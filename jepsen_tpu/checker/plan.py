@@ -931,3 +931,21 @@ def request_footprint(dims: PlanDims,
     if not cands:
         return None
     return int(footprint(cands[0])["total-bytes"])
+
+
+def gang_footprint(dims: PlanDims, size: int,
+                   kind: str = "segment") -> Optional[int]:
+    """Predicted device bytes of a ``size``-member GANG over these
+    dims — :func:`request_footprint` scaled by the gang size, because
+    batched execution (checker.tpu.check_packed_gang) stacks every
+    packed column and every pool/carry row on a leading gang axis, so
+    the working set is linear in members. The serve daemon's
+    BatchScheduler prices the WHOLE gang here BEFORE dispatch
+    (doc/serve.md "Concurrent batching") and caps the gang at the
+    largest size that fits the admission byte budget — the gang-shaped
+    extension of the per-request 429 contract. None when the dims
+    cannot plan at all."""
+    if size < 1:
+        return None
+    fp = request_footprint(dims, kind=kind)
+    return None if fp is None else int(fp) * int(size)

@@ -47,7 +47,7 @@ caller finally falls back to the exact CPU search.
 from __future__ import annotations
 
 import time as _hosttime
-from typing import Any, Dict, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -1072,6 +1072,16 @@ def _jit_batch(kernel_id: int, capacity: int, window: int,
                                unroll, tiebreak)
 
 
+def _jit_batch_segment(kernel_id: int, capacity: int, window: int,
+                       expand: Optional[int] = None, unroll: int = 1):
+    """One checkpointed segment vmapped over a GANG of same-bucket
+    single-key histories (engine.jit_batch_segment) — the executable
+    behind :func:`check_packed_gang` and the serve daemon's concurrent
+    batching."""
+    return _engine().jit_batch_segment(kernel_id, capacity, window,
+                                       expand, unroll)
+
+
 #: Max crashed ('info') ops per key (four crashed-mask words). Crash-
 #: heavy searches are the hardest axis (every crashed op is optional
 #: at every point), so wide-crash histories lean on the canonical-order
@@ -1460,6 +1470,174 @@ def _check_packed_ladder(p, kernel, ladder, cols, plan_entry, work,
         if bool(wovf) and win >= MAX_WINDOW and not bool(lossy):
             return out  # a bigger frontier won't fix a window overflow
     return out
+
+
+#: Fault-injection seam for the gang dispatch path (the batched twin of
+#: resilience._inject_fault): when set, called with the gang's packed
+#: members right before any device work — raising from it simulates a
+#: device failure of the WHOLE batched call, which is exactly the event
+#: resilience.bisect_poison isolates by splitting and re-running.
+#: tests/test_serve.py and tools/chaos_matrix.py's serve-batch-poison
+#: scenario set and clear it.
+_GANG_FAULT: Optional[Callable[[list], None]] = None
+
+
+def check_packed_gang(pks: Sequence[PackedHistory], kernel: KernelSpec,
+                      deadlines: Optional[Sequence[Optional[float]]]
+                      = None,
+                      segment_iters: Optional[int] = None
+                      ) -> List[Dict[str, Any]]:
+    """Check a GANG of packed single-key histories in ONE vmapped
+    device call per segment — the serve daemon's concurrent-batching
+    seam (doc/serve.md "Concurrent batching").
+
+    Per-member semantics are exactly :func:`check_packed_tpu`'s
+    segmented search: the same escalation ladder (``_ladder_for`` at
+    the member's needed window), the same per-lane search body
+    (engine.jit_batch_segment vmaps the ``segment=True`` closure
+    jit_segment builds), the same carry summary — so member ``i``'s
+    verdict and counterexample artifacts are identical to checking it
+    alone. P-compositionality (arXiv:1504.00204) grounds the claim:
+    independent histories are independent sub-problems, and a vmap
+    lane neither reads nor writes any other lane.
+
+    ``deadlines[i]`` is an ABSOLUTE ``time.monotonic()`` deadline for
+    member ``i`` (None = unbounded). A member past its deadline is
+    cancelled at the next segment barrier — its lane's live pool rows
+    are cleared host-side, making its vmapped while-condition false, so
+    later segments no-op the lane while the cohort keeps running — and
+    it reports the serve timeout shape ``{"valid": "unknown", "error":
+    ":info/timeout", "error-class": "wedge"}``.
+
+    Deliberately NO OOM-halving or plan-seeding happens here: shrinking
+    the pool mid-gang would change every lane's shape and break the
+    serial-equivalence contract. A failed device call raises to the
+    caller, where :func:`jepsen_tpu.resilience.bisect_poison` splits
+    the gang and converges on the poison member; callers price the
+    whole gang beforehand via
+    :func:`jepsen_tpu.checker.plan.gang_footprint`.
+
+    Returns one result dict per member, aligned with ``pks``.
+    """
+    pks = list(pks)
+    if not pks:
+        return []
+    if _GANG_FAULT is not None:
+        _GANG_FAULT(pks)
+    results: List[Optional[Dict[str, Any]]] = [None] * len(pks)
+    # Per-member early outs (the _prep_single trivial / crashed-set-
+    # overflow cases), then group survivors by their exact escalation
+    # ladder: members needing different window buckets must escalate
+    # exactly as they would serially, not on a merged ladder.
+    groups: Dict[tuple, list] = {}
+    for i, p in enumerate(pks):
+        if p.n_required == 0:
+            results[i] = {"valid": True, "levels": 0, "backend": "tpu"}
+        elif _crash_width(p.n - p.n_required) is None:
+            results[i] = {
+                "valid": UNKNOWN, "backend": "tpu",
+                "error": f"{p.n - p.n_required} crashed ops exceed the "
+                         f"crashed-set width {CRASH_MAX}"}
+        else:
+            groups.setdefault(
+                _ladder_for(_window_needed(p)), []).append(i)
+    if not groups:
+        return results
+    from jepsen_tpu import accel
+    accel.ensure_usable("check_packed_gang")
+    # gangs always run segmented: the segment barrier IS the per-member
+    # cancellation point, so a 0/monolithic config still segments
+    seg = _segment_config(segment_iters) or DEFAULT_SEGMENT_ITERS
+    for ladder, idx in groups.items():
+        _gang_ladder(pks, kernel, idx, ladder, seg, deadlines, results)
+    return results
+
+
+def _gang_ladder(pks, kernel, idx, ladder, seg, deadlines,
+                 results) -> None:
+    """Run one ladder-homogeneous gang group through the escalation
+    ladder, writing each member's result into ``results``."""
+    kid = _kernel_key(kernel)
+    unroll = _unroll_factor()
+    breq = max(_bucket(pks[i].n_required) for i in idx)
+    crw = max(_crash_width(pks[i].n - pks[i].n_required) for i in idx)
+    cols = {i: _split_packed(pks[i], breq, crw, kernel) for i in idx}
+    work: Dict[int, list] = {i: [] for i in idx}
+    pending = list(idx)
+    for cap, win, exp in ladder:
+        if not pending:
+            return
+        rows = [cols[i] for i in pending]
+        arrays = [np.stack([np.asarray(c[col]) for c in rows])
+                  for col in _COLS]
+        cr_pad = int(rows[0]["cf"].shape[0])
+        lmax = _level_budget(breq, cr_pad)
+        carry_b = tuple(
+            np.stack(lanes) for lanes in zip(*(
+                _carry0_host(cap, win, cr_pad, c["ini"], int(c["nr"]))
+                for c in rows)))
+        fn = _jit_batch_segment(kid, cap, win, exp, unroll)
+        shape_key = ("batch-segment", kid, cap, win, exp, unroll,
+                     len(pending), breq, cr_pad)
+        lane_live = [True] * len(pending)
+        timed_out: set = set()
+        while any(lane_live):
+            outs, _, _ = _timed_call(
+                "batch-segment", shape_key, fn,
+                arrays + [np.int32(seg), carry_b],
+                rung=(cap, win, exp), gang=len(pending))
+            # writable host snapshot: the checkpoint, and the thing the
+            # barrier below edits to cancel an overdue lane
+            carry_b = tuple(np.array(x) for x in outs)
+            _SEGMENTS_TOTAL.inc()
+            now = _hosttime.monotonic()
+            for j, i in enumerate(pending):
+                if not lane_live[j]:
+                    continue
+                lane = tuple(a[j] for a in carry_b)
+                if not _carry_active(lane, lmax):
+                    lane_live[j] = False
+                    continue
+                dl = deadlines[i] if deadlines else None
+                if dl is not None and now >= dl:
+                    # deadline barrier-cancel: clear the lane's live
+                    # rows so its while-condition goes false; the
+                    # cohort's lanes are untouched
+                    carry_b[4][j, ...] = False
+                    lane_live[j] = False
+                    timed_out.add(i)
+        still = []
+        for j, i in enumerate(pending):
+            lane = tuple(a[j] for a in carry_b)
+            if i in timed_out:
+                # a cancelled lane's carry must NOT be summarized —
+                # "no live rows" would misread as a refutation. This is
+                # the serve timeout result shape (serve._run_one).
+                results[i] = {
+                    "valid": UNKNOWN, "error": ":info/timeout",
+                    "error-class": "wedge", "backend": "tpu",
+                    "levels": int(lane[8]), "rung": (cap, win, exp),
+                    "gang-cancelled": True}
+                continue
+            done, lossy, wovf, best, levels, pool = \
+                _summarize_carry(lane)
+            _LEVELS_TOTAL.inc(levels)
+            out = _result(done, lossy, wovf, best, levels, pks[i],
+                          pool=pool)
+            out["rung"] = (cap, win, exp)
+            out["crash-width"] = _crash_width(
+                pks[i].n - pks[i].n_required) or 0
+            out["tiebreak"] = "lex"
+            work[i].append(((cap, win, exp), out["crash-width"], "lex",
+                            levels))
+            out["work"] = list(work[i])
+            out["gang-size"] = len(pending)
+            results[i] = out
+            if out["valid"] is UNKNOWN and not (
+                    bool(wovf) and win >= MAX_WINDOW
+                    and not bool(lossy)):
+                still.append(i)
+        pending = still
 
 
 #: Mesh axis name for pool-sharded single-history searches.

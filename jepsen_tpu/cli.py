@@ -276,6 +276,21 @@ def serve_cmd() -> dict:
                        choices=["cpu", "tpu"],
                        help="checker backend for daemon requests "
                             "(default: tpu — the warm device path)")
+        p.add_argument("--batch-max", type=int, default=None,
+                       help="max same-bucket requests coalesced into "
+                            "one gang-scheduled device call; 0 or 1 "
+                            "disables batching (JTPU_SERVE_BATCH_MAX)")
+        p.add_argument("--batch-wait-ms", type=float, default=None,
+                       help="coalesce window a gang leader waits for "
+                            "cohort members (JTPU_SERVE_BATCH_WAIT_MS)")
+        p.add_argument("--auth-token", default=None, metavar="TOKEN",
+                       help="require 'Authorization: Bearer TOKEN' on "
+                            "POST /check and /drain; metrics/healthz "
+                            "stay open (JTPU_SERVE_TOKEN)")
+        p.add_argument("--engine-max-buckets", type=int, default=None,
+                       help="LRU-evict warmed engine buckets past this "
+                            "count; 0 = unbounded "
+                            "(JTPU_ENGINE_MAX_BUCKETS)")
         return p
 
     def run(opts) -> int:
@@ -307,6 +322,15 @@ def serve_cmd() -> dict:
             cfg.compile_cache = opts["compile_cache"]
         if opts.get("serve_backend") is not None:
             cfg.backend = opts["serve_backend"]
+        if opts.get("batch_max") is not None:
+            cfg.batch_max = opts["batch_max"]
+            cfg.batch_enabled = opts["batch_max"] > 1
+        if opts.get("batch_wait_ms") is not None:
+            cfg.batch_wait_ms = opts["batch_wait_ms"]
+        if opts.get("auth_token") is not None:
+            cfg.auth_token = opts["auth_token"] or None
+        if opts.get("engine_max_buckets") is not None:
+            cfg.engine_max_buckets = opts["engine_max_buckets"]
         daemon, server = serve_ns.run_daemon(
             cfg, host=opts["host"], port=opts["port"],
             store_root=opts["store_root"])

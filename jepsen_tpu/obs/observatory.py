@@ -315,6 +315,12 @@ def format_status(p: Optional[Dict[str, Any]]) -> str:
                 f"inflight {s.get('inflight', 0)}",
                 f"done {s.get('completed', 0)}",
                 f"rejected {s.get('rejected', 0)}"]
+        if s.get("batches"):
+            gang = f"batches {s['batches']} (max {s.get('max-batch', 0)})"
+            bits.append(gang)
+        if s.get("poisoned"):
+            bits.append(f"poisoned {s['poisoned']} "
+                        f"(bisections {s.get('bisections', 0)})")
         if s.get("breakers-open"):
             bits.append(f"breakers-open {s['breakers-open']}")
         if s.get("warm-buckets") is not None:

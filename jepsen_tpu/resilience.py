@@ -178,6 +178,69 @@ def result_failure_class(result: Optional[Dict[str, Any]]
     return None
 
 
+def bisect_poison(members: list, run_gang: Callable[[list], list]
+                  ) -> tuple:
+    """Fault-isolated gang execution: run ``run_gang`` over the whole
+    gang; when the batched call FAILS (raises, or returns a single
+    failure dict instead of a per-member list), split the gang in half
+    and re-run each half, converging on the poison member(s) — the
+    blast-radius containment the serve daemon's concurrent batching
+    ships with (doc/serve.md "Concurrent batching").
+
+    The failure taxonomy drives the recursion:
+    :func:`result_failure_class` names the gang-level failure's class
+    (an injected/real OOM, a wedge, ...); a CLASSIFIED failure on a
+    gang of two or more is worth halving — some member provoked it and
+    the rest are owed their verdicts — while a failure that has
+    converged to one member (or carries no recognised class) is
+    attributed to exactly that span: those members get the failure dict
+    as their result and land in the poison list, so the caller can fail
+    ONLY them and count ONLY them toward breaker accounting.
+
+    ``run_gang(sub)`` takes a sub-list of ``members`` and returns a
+    result list aligned with it; an exception it raises is converted to
+    a failure dict via :func:`classify_failure`. P-compositionality is
+    again what makes re-execution sound: members are independent
+    sub-problems, so a half-gang re-run answers exactly what the full
+    gang would have.
+
+    Returns ``(results, poison_indices, bisections)`` with ``results``
+    aligned to ``members``.
+    """
+    results: list = [None] * len(members)
+    poison: list = []
+    bisections = 0
+
+    def fail_dict(exc: BaseException) -> Dict[str, Any]:
+        return {"valid": UNKNOWN,
+                "error": f"{type(exc).__name__}: {exc}",
+                "error-class": classify_failure(exc)}
+
+    def go(span: list) -> None:
+        nonlocal bisections
+        try:
+            out = run_gang([members[i] for i in span])
+        except Exception as e:  # noqa: BLE001 — the device call failed
+            out = fail_dict(e)
+        if isinstance(out, list):
+            for i, r in zip(span, out):
+                results[i] = r
+            return
+        cls = result_failure_class(out)
+        if len(span) > 1 and cls is not None:
+            bisections += 1
+            mid = (len(span) + 1) // 2
+            go(span[:mid])
+            go(span[mid:])
+            return
+        for i in span:
+            results[i] = dict(out)
+            poison.append(i)
+
+    go(list(range(len(members))))
+    return results, poison, bisections
+
+
 def _env_float(name: str, default: float) -> float:
     v = os.environ.get(name)
     if not v:

@@ -43,6 +43,22 @@ Robustness is the headline, piece by piece:
   ``Retry-After`` while every other bucket keeps serving. After a
   jittered cooldown the breaker goes half-open and admits one probe;
   success closes it, failure re-opens with doubled cooldown.
+* **Fault-isolated concurrent batching** — queued requests sharing an
+  engine shape bucket coalesce (bounded size, short window, tenant-fair
+  fill) into a gang dispatched as ONE vmapped device call
+  (:func:`jepsen_tpu.checker.tpu.check_packed_gang`). A failing gang is
+  bisected (:func:`jepsen_tpu.resilience.bisect_poison`) until the
+  poison request is isolated: only IT fails (and only it counts toward
+  its bucket's breaker, tagged to its tenant); survivors' verdicts are
+  bit-identical to serial execution. Per-request deadlines cancel one
+  lane at the next segment barrier without aborting its cohort.
+  ``JTPU_SERVE_BATCH=0`` restores serial behavior byte-identically.
+* **Warm-state eviction** — ``--engine-max-buckets`` /
+  ``JTPU_ENGINE_MAX_BUCKETS`` bounds the engine's warm-bucket claim
+  (LRU) so a daemon serving many shapes cannot grow without bound.
+* **Shared-secret auth** — ``--auth-token`` / ``JTPU_SERVE_TOKEN``
+  requires ``Authorization: Bearer`` on ``POST /check`` and ``/drain``;
+  ``/metrics``, ``/healthz`` and the results browser stay open.
 * **Graceful drain** — ``POST /drain`` stops admission, finishes
   in-flight work, leaves the still-queued remainder journaled for the
   next incarnation, and lets the CLI exit 0.
@@ -66,6 +82,7 @@ tests/test_serve.py).
 
 from __future__ import annotations
 
+import hmac
 import json
 import logging
 import os
@@ -119,6 +136,24 @@ _QUEUE_WAIT = obs_metrics.histogram(
     "seconds a request spent queued before a worker picked it up",
     buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
              60.0, 300.0))
+_BATCH_SIZE = obs_metrics.histogram(
+    "jtpu_serve_batch_size",
+    "realized gang size per batched dispatch (1 = a request that "
+    "found no same-bucket cohort inside the coalesce window)",
+    buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0))
+_COALESCE_WAIT = obs_metrics.histogram(
+    "jtpu_serve_batch_coalesce_wait_seconds",
+    "seconds a gang leader spent coalescing cohort members before "
+    "dispatch (bounded by --batch-wait-ms)",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25))
+_BATCH_BISECTIONS = obs_metrics.counter(
+    "jtpu_serve_batch_bisections_total",
+    "gang splits performed by poison-request bisection after a failed "
+    "batched device call")
+_BATCH_POISON = obs_metrics.counter(
+    "jtpu_serve_batch_poison_total",
+    "requests isolated as the poison member of a failed gang, labeled "
+    "tenant — only these count toward their bucket's circuit breaker")
 
 
 def serve_enabled() -> bool:
@@ -188,6 +223,37 @@ class ServeConfig:
     backend: str = field(
         default_factory=lambda: os.environ.get(
             "JTPU_SERVE_BACKEND", "tpu"))
+    # -- concurrent batching (doc/serve.md "Concurrent batching") -----------
+    #: Kill switch: JTPU_SERVE_BATCH=0 restores the serial per-worker
+    #: dispatch byte-identically (no BatchScheduler is constructed).
+    batch_enabled: bool = field(
+        default_factory=lambda: os.environ.get(
+            "JTPU_SERVE_BATCH", "1").strip().lower()
+        not in ("0", "false", "no", "off"))
+    #: Max requests per gang (same Engine.bucket_key, one device call).
+    batch_max: int = field(
+        default_factory=lambda: _env_int("JTPU_SERVE_BATCH_MAX", 8))
+    #: Coalesce window: how long a gang leader waits for same-bucket
+    #: cohort members before dispatching what it has.
+    batch_wait_ms: float = field(
+        default_factory=lambda: _env_float(
+            "JTPU_SERVE_BATCH_WAIT_MS", 5.0))
+    #: Debug/CI mode: re-run every surviving gang member serially and
+    #: assert verdict equality (JTPU_SERVE_BATCH_VERIFY=1) — the
+    #: serial-equivalence proof, paid for with double execution.
+    batch_verify: bool = field(
+        default_factory=lambda: os.environ.get(
+            "JTPU_SERVE_BATCH_VERIFY", "").strip().lower()
+        in ("1", "true", "yes", "on"))
+    #: Optional shared-secret Bearer token for POST /check and
+    #: POST /drain (GET routes stay open). Empty = no auth.
+    auth_token: Optional[str] = field(
+        default_factory=lambda: os.environ.get(
+            "JTPU_SERVE_TOKEN") or None)
+    #: LRU cap on the Engine's warmed shape buckets (0 = unbounded);
+    #: evictions surface as jtpu_engine_evictions_total and /healthz.
+    engine_max_buckets: int = field(
+        default_factory=lambda: _env_int("JTPU_ENGINE_MAX_BUCKETS", 0))
 
 
 @dataclass
@@ -206,6 +272,7 @@ class CheckRequest:
     result: Optional[Dict[str, Any]] = None
     bucket: Optional[tuple] = None
     footprint: Optional[int] = None
+    dims: Optional[Any] = None         # plan.PlanDims, for gang pricing
     probe: bool = False                # half-open breaker probe
 
     def public(self) -> Dict[str, Any]:
@@ -370,6 +437,72 @@ class RequestJournal:
         return list(accepted.values()), stats
 
 
+class BatchScheduler:
+    """The gang former between the fair dequeue and the Engine — the
+    concurrent-batching tentpole (doc/serve.md "Concurrent batching").
+
+    A worker that dequeued a request (the gang LEADER) asks
+    :meth:`gather` to coalesce queued requests sharing the leader's
+    ``Engine.bucket_key`` (and model) into one gang: bounded by
+    ``--batch-max``, by the coalesce window ``--batch-wait-ms``, and by
+    the admission byte budget priced for the WHOLE gang
+    (:func:`jepsen_tpu.checker.plan.gang_footprint`) — a gang is one
+    vmapped device call, so its working set is the sum of its members'.
+    Cohort members are taken from tenant queue HEADS only, round-robin
+    across tenants: the fill is tenant-fair and per-tenant FIFO order
+    is preserved. One history per bucket behaves exactly like the
+    serial path (a gang of one dispatches through ``_run_one``)."""
+
+    def __init__(self, daemon: "CheckDaemon", batch_max: int,
+                 wait_s: float):
+        self.daemon = daemon
+        self.batch_max = max(1, int(batch_max))
+        self.wait_s = max(0.0, float(wait_s))
+
+    def max_fit(self, leader: CheckRequest) -> int:
+        """The largest gang size whose stacked footprint fits the byte
+        budget — priced BEFORE dispatch, not discovered by the
+        allocator failing mid-gang."""
+        n = self.batch_max
+        budget = self.daemon._budget()
+        if budget and leader.dims is not None:
+            from jepsen_tpu.checker import plan as plan_mod
+            while n > 1:
+                gfp = plan_mod.gang_footprint(leader.dims, n)
+                if gfp is None or gfp <= budget:
+                    break
+                n -= 1
+        return n
+
+    def gather(self, leader: CheckRequest) -> list:
+        """The leader's gang: ``[leader]`` alone when batching cannot
+        apply (no bucket — the CPU object-search path — or a draining/
+        stopping daemon), else leader + up to ``max_fit - 1`` cohort
+        members coalesced inside the wait window."""
+        d = self.daemon
+        gang = [leader]
+        if (leader.bucket is None or self.batch_max <= 1
+                or d.draining or d._stop.is_set()):
+            _BATCH_SIZE.observe(len(gang))
+            return gang
+        limit = self.max_fit(leader)
+        t0 = time.monotonic()
+        deadline = t0 + self.wait_s
+        while len(gang) < limit:
+            nxt = d._take_matching(leader)
+            if nxt is not None:
+                gang.append(nxt)
+                continue
+            now = time.monotonic()
+            if now >= deadline or d.draining or d._stop.is_set():
+                break
+            with d._work:
+                d._work.wait(timeout=min(deadline - now, 0.05))
+        _COALESCE_WAIT.observe(time.monotonic() - t0)
+        _BATCH_SIZE.observe(len(gang))
+        return gang
+
+
 class CheckDaemon:
     """The queue, the workers, the journal, and the admission logic —
     everything behind the HTTP handler. Start with :meth:`start`
@@ -403,10 +536,21 @@ class CheckDaemon:
         self._started = time.time()
         self._service_ewma: Optional[float] = None
         self.stats = {"admitted": 0, "rejected": 0, "completed": 0,
-                      "timeouts": 0, "replayed": 0}
+                      "timeouts": 0, "replayed": 0, "batches": 0,
+                      "max-batch": 0, "bisections": 0, "poisoned": 0}
         self.replay_stats: Dict[str, Any] = {}
         self.breaker = CircuitBreaker(self.config.breaker_fails,
                                       self.config.breaker_cooldown_s)
+        # JTPU_SERVE_BATCH=0 kill switch: no scheduler object at all —
+        # the worker loop is the serial PR-9 dispatch, byte-identical
+        self.batcher = (BatchScheduler(
+            self, self.config.batch_max,
+            self.config.batch_wait_ms / 1000.0)
+            if self.config.batch_enabled and self.config.batch_max > 1
+            else None)
+        if self.config.engine_max_buckets > 0:
+            self.engine.set_max_warm_buckets(
+                self.config.engine_max_buckets)
         self._progress_last = 0.0
 
     # -- model / planning helpers -------------------------------------------
@@ -417,10 +561,14 @@ class CheckDaemon:
         return _model_registry()
 
     def _plan_request(self, model_name: str, h: History
-                      ) -> Tuple[Optional[tuple], Optional[int]]:
-        """(shape bucket, predicted footprint bytes) for a request —
-        None/None when the model has no integer kernel (the CPU object
-        search serves it; no device budget is committed)."""
+                      ) -> Tuple[Optional[tuple], Optional[int],
+                                 Optional[Any]]:
+        """(shape bucket, predicted footprint bytes, plan dims) for a
+        request — None/None/None when the model has no integer kernel
+        (the CPU object search serves it; no device budget is
+        committed). The dims ride on the CheckRequest so the
+        BatchScheduler can price a whole gang (plan.gang_footprint)
+        without re-packing."""
         from jepsen_tpu.checker import plan as plan_mod
         from jepsen_tpu.models.core import kernel_spec_for
         from jepsen_tpu.ops.encode import pack_with_init
@@ -428,14 +576,14 @@ class CheckDaemon:
         try:
             pk = pack_with_init(h, model)
         except ValueError:
-            return None, None
+            return None, None, None
         if pk is None:
-            return None, None
+            return None, None, None
         packed, kernel = pk
         bucket = self.engine.bucket_key(packed, kernel)
         dims = plan_mod.PlanDims.from_packed(packed)
         fp = plan_mod.request_footprint(dims)
-        return bucket, fp
+        return bucket, fp, dims
 
     def _budget(self) -> Optional[int]:
         from jepsen_tpu.checker import plan as plan_mod
@@ -501,9 +649,9 @@ class CheckDaemon:
             return reject(400, "malformed",
                           lint=summarize(errs),
                           detail=errs[0].format())
-        bucket, footprint = None, None
+        bucket, footprint, dims = None, None, None
         try:
-            bucket, footprint = self._plan_request(model_name, h)
+            bucket, footprint, dims = self._plan_request(model_name, h)
         except Exception as e:  # noqa: BLE001 — planning is advisory
             log.warning("request planning failed (%s); admitting on "
                         "depth alone", e)
@@ -549,7 +697,7 @@ class CheckDaemon:
         req = CheckRequest(id=rid, tenant=tenant, model=model_name,
                            history=ops, deadline_s=deadline,
                            bucket=bucket, footprint=footprint,
-                           probe=probe)
+                           dims=dims, probe=probe)
         if not replayed:
             self.journal.append({
                 "event": "accepted", "id": req.id, "tenant": tenant,
@@ -601,6 +749,34 @@ class CheckDaemon:
                         _INFLIGHT.set(len(self._inflight))
                         return req
                 self._work.wait(timeout=0.5)
+
+    def _take_matching(self, leader: CheckRequest
+                       ) -> Optional[CheckRequest]:
+        """Pull ONE queued request joinable to the leader's gang: same
+        shape bucket AND model, taken only from tenant queue HEADS
+        (rotating the ring like _dequeue) — the gang fill is
+        tenant-fair and per-tenant FIFO order is preserved. None when
+        no head matches right now."""
+        with self._work:
+            if self._stop.is_set() or self.draining:
+                return None
+            for _ in range(len(self._rr)):
+                t = self._rr[0]
+                self._rr.rotate(-1)
+                q = self._queues.get(t)
+                if not q:
+                    continue
+                head = q[0]
+                if head.bucket == leader.bucket \
+                        and head.model == leader.model:
+                    q.popleft()
+                    self._depth -= 1
+                    head.state = "running"
+                    self._inflight[head.id] = head
+                    _QUEUE_DEPTH.set(self._depth)
+                    _INFLIGHT.set(len(self._inflight))
+                    return head
+        return None
 
     def _check(self, req: CheckRequest) -> Dict[str, Any]:
         """Run one request through EXACTLY the offline analyze path
@@ -659,8 +835,143 @@ class CheckDaemon:
                             req.probe)
         self._finish(req, result, secs)
 
+    def _run_gang(self, gang: list) -> None:
+        """Run a coalesced gang as vmapped device segments
+        (checker.tpu.check_packed_gang) under poison bisection
+        (resilience.bisect_poison) — the fault-isolated concurrent
+        batching path. Members the gang leaves UNKNOWN re-run the exact
+        serial path, so every verdict a tenant sees is one the
+        JTPU_SERVE_BATCH=0 daemon (and the offline analyze path) would
+        also produce; ``JTPU_SERVE_BATCH_VERIFY=1`` asserts that
+        equality by re-running survivors serially."""
+        from jepsen_tpu.checker import UNKNOWN
+        from jepsen_tpu.checker import tpu as tpu_mod
+        from jepsen_tpu.ops.encode import pack_with_init
+        from jepsen_tpu.resilience import (bisect_poison,
+                                           result_failure_class)
+        t0 = time.monotonic()
+        for req in gang:
+            _QUEUE_WAIT.observe(time.monotonic() - req.queued_at)
+        # gang membership journaled BEFORE dispatch: a SIGKILL mid-gang
+        # replays every member (none has a done record yet), and the
+        # record preserves the cohort for replay audits. Replay itself
+        # ignores it (no "id" field) — membership is evidence, not a
+        # second acceptance.
+        self.journal.append({
+            "event": "gang", "ids": [r.id for r in gang],
+            "tenants": [r.tenant for r in gang],
+            "bucket": list(gang[0].bucket or ()), "ts": time.time()})
+        self.stats["batches"] += 1
+        self.stats["max-batch"] = max(self.stats["max-batch"],
+                                      len(gang))
+        model = self._models()[gang[0].model]()
+        pks: list = []
+        kernel = None
+        try:
+            for req in gang:
+                pk = pack_with_init(History.of(req.history), model)
+                if pk is None:
+                    raise ValueError("model has no integer kernel")
+                pks.append(pk[0])
+                kernel = pk[1]
+        except Exception as e:  # noqa: BLE001 — fall back serially
+            log.warning("gang pack failed (%s); running %d member(s) "
+                        "serially", e, len(gang))
+            for req in gang:
+                self._run_one(req)
+            return
+        if self.config.warm and gang[0].bucket is not None:
+            try:
+                self.engine.warm(pks[0], kernel,
+                                 rungs=self.config.warm_rungs)
+            except Exception as e:  # noqa: BLE001 — warming is advisory
+                log.warning("bucket warm failed (%s); checking cold", e)
+        now = time.monotonic()
+        deadlines = [(now + req.deadline_s) if req.deadline_s else None
+                     for req in gang]
+
+        def run_gang(span):
+            # span is a list of gang indices: bisect_poison hands back
+            # subsets of the members we gave it
+            return tpu_mod.check_packed_gang(
+                [pks[i] for i in span], kernel,
+                deadlines=[deadlines[i] for i in span])
+
+        results, poison, bisections = bisect_poison(
+            list(range(len(gang))), run_gang)
+        poison_set = set(poison)
+        if bisections:
+            _BATCH_BISECTIONS.inc(bisections)
+            self.stats["bisections"] += bisections
+        # Serial-equivalence: whatever the gang could not decide (an
+        # exhausted ladder, a crashed-set overflow) re-runs the EXACT
+        # serial path — device escalation plus the wgl CPU fallback —
+        # identical to what JTPU_SERVE_BATCH=0 would have answered.
+        # Deadline cancels stay timeouts: serial would time out too.
+        serial_rerun = set()
+        for i, r in enumerate(results):
+            if i in poison_set:
+                continue
+            if not isinstance(r, dict) or (
+                    r.get("valid") is UNKNOWN
+                    and r.get("error") != ":info/timeout"):
+                results[i] = self._check(gang[i])
+                serial_rerun.add(i)
+        if self.config.batch_verify:
+            for i, req in enumerate(gang):
+                r = results[i]
+                if (i in poison_set or i in serial_rerun
+                        or not isinstance(r, dict)
+                        or r.get("error") == ":info/timeout"):
+                    continue
+                serial = self._check(req)
+                keys = ("valid", "levels", "max-linearized-prefix",
+                        "final-states", "frontier-op")
+                bad = [k for k in keys if r.get(k) != serial.get(k)]
+                if bad:
+                    log.error(
+                        "gang/serial verdict mismatch for %s on %s: "
+                        "gang=%r serial=%r — serving the serial result",
+                        req.id, bad, {k: r.get(k) for k in bad},
+                        {k: serial.get(k) for k in bad})
+                    serial = dict(serial)
+                    serial["batch-mismatch"] = bad
+                    results[i] = serial
+        secs = time.monotonic() - t0
+        # Breaker accounting order matters: survivors' successes FIRST
+        # (each resets the bucket's fail count), poison failures LAST —
+        # a gang with one poison member moves its bucket's breaker by
+        # exactly one failure, tagged to exactly one tenant.
+        order = ([i for i in range(len(gang)) if i not in poison_set]
+                 + list(poison))
+        gang_ids = [r.id for r in gang]
+        for i in order:
+            req = gang[i]
+            result = (dict(results[i]) if isinstance(results[i], dict)
+                      else {"valid": "unknown",
+                            "error": "gang produced no result"})
+            timed_out = result.get("error") == ":info/timeout"
+            if timed_out:
+                result.setdefault("deadline-s", req.deadline_s)
+                _TIMEOUTS.inc()
+                self.stats["timeouts"] += 1
+            result["serve"] = {
+                "id": req.id, "tenant": req.tenant,
+                "seconds": round(secs, 6), "timed-out": timed_out,
+                "gang": {"size": len(gang), "index": i,
+                         "bisections": bisections,
+                         "poison": i in poison_set}}
+            if i in poison_set:
+                _BATCH_POISON.inc(tenant=req.tenant)
+                self.stats["poisoned"] += 1
+            self.breaker.record(req.bucket,
+                                result_failure_class(result), req.probe)
+            self._finish(req, result, secs, batch_size=len(gang),
+                         gang=gang_ids)
+
     def _finish(self, req: CheckRequest, result: Dict[str, Any],
-                secs: float) -> None:
+                secs: float, batch_size: int = 1,
+                gang: Optional[list] = None) -> None:
         # result file first (tmp+replace), then the done journal record:
         # a crash between them re-runs the request, never loses it
         path = os.path.join(self.config.root, f"{req.id}.json")
@@ -671,9 +982,12 @@ class CheckDaemon:
             os.replace(tmp, path)
         except OSError as e:
             log.warning("couldn't persist result for %s: %s", req.id, e)
-        self.journal.append({"event": "done", "id": req.id,
-                             "valid": repr(result.get("valid")),
-                             "seconds": round(secs, 6)})
+        done = {"event": "done", "id": req.id,
+                "valid": repr(result.get("valid")),
+                "seconds": round(secs, 6)}
+        if gang is not None:
+            done["gang"] = list(gang)
+        self.journal.append(done)
         with self._work:
             req.result = result
             req.state = "done"
@@ -681,8 +995,13 @@ class CheckDaemon:
             if req.footprint:
                 self._footprint_committed = max(
                     0, self._footprint_committed - req.footprint)
-            self._service_ewma = (secs if self._service_ewma is None
-                                  else 0.3 * secs
+            # Retry-After estimation: the EWMA tracks per-REQUEST
+            # service time, so a gang's wall-clock is amortized over
+            # its realized batch size — one 8-wide batch taking 2 s is
+            # 0.25 s/request, not 2 s/request
+            per = secs / max(1, batch_size)
+            self._service_ewma = (per if self._service_ewma is None
+                                  else 0.3 * per
                                   + 0.7 * self._service_ewma)
             self._work.notify_all()
         _INFLIGHT.set(len(self._inflight))
@@ -695,13 +1014,21 @@ class CheckDaemon:
             req = self._dequeue()
             if req is None:
                 return
+            gang = (self.batcher.gather(req)
+                    if self.batcher is not None else [req])
             try:
-                self._run_one(req)
+                if len(gang) == 1:
+                    self._run_one(req)
+                else:
+                    self._run_gang(gang)
             except Exception:  # noqa: BLE001 — a worker must never die
-                log.exception("worker crashed on %s", req.id)
-                self._finish(req, {"valid": "unknown",
-                                   "error": "serve worker crashed"},
-                             0.0)
+                log.exception("worker crashed on %s",
+                              [r.id for r in gang])
+                for r in gang:
+                    if r.state != "done":
+                        self._finish(r, {"valid": "unknown",
+                                         "error": "serve worker crashed"},
+                                     0.0)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -792,6 +1119,8 @@ class CheckDaemon:
                 "warm-buckets": [
                     "/".join(str(x) for x in b)
                     for b in self.engine.warm_buckets()],
+                "max-warm-buckets": self.engine.max_warm_buckets or 0,
+                "evictions": self.engine.evictions,
                 "persistent-cache": self.config.compile_cache,
             },
         }
@@ -818,6 +1147,10 @@ class CheckDaemon:
                     "rejected": self.stats["rejected"],
                     "completed": self.stats["completed"],
                     "timeouts": self.stats["timeouts"],
+                    "batches": self.stats["batches"],
+                    "max-batch": self.stats["max-batch"],
+                    "bisections": self.stats["bisections"],
+                    "poisoned": self.stats["poisoned"],
                     "breakers-open": self.breaker.open_count(),
                     "warm-buckets": len(self.engine.warm_buckets()),
                 },
@@ -854,10 +1187,23 @@ def make_handler(daemon: CheckDaemon, root: str = "store"):
         self._send(code, json.dumps(doc, default=repr).encode(),
                    ctype="application/json", headers=headers or {})
 
+    def _authorized(self) -> bool:
+        # Mutating routes only — /metrics, /healthz and the results
+        # browser stay open for scrapers and dashboards. Constant-time
+        # compare so the token can't be guessed byte-by-byte.
+        token = self.daemon.config.auth_token
+        if not token:
+            return True
+        got = self.headers.get("Authorization") or ""
+        return hmac.compare_digest(got, f"Bearer {token}")
+
     def do_POST(self):  # noqa: N802 (stdlib naming)
         from urllib.parse import urlparse
         path = urlparse(self.path).path
         try:
+            if path in ("/check", "/drain") and not _authorized(self):
+                return _json(self, 401, {"error": "unauthorized"},
+                             {"WWW-Authenticate": "Bearer"})
             if path == "/check":
                 try:
                     n = int(self.headers.get("Content-Length") or 0)
@@ -886,10 +1232,18 @@ def make_handler(daemon: CheckDaemon, root: str = "store"):
             if doc is None:
                 return _json(self, 404, {"error": "no such request",
                                          "id": rid})
-            return _json(self, 200, doc)
+            # a poisoned gang member failed — surface it as a server
+            # error so callers retrying on 5xx treat it like any other
+            # failed check, while its cohort keeps answering 200
+            result = doc.get("result") or {}
+            serve = (result.get("serve") or {}
+                     if isinstance(result, dict) else {})
+            code = 500 if (serve.get("gang") or {}).get("poison") else 200
+            return _json(self, code, doc)
         return web.Handler.do_GET(self)
 
     ServeHandler.do_POST = do_POST
+    ServeHandler._authorized = _authorized
     ServeHandler.do_GET = do_GET
     return ServeHandler
 

@@ -639,3 +639,509 @@ class TestKillSwitch:
         r2 = T.check_packed_tpu(p, kernel)
         for key in ("valid", "levels", "rung", "work"):
             assert r1.get(key) == r2.get(key)
+
+
+# ---------------------------------------------------------------------------
+# Gang-scheduled concurrent batching (doc/serve.md, "Concurrent
+# batching"): coalescing, serial equivalence, poison bisection, the
+# JTPU_SERVE_BATCH kill switch
+# ---------------------------------------------------------------------------
+
+#: keys on which a gang verdict must be indistinguishable from serial.
+_VERDICT_KEYS = ("valid", "levels", "max-linearized-prefix",
+                 "final-states", "frontier-op")
+
+
+def _conc_ops(n, seed, value_base=0):
+    """A CONCURRENT register history (4 procs, interleaved invokes) —
+    deep enough that a segment_iters=1 gang needs several barriers,
+    which the deadline-cancel test relies on."""
+    import random as _random
+    rng = _random.Random(seed)
+    ops, t, pend, val = [], 0, {}, value_base
+    for _ in range(n):
+        p = rng.choice((0, 1, 2, 3))
+        if p in pend:
+            inv = pend.pop(p)
+            ops.append({"process": p, "type": "ok", "f": inv["f"],
+                        "value": inv["value"], "time": t})
+        else:
+            f = rng.choice(("write", "read"))
+            v = val if f == "write" else None
+            if f == "write":
+                val += 1
+            inv = {"process": p, "type": "invoke", "f": f, "value": v,
+                   "time": t}
+            ops.append(inv)
+            pend[p] = inv
+        t += 1
+    for p, inv in pend.items():
+        ops.append({"process": p, "type": "ok", "f": inv["f"],
+                    "value": inv["value"], "time": t})
+        t += 1
+    return ops
+
+
+def _offline(ops):
+    from jepsen_tpu.checker import check_safe
+    from jepsen_tpu.checker.wgl import linearizable
+    return check_safe(linearizable(CASRegister(), backend="tpu"),
+                      {"name": "gang-offline"}, History.of(ops))
+
+
+@pytest.fixture
+def gang_fault():
+    """Install/clear the checker.tpu gang fault seam."""
+    def install(fn):
+        T._GANG_FAULT = fn
+    yield install
+    T._GANG_FAULT = None
+
+
+class TestCheckPackedGang:
+    def test_gang_verdicts_match_serial(self):
+        """The tentpole equivalence leg: one vmapped gang call renders
+        per-member verdicts identical to serial check_packed_tpu."""
+        histories = [_ops(3), _ops(5, value=9), _conc_ops(24, 3),
+                     _ops(6, value=40)]
+        pks, kernel = [], None
+        for ops in histories:
+            p, kernel = _packed(ops)
+            pks.append(p)
+        gang = T.check_packed_gang(pks, kernel)
+        assert len(gang) == len(pks)
+        for g, p in zip(gang, pks):
+            serial = T.check_packed_tpu(p, kernel)
+            for key in _VERDICT_KEYS:
+                assert g.get(key) == serial.get(key), (key, g, serial)
+            assert g["gang-size"] == len(pks)
+
+    def test_empty_and_trivial_members(self):
+        p, kernel = _packed(_ops(3))
+        assert T.check_packed_gang([], kernel) == []
+
+    def test_deadline_cancels_lane_not_cohort(self):
+        """A member whose deadline passes is cancelled at the next
+        segment barrier (:info/timeout, gang-cancelled) while its
+        cohort finishes with serial-identical verdicts."""
+        victim = _conc_ops(24, 5)
+        cohort = _conc_ops(24, 6, value_base=100)
+        pks, kernel = [], None
+        for ops in (victim, cohort):
+            p, kernel = _packed(ops)
+            pks.append(p)
+        out = T.check_packed_gang(
+            pks, kernel, deadlines=[time.monotonic() - 1.0, None],
+            segment_iters=1)
+        from jepsen_tpu.checker import UNKNOWN
+        assert out[0]["valid"] is UNKNOWN
+        assert out[0]["error"] == ":info/timeout"
+        assert out[0]["gang-cancelled"] is True
+        serial = T.check_packed_tpu(pks[1], kernel)
+        for key in _VERDICT_KEYS:
+            assert out[1].get(key) == serial.get(key)
+
+    def test_gang_fault_seam_raises_through(self, gang_fault):
+        p, kernel = _packed(_ops(3))
+
+        def boom(pks):
+            raise RuntimeError("RESOURCE_EXHAUSTED: injected")
+
+        gang_fault(boom)
+        with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+            T.check_packed_gang([p], kernel)
+
+
+class TestBisectPoison:
+    def test_isolates_single_poison(self):
+        from jepsen_tpu.resilience import bisect_poison
+        calls = []
+
+        def run_gang(span):
+            calls.append(list(span))
+            if 3 in span:
+                raise RuntimeError("RESOURCE_EXHAUSTED: poison")
+            return [{"valid": True, "member": m} for m in span]
+
+        results, poison, bisections = bisect_poison(
+            list(range(6)), run_gang)
+        assert poison == [3]
+        assert bisections >= 1
+        assert results[3]["error-class"] == "oom"
+        for i in (0, 1, 2, 4, 5):
+            assert results[i] == {"valid": True, "member": i}
+        # the poison was isolated by splitting, not by 6 serial runs
+        assert calls[0] == [0, 1, 2, 3, 4, 5]
+
+    def test_all_clean_no_bisection(self):
+        from jepsen_tpu.resilience import bisect_poison
+        results, poison, bisections = bisect_poison(
+            [10, 11], lambda span: [{"valid": True}] * len(span))
+        assert poison == [] and bisections == 0
+        assert all(r == {"valid": True} for r in results)
+
+    def test_result_failure_class_drives_split(self):
+        """A run_gang returning a single failure DICT (not raising)
+        bisects too — the resilience result taxonomy is the trigger."""
+        from jepsen_tpu.resilience import bisect_poison
+
+        def run_gang(span):
+            if 1 in span:
+                return {"valid": "unknown", "error": "wedged",
+                        "error-class": "wedge"}
+            return [{"valid": True}] * len(span)
+
+        results, poison, _ = bisect_poison([0, 1], run_gang)
+        assert poison == [1]
+        assert results[0] == {"valid": True}
+        assert results[1]["error-class"] == "wedge"
+
+
+class TestGangServe:
+    def test_burst_coalesces_and_matches_offline(self, tmp_path):
+        """4 same-bucket requests journaled by a killed incarnation
+        re-queue together on restart — the worker's first dequeue leads
+        a deterministic gang of 4, and every verdict matches the
+        offline analyze path."""
+        histories = [_ops(3), _ops(4, value=9), _ops(5, value=20),
+                     _conc_ops(24, 7)]
+        d1 = _daemon(tmp_path)
+        for i, ops in enumerate(histories):
+            code, _, _ = d1.submit({"tenant": f"t{i % 2}",
+                                    "model": "cas-register",
+                                    "history": ops})
+            assert code == 202
+        d1.journal.close()          # SIGKILL before any worker ran
+
+        d2 = _daemon(tmp_path, start=True, workers=1,
+                     batch_wait_ms=200.0)
+        assert d2.batcher is not None
+        assert d2.replay_stats["requeued"] == 4
+        with d2._lock:
+            rids = list(d2._by_id)
+        docs = {rid: _wait_done(d2, rid) for rid in rids}
+        assert d2.stats["batches"] >= 1
+        assert d2.stats["max-batch"] >= 2
+        d2.stop()
+        by_order = sorted(docs.values(), key=lambda x: x["id"])
+        pending, _ = serve_ns.RequestJournal.replay(d2.journal.path)
+        assert pending == []        # every gang member reached done
+        for doc in by_order:
+            gang = doc["result"]["serve"]["gang"]
+            assert gang["size"] >= 2 and gang["poison"] is False
+        # order-insensitive equality against offline (ids regenerate)
+        served = sorted(repr(d["result"]["valid"]) for d in by_order)
+        offline = sorted(repr(_offline(o)["valid"]) for o in histories)
+        assert served == offline
+
+    def test_gang_wal_records_membership(self, tmp_path):
+        d1 = _daemon(tmp_path)
+        for v in (1, 5):
+            d1.submit({"model": "cas-register",
+                       "history": _ops(3, value=v)})
+        d1.journal.close()
+        d2 = _daemon(tmp_path, start=True, workers=1,
+                     batch_wait_ms=200.0)
+        with d2._lock:
+            rids = list(d2._by_id)
+        for rid in rids:
+            _wait_done(d2, rid)
+        d2.stop()
+        gang_events, done_gangs = [], []
+        from jepsen_tpu import journal as journal_ns
+        records, _ = journal_ns.read_json_records(d2.journal.path)
+        for rec in records:
+            if rec.get("event") == "gang":
+                gang_events.append(rec)
+            if rec.get("event") == "done" and rec.get("gang"):
+                done_gangs.append(rec)
+        assert gang_events and sorted(gang_events[0]["ids"]) == \
+            sorted(rids)
+        assert done_gangs and all(
+            sorted(rec["gang"]) == sorted(rids) for rec in done_gangs)
+
+    def test_poison_member_isolated_breaker_counts_one(
+            self, tmp_path, gang_fault):
+        """The fault-isolation acceptance: one poison member OOMs any
+        gang containing it; bisection fails ONLY it, survivors' verdicts
+        match offline, and the bucket's breaker counts exactly 1."""
+        survivors = [_ops(3), _ops(4, value=9), _ops(5, value=20)]
+        poison = _ops(7, value=50)   # same bucket, unique row count
+        poison_n = _packed(poison)[0].n
+        assert all(_packed(o)[0].n != poison_n for o in survivors)
+
+        def fault(pks):
+            if any(p.n == poison_n for p in pks):
+                raise RuntimeError("RESOURCE_EXHAUSTED: injected gang "
+                                   "OOM")
+
+        gang_fault(fault)
+        d1 = _daemon(tmp_path)
+        rid_p = d1.submit({"tenant": "a", "model": "cas-register",
+                           "history": poison})[1]["id"]
+        rid_s = [d1.submit({"tenant": "ab"[i % 2],
+                            "model": "cas-register", "history": o}
+                           )[1]["id"] for i, o in enumerate(survivors)]
+        d1.journal.close()
+
+        d2 = _daemon(tmp_path, start=True, workers=1,
+                     batch_wait_ms=200.0, breaker_fails=5)
+        with d2._lock:
+            # replay regenerates nothing: ids persist through the WAL
+            assert set(d2._by_id) == {rid_p, *rid_s}
+        doc_p = _wait_done(d2, rid_p)
+        docs_s = [_wait_done(d2, r) for r in rid_s]
+
+        res = doc_p["result"]
+        assert res["serve"]["gang"]["poison"] is True
+        assert res["error-class"] == "oom"
+        assert res["serve"]["gang"]["size"] == 4
+        assert d2.stats["poisoned"] == 1
+        assert d2.stats["bisections"] >= 1
+        for doc, ops in zip(docs_s, survivors):
+            r = doc["result"]
+            assert r["serve"]["gang"]["poison"] is False
+            offline = _offline(ops)
+            for key in _VERDICT_KEYS:
+                assert r.get(key) == offline.get(key), (key, r)
+        snap = d2.breaker.snapshot()
+        fails = [r["fails"] for r in snap.values()]
+        assert fails == [1], snap    # exactly the poison, nothing else
+        d2.stop()
+
+    def test_kill_switch_restores_serial_path(self, tmp_path,
+                                              monkeypatch):
+        monkeypatch.setenv("JTPU_SERVE_BATCH", "0")
+        cfg = serve_ns.ServeConfig(root=str(tmp_path / "serve"),
+                                   backend="tpu")
+        assert cfg.batch_enabled is False
+        d = serve_ns.CheckDaemon(cfg)
+        assert d.batcher is None     # no scheduler object at all
+        d.start()
+        code, body, _ = d.submit({"model": "cas-register",
+                                  "history": _ops(3)})
+        assert code == 202
+        doc = _wait_done(d, body["id"])
+        assert doc["result"]["valid"] is True
+        assert "gang" not in doc["result"]["serve"]
+        d.stop()
+
+    def test_batch_max_one_disables_scheduler(self, tmp_path):
+        d = _daemon(tmp_path, batch_max=1)
+        assert d.batcher is None
+        d.stop()
+
+    def test_retry_after_ewma_divides_by_batch_size(self, tmp_path):
+        """The Retry-After satellite: a gang's wall-clock is amortized
+        over its realized batch size, so an 8-wide 8 s batch reads as
+        1 s/request — not 8."""
+        d = _daemon(tmp_path, queue_max=16)
+        reqs = []
+        for v in (1, 5, 9):
+            code, body, _ = d.submit({"model": "cas-register",
+                                      "history": _ops(3, value=v)})
+            assert code == 202
+        for _ in range(3):
+            reqs.append(d._dequeue())
+        d._finish(reqs[0], {"valid": True}, 8.0, batch_size=8)
+        assert d._service_ewma == pytest.approx(1.0)
+        d._finish(reqs[1], {"valid": True}, 4.0, batch_size=4)
+        assert d._service_ewma == pytest.approx(1.0)   # same per-request
+        d._finish(reqs[2], {"valid": True}, 2.0)       # serial: 2 s/req
+        assert d._service_ewma == pytest.approx(0.3 * 2.0 + 0.7 * 1.0)
+        d.stop()
+
+
+class TestWalGangReplay:
+    def test_torn_tail_mid_gang_replays_all_members(self, tmp_path):
+        """A SIGKILL that tears the WAL mid-gang-record: every accepted
+        member still replays (none had a done record), the torn gang
+        line is skipped, and verdicts match offline."""
+        histories = [_ops(3), _ops(4, value=9)]
+        d1 = _daemon(tmp_path)
+        for ops in histories:
+            assert d1.submit({"model": "cas-register",
+                              "history": ops})[0] == 202
+        d1.journal.close()
+        with open(d1.journal.path, "ab") as f:
+            f.write(b'deadbeef {"event": "gang", "ids": [tor')  # torn
+        pending, stats = serve_ns.RequestJournal.replay(d1.journal.path)
+        assert len(pending) == 2 and stats["torn"] == 1
+        d2 = _daemon(tmp_path, start=True, workers=1,
+                     batch_wait_ms=150.0)
+        assert d2.replay_stats["requeued"] == 2
+        with d2._lock:
+            rids = list(d2._by_id)
+        docs = [_wait_done(d2, rid) for rid in rids]
+        d2.stop()
+        served = sorted(repr(doc["result"]["valid"]) for doc in docs)
+        offline = sorted(repr(_offline(o)["valid"]) for o in histories)
+        assert served == offline
+
+    def test_complete_gang_records_are_replay_inert(self, tmp_path):
+        """A COMPLETE gang record (all members done) must not re-queue
+        anything: gang membership is evidence, not acceptance."""
+        d1 = _daemon(tmp_path, start=True, workers=1,
+                     batch_wait_ms=100.0)
+        ids = []
+        for v in (1, 5):
+            code, body, _ = d1.submit({"model": "cas-register",
+                                       "history": _ops(3, value=v)})
+            ids.append(body["id"])
+        for rid in ids:
+            _wait_done(d1, rid)
+        d1.stop()
+        pending, stats = serve_ns.RequestJournal.replay(d1.journal.path)
+        assert pending == []
+        assert stats["records"] >= 4   # accepted x2 (+gang) + done x2
+
+    def test_interleaved_tenants_replay_in_acceptance_order(
+            self, tmp_path):
+        """Replay preserves WAL acceptance order across interleaved
+        tenants; the re-formed gang then serves both tenants in one
+        dispatch."""
+        d1 = _daemon(tmp_path, queue_max=16)
+        expect = []
+        for i in range(4):
+            code, body, _ = d1.submit(
+                {"tenant": "ab"[i % 2], "model": "cas-register",
+                 "history": _ops(3 + i, value=10 * i)})
+            assert code == 202
+            expect.append((body["id"], "ab"[i % 2]))
+        d1.journal.close()
+        pending, _ = serve_ns.RequestJournal.replay(d1.journal.path)
+        assert [(p["id"], p["tenant"]) for p in pending] == expect
+        d2 = _daemon(tmp_path, start=True, workers=1,
+                     batch_wait_ms=200.0)
+        assert d2.replay_stats["requeued"] == 4
+        docs = [_wait_done(d2, rid) for rid, _ in expect]
+        sizes = {doc["result"]["serve"]["gang"]["size"]
+                 for doc in docs}
+        tenants = {doc["tenant"] for doc in docs}
+        assert sizes == {4} and tenants == {"a", "b"}
+        d2.stop()
+
+
+# ---------------------------------------------------------------------------
+# Warm-state eviction (the --engine-max-buckets satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestWarmEviction:
+    def test_lru_evicts_oldest_warm_bucket(self):
+        eng = Engine("evict-warm", max_warm_buckets=1)
+        p1, kernel = _packed(_ops(2))
+        p2, _ = _packed(_ops(40))          # a different shape bucket
+        b1 = Engine.bucket_key(p1, kernel)
+        b2 = Engine.bucket_key(p2, kernel)
+        assert b1 != b2
+        eng.warm(p1, kernel, rungs=1)
+        eng.warm(p2, kernel, rungs=1)
+        assert eng.warm_buckets() == [b2]  # LRU: oldest claim dropped
+        assert eng.evictions == 1
+
+    def test_touch_refreshes_lru_order(self):
+        eng = Engine("evict-touch", max_warm_buckets=2)
+        p1, kernel = _packed(_ops(2))
+        p2, _ = _packed(_ops(40))
+        p3, _ = _packed(_ops(10))
+        keys = {Engine.bucket_key(p, kernel) for p in (p1, p2, p3)}
+        assert len(keys) == 3, "need three distinct buckets"
+        eng.warm(p1, kernel, rungs=1)
+        eng.warm(p2, kernel, rungs=1)
+        eng.warm(p1, kernel, rungs=1)      # touch: p1 is now newest
+        eng.warm(p3, kernel, rungs=1)      # evicts p2, not p1
+        assert Engine.bucket_key(p1, kernel) in eng.warm_buckets()
+        assert Engine.bucket_key(p2, kernel) not in eng.warm_buckets()
+
+    def test_env_and_setter_bound_the_claim(self, monkeypatch):
+        monkeypatch.setenv("JTPU_ENGINE_MAX_BUCKETS", "3")
+        assert Engine("env-bound").max_warm_buckets == 3
+        monkeypatch.delenv("JTPU_ENGINE_MAX_BUCKETS")
+        eng = Engine("set-bound")
+        assert eng.max_warm_buckets == 0   # unbounded by default
+        p1, kernel = _packed(_ops(2))
+        p2, _ = _packed(_ops(40))
+        eng.warm(p1, kernel, rungs=1)
+        eng.warm(p2, kernel, rungs=1)
+        eng.set_max_warm_buckets(1)        # trims immediately
+        assert len(eng.warm_buckets()) == 1 and eng.evictions == 1
+
+    def test_daemon_healthz_reports_eviction_state(self, tmp_path):
+        d = _daemon(tmp_path, engine_max_buckets=2)
+        assert d.engine.max_warm_buckets == 2
+        health = d.healthz()
+        assert health["engine"]["max-warm-buckets"] == 2
+        assert health["engine"]["evictions"] == 0
+        d.stop()
+
+
+# ---------------------------------------------------------------------------
+# Shared-secret auth (the --auth-token satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestAuth:
+    def _server(self, tmp_path, token):
+        cfg = serve_ns.ServeConfig(root=str(tmp_path / "serve"),
+                                   backend="tpu", auth_token=token)
+        return serve_ns.run_daemon(cfg, host="127.0.0.1", port=0,
+                                   store_root=str(tmp_path / "store"))
+
+    def test_post_routes_require_bearer_token(self, tmp_path):
+        daemon, server = self._server(tmp_path, "s3cret")
+        port = server.server_port
+        doc = {"model": "cas-register", "history": _ops()}
+        try:
+            code, body, hdrs = _post(port, "/check", doc)
+            assert code == 401 and body["error"] == "unauthorized"
+            assert hdrs.get("WWW-Authenticate") == "Bearer"
+
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/check",
+                data=json.dumps(doc).encode(), method="POST",
+                headers={"Authorization": "Bearer wrong"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req)
+            assert ei.value.code == 401
+
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/check",
+                data=json.dumps(doc).encode(), method="POST",
+                headers={"Authorization": "Bearer s3cret"})
+            with urllib.request.urlopen(req) as r:
+                assert r.status == 202
+
+            # metrics / healthz / the results browser stay open
+            assert _get(port, "/healthz")[0] == 200
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics") as r:
+                assert r.status == 200
+
+            code, _, _ = _post(port, "/drain", None)
+            assert code == 401     # drain is a mutating route too
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/drain", data=b"",
+                method="POST",
+                headers={"Authorization": "Bearer s3cret"})
+            with urllib.request.urlopen(req) as r:
+                assert json.load(r)["drained"] is True
+        finally:
+            server.shutdown()
+            daemon.stop()
+
+    def test_no_token_configured_keeps_routes_open(self, tmp_path):
+        daemon, server = self._server(tmp_path, None)
+        try:
+            code, _, _ = _post(server.server_port, "/check",
+                               {"model": "cas-register",
+                                "history": _ops()})
+            assert code == 202
+        finally:
+            server.shutdown()
+            daemon.stop()
+
+    def test_env_token_configures_daemon(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("JTPU_SERVE_TOKEN", "from-env")
+        cfg = serve_ns.ServeConfig(root=str(tmp_path / "serve"))
+        assert cfg.auth_token == "from-env"

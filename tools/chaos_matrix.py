@@ -54,6 +54,12 @@ qualify a new accelerator image before trusting it with long runs):
                    daemon replays its request journal (serve.wal),
                    re-checks both, and both verdicts are identical to
                    the offline analyze path
+  serve-batch-poison  a 4-request same-bucket burst with ONE poison
+                   member OOMing every gang that contains it: the gang
+                   scheduler bisects to isolate it — 3 survivors
+                   answer 200 with offline-identical verdicts, the
+                   poison answers 500 (oom), and its bucket's breaker
+                   counts exactly one failure
 
 Usage: python tools/chaos_matrix.py [--seed N] [--only NAME ...]
 Exit code 0 iff every selected scenario passes — nonzero on any
@@ -980,6 +986,157 @@ def scenario_serve_kill(seed):
     return ok, "; ".join(details)
 
 
+def scenario_serve_batch_poison(seed):
+    """A 4-request same-bucket burst against a REAL daemon (HTTP, warm
+    engine, gang scheduler on) with ONE poison member: the injected
+    gang fault (`checker.tpu._GANG_FAULT`) OOMs every device call whose
+    gang contains the poison request. Bisection must isolate it — the
+    3 survivors answer 200 with verdicts identical to the offline
+    analyze path, the poison answers 500 with an oom-class error, and
+    its bucket's breaker counts EXACTLY one failure (doc/serve.md,
+    "Concurrent batching")."""
+    import tempfile
+    import urllib.error
+    import urllib.request
+
+    from jepsen_tpu import serve as serve_ns
+    from jepsen_tpu import web
+    from jepsen_tpu.checker import check_safe
+    from jepsen_tpu.checker import tpu as tpu_ns
+    from jepsen_tpu.checker.wgl import linearizable
+    from jepsen_tpu.history import History
+    from jepsen_tpu.ops.encode import pack_with_init
+    from jepsen_tpu.testing import simulate_register_history
+
+    root = tempfile.mkdtemp(prefix="jepsen-chaos-servepoison-")
+    # 3 survivors at one op count, the poison at another — close enough
+    # to share a shape bucket (so they coalesce into one gang), distinct
+    # enough that the fault hook can recognize the poison by its packed
+    # row count without touching daemon internals
+    surv_ops = [[o.to_dict() for o in
+                 simulate_register_history(40, n_procs=3, n_vals=3,
+                                           seed=seed + i)]
+                for i in range(3)]
+    surv_ns = {pack_with_init(History.of(o), CASRegister())[0].n
+               for o in surv_ops}
+    poison_ops = poison_n = None
+    for s in range(seed + 9, seed + 29):
+        ops = [o.to_dict() for o in
+               simulate_register_history(48, n_procs=3, n_vals=3,
+                                         seed=s)]
+        n = pack_with_init(History.of(ops), CASRegister())[0].n
+        if n not in surv_ns:
+            poison_ops, poison_n = ops, n
+            break
+    if poison_ops is None:
+        return False, "poison history not distinguishable by row count"
+
+    offline = [check_safe(linearizable(CASRegister(), backend="tpu"),
+                          {"name": "chaos-poison-offline"},
+                          History.of(o)) for o in surv_ops]
+
+    cfg = serve_ns.ServeConfig(root=os.path.join(root, "serve"),
+                               backend="tpu", workers=1,
+                               batch_max=8, batch_wait_ms=1000.0)
+    daemon = serve_ns.CheckDaemon(cfg)
+    if daemon.batcher is None:
+        return False, "gang scheduler unexpectedly disabled"
+    daemon.start()
+    server = web.serve(host="127.0.0.1", port=0, root=root,
+                       handler_cls=serve_ns.make_handler(daemon,
+                                                         root=root))
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    port = server.server_port
+
+    def post(doc):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/check",
+            data=json.dumps(doc).encode(), method="POST")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.load(r)
+
+    def get(rid):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/check/{rid}",
+                    timeout=10) as r:
+                return r.status, json.load(r)
+        except urllib.error.HTTPError as e:
+            return e.code, json.load(e)
+
+    def gang_fault(pks):
+        if any(p.n == poison_n for p in pks):
+            raise RuntimeError(
+                "RESOURCE_EXHAUSTED: injected gang OOM (chaos)")
+
+    tpu_ns._GANG_FAULT = gang_fault
+    details = []
+    try:
+        # burst: the poison first so it leads the gang, survivors land
+        # inside the 1 s coalesce window behind it
+        rid_p = post({"tenant": "a", "model": "cas-register",
+                      "history": poison_ops})["id"]
+        rid_s = [post({"tenant": "ab"[i % 2], "model": "cas-register",
+                       "history": o})["id"]
+                 for i, o in enumerate(surv_ops)]
+        deadline = time.time() + 120
+        docs = {}
+        while time.time() < deadline and len(docs) < 4:
+            for rid in [rid_p] + rid_s:
+                if rid in docs:
+                    continue
+                code, doc = get(rid)
+                if doc.get("state") == "done":
+                    docs[rid] = (code, doc)
+            time.sleep(0.05)
+        if len(docs) != 4:
+            return False, f"only {len(docs)}/4 requests finished"
+
+        code, doc = docs[rid_p]
+        res = doc["result"]
+        gang = (res.get("serve") or {}).get("gang") or {}
+        if gang.get("size", 0) < 2:
+            return False, (f"no gang formed (size "
+                           f"{gang.get('size')}) — burst ran serially")
+        if not gang.get("poison"):
+            return False, f"poison member not isolated: {res}"
+        if code != 500:
+            return False, f"poison answered {code}, want 500"
+        if res.get("error-class") != "oom":
+            return False, (f"poison error-class "
+                           f"{res.get('error-class')!r}, want 'oom'")
+        details.append(f"gang of {gang['size']} bisected "
+                       f"{gang.get('bisections')}x; poison 500/oom")
+
+        for i, rid in enumerate(rid_s):
+            code, doc = docs[rid]
+            res = doc["result"]
+            g = (res.get("serve") or {}).get("gang") or {}
+            if g.get("poison"):
+                return False, f"survivor {i} marked poison: {res}"
+            if code != 200:
+                return False, f"survivor {i} answered {code}, want 200"
+            if res.get("valid") != offline[i].get("valid"):
+                return False, (f"survivor {i}: served "
+                               f"{res.get('valid')!r} != offline "
+                               f"{offline[i].get('valid')!r}")
+        details.append("3 survivors: 200, verdicts == offline")
+
+        snap = daemon.breaker.snapshot()
+        fails = [r["fails"] for r in snap.values() if r["fails"]]
+        if fails != [1]:
+            return False, (f"breaker counted {fails or [0]} failures, "
+                           f"want exactly [1] (snapshot {snap})")
+        details.append("breaker counted exactly 1 failure")
+        if daemon.stats["bisections"] < 1:
+            return False, "no bisection recorded"
+        return True, "; ".join(details)
+    finally:
+        tpu_ns._GANG_FAULT = None
+        server.shutdown()
+        daemon.stop()
+
+
 SCENARIOS = (
     ("oom", scenario_oom),
     ("wedge", scenario_wedge),
@@ -994,6 +1151,7 @@ SCENARIOS = (
     ("plan-rejects", scenario_plan_rejects),
     ("fleet-host-kill", scenario_fleet_host_kill),
     ("serve-kill", scenario_serve_kill),
+    ("serve-batch-poison", scenario_serve_batch_poison),
 )
 
 

@@ -15,6 +15,9 @@ served request. This gate IS that request:
 * the daemon admits it (202 + id), checks it on the warm device path,
   and the polled verdict must be ``valid: true`` AND identical to the
   offline ``analyze``-path verdict computed in-process;
+* a same-bucket burst must coalesce: the gang scheduler has to
+  dispatch at least one batch of size >= 2 (healthz ``stats.batches``
+  / ``stats.max-batch``), proving concurrent batching survives CI;
 * ``/healthz`` must report the completed request and a warm bucket;
 * ``POST /drain`` must finish in-flight work and release the daemon
   (exit-0 contract).
@@ -80,9 +83,10 @@ def main() -> int:
               file=sys.stderr)
         return 1
 
-    # 2. the daemon, on a real port
+    # 2. the daemon, on a real port — coalesce window widened so the
+    # same-bucket burst below reliably forms a gang inside CI jitter
     cfg = serve_ns.ServeConfig(root=os.path.join(root, "serve"),
-                               backend="tpu")
+                               backend="tpu", batch_wait_ms=250.0)
     daemon, server = serve_ns.run_daemon(
         cfg, host="127.0.0.1", port=0, store_root=root)
     port = server.server_port
@@ -123,10 +127,39 @@ def main() -> int:
                     problems.append(
                         f"served verdict {verdict!r} != offline "
                         f"{offline.get('valid')!r}")
+        # 3. the gang scheduler: a same-bucket burst must coalesce into
+        # at least one batched dispatch of size >= 2 (doc/serve.md,
+        # "Concurrent batching") — the first request warmed the bucket,
+        # so the burst exercises the batched device path end to end
+        burst = []
+        for i in range(3):
+            code, body = _post(port, "/check",
+                               {"tenant": f"burst-{i % 2}",
+                                "model": "cas-register",
+                                "history": history})
+            if code == 202:
+                burst.append(body["id"])
+            else:
+                problems.append(f"burst POST {i} answered {code}: "
+                                f"{body}")
+        deadline = time.time() + args.budget
+        while time.time() < deadline and burst:
+            burst = [r for r in burst
+                     if _get(port, f"/check/{r}")[1].get("state")
+                     != "done"]
+            time.sleep(0.05)
+        if burst:
+            problems.append(f"{len(burst)} burst request(s) never "
+                            f"finished")
         _, health = _get(port, "/healthz")
-        if not health.get("stats", {}).get("completed"):
+        stats = health.get("stats", {})
+        if not stats.get("batches"):
+            problems.append(f"burst dispatched no batch: {stats}")
+        elif stats.get("max-batch", 0) < 2:
+            problems.append(f"no gang of size >= 2 formed: {stats}")
+        if not stats.get("completed"):
             problems.append(f"healthz reports no completed request: "
-                            f"{health.get('stats')}")
+                            f"{stats}")
         if not health.get("engine", {}).get("warm-buckets"):
             problems.append("healthz reports no warm bucket")
         code, drained = _post(port, "/drain", None)
