@@ -741,7 +741,14 @@ def trace_cmd() -> dict:
       loads directly in Perfetto (ui.perfetto.dev) or chrome://tracing;
       ``--format jsonl`` relays the raw records.
     * ``trace summary`` — per-span-name counts and total/max durations,
-      printed as ``# trace:`` lines.
+      printed as ``# trace:`` lines (plus the artifact's integrity:
+      torn/corrupt line counts and distinct request trace ids).
+    * ``trace request <id>`` — ONE request's distributed trace,
+      stitched across the serve daemon and any fleet worker host dirs
+      (doc/observability.md "Request tracing"): a text waterfall by
+      default, ``--format chrome`` for Perfetto, ``--format json`` for
+      the raw stitched document. ``<id>`` is a serve request id
+      (resolved through serve.wal) or a literal 32-hex trace id.
 
     Reading is torn-tail tolerant (the run may have been SIGKILLed
     mid-span, or still be running)."""
@@ -750,16 +757,24 @@ def trace_cmd() -> dict:
         p = Parser(prog="trace",
                    description="Export or summarize a run's span "
                                "trace (trace.jsonl).")
-        p.add_argument("action", choices=["export", "summary"],
+        p.add_argument("action",
+                       choices=["export", "summary", "request"],
                        help="export: write Chrome/Perfetto (or raw "
-                            "jsonl) trace; summary: per-span rollup")
+                            "jsonl) trace; summary: per-span rollup; "
+                            "request: one request's stitched "
+                            "cross-process waterfall")
+        p.add_argument("rid", nargs="?", default=None, metavar="ID",
+                       help="with `request`: the serve request id (or "
+                            "32-hex trace id) to stitch")
         p.add_argument("--store", default=None,
                        help="store directory (default: latest under "
                             "./store)")
-        p.add_argument("--format", default="chrome",
-                       choices=["chrome", "jsonl", "json"],
-                       help="export format (chrome loads in Perfetto; "
-                            "json = machine-readable `summary` output)")
+        p.add_argument("--format", default=None,
+                       choices=["chrome", "jsonl", "json", "text"],
+                       help="output format (default: chrome for "
+                            "export, text for request; json = "
+                            "machine-readable `summary`/`request` "
+                            "output)")
         p.add_argument("-o", "--output", default=None, metavar="FILE",
                        help="write the export here (default: stdout)")
         p.add_argument("--top", type=int, default=None, metavar="N",
@@ -767,6 +782,12 @@ def trace_cmd() -> dict:
                             "span names by SELF time (total minus "
                             "child spans) — the one slow span a "
                             "count-only rollup buries")
+        p.add_argument("--host-dir", action="append", default=None,
+                       metavar="DIR",
+                       help="with `request`: extra fleet worker host "
+                            "dir(s) whose trace.jsonl joins the "
+                            "stitch (repeatable; host dirs under the "
+                            "store dir are discovered automatically)")
         return p
 
     def run_(opts) -> int:
@@ -783,6 +804,9 @@ def trace_cmd() -> dict:
         if not d or not _os.path.isdir(d):
             print(f"no such store directory: {d}", file=sys.stderr)
             return INVALID_ARGS
+        fmt = opts.get("format") or "chrome"
+        if opts["action"] == "request":
+            return _trace_request(opts, d)
         path = _os.path.join(d, trace_ns.TRACE_NAME)
         if not _os.path.exists(path):
             print(f"no {trace_ns.TRACE_NAME} in {d} (run predates "
@@ -808,12 +832,18 @@ def trace_cmd() -> dict:
         if opts["action"] == "summary":
             rollup = trace_ns.summarize(records)
             kern = profiler.top_kernels(device, k=opts.get("top") or 10)
-            if opts["format"] == "json":
+            if fmt == "json":
                 print(_json.dumps({
                     "stats": stats, "summary": rollup,
                     "self-time": trace_ns.self_time_rollup(records),
                     "kernels": kern}, indent=2, default=repr))
                 return OK
+            # artifact integrity on STDOUT (the stderr banner is lost
+            # in pipelines): torn = SIGKILL mid-write, corrupt = real
+            # damage, traces = distinct request trace ids present
+            print(f"# trace: integrity: {stats['torn']} torn, "
+                  f"{stats['corrupt']} corrupt line(s); "
+                  f"{stats['traces']} request trace id(s)")
             width = max((len(n) for n in rollup), default=4)
             print(f"# trace: {'name':<{width}}  count  total      max")
             for name, s in sorted(rollup.items(),
@@ -844,7 +874,7 @@ def trace_cmd() -> dict:
                           f"rung={rung if rung else '?'}")
             return OK
 
-        if opts["format"] == "chrome":
+        if fmt == "chrome":
             text = _json.dumps(trace_ns.to_chrome(
                 records + device,
                 process_name=_os.path.basename(d) or "jtpu"))
@@ -854,13 +884,114 @@ def trace_cmd() -> dict:
         if opts.get("output"):
             with open(opts["output"], "w") as f:
                 f.write(text)
-            print(f"# trace: wrote {opts['format']} export to "
+            print(f"# trace: wrote {fmt} export to "
                   f"{opts['output']}", file=sys.stderr)
         else:
             print(text)
         return OK
 
     return {"trace": {"parser": build_parser, "run": run_}}
+
+
+def _resolve_trace_id(store_dir: str, token: str):
+    """A serve request id (via the daemon's serve.wal accepted
+    records) or a literal 32-hex trace id -> the trace id, else
+    None."""
+    import os as _os
+
+    t = (token or "").strip()
+    low = t.lower()
+    if len(low) == 32 and all(c in "0123456789abcdef" for c in low):
+        return low
+    from jepsen_tpu import journal as journal_ns
+    from jepsen_tpu import serve as serve_ns
+    wal = _os.path.join(store_dir, serve_ns.WAL_NAME)
+    if not _os.path.exists(wal):
+        return None
+    try:
+        records, _ = journal_ns.read_json_records(wal)
+    except (OSError, ValueError):
+        return None
+    for r in records:
+        if r.get("event") == "accepted" and r.get("id") == t:
+            return r.get("trace")
+    return None
+
+
+def _trace_request(opts, d: str) -> int:
+    """``jtpu trace request <id>`` — stitch one request's distributed
+    trace across the serve daemon's trace.jsonl and any fleet worker
+    host dirs, and render the single-request waterfall."""
+    import json as _json
+
+    from jepsen_tpu.obs import fleet as obs_fleet
+
+    rid = opts.get("rid")
+    if not rid:
+        print("trace request needs a request id (or a 32-hex trace "
+              "id): jtpu trace request <id> --store <serve-dir>",
+              file=sys.stderr)
+        return INVALID_ARGS
+    tid = _resolve_trace_id(d, rid)
+    if not tid:
+        print(f"couldn't resolve {rid!r} to a trace id: no matching "
+              f"accepted record in {d}/serve.wal and it is not a "
+              f"32-hex trace id (JTPU_TRACE=0 at admission?)",
+              file=sys.stderr)
+        return INVALID_ARGS
+    stitched = obs_fleet.stitch_request(d, tid,
+                                        extra_dirs=opts.get("host_dir"))
+    recs = stitched["records"]
+    fmt = opts.get("format") or "text"
+    text = None
+    if fmt == "json":
+        text = _json.dumps(stitched, indent=2, default=repr)
+    elif fmt == "chrome":
+        text = _json.dumps(obs_fleet.to_chrome(
+            {"hosts": stitched["hosts"], "trace": recs}))
+    elif fmt == "jsonl":
+        text = "\n".join(_json.dumps(r, default=repr)
+                         for r in recs) + "\n"
+    if text is not None:
+        if opts.get("output"):
+            with open(opts["output"], "w") as f:
+                f.write(text)
+            print(f"# trace: wrote {fmt} request export to "
+                  f"{opts['output']}", file=sys.stderr)
+        else:
+            print(text)
+        return OK
+    # the text waterfall: one aligned cross-process timeline
+    hosts = stitched.get("hosts") or []
+    method = stitched.get("method")
+    print(f"# trace: request {rid}: trace {tid}: {len(recs)} "
+          f"record(s) across {max(len(hosts), 1)} process(es)"
+          + (f", clocks aligned via {method}" if method else ""))
+    if not recs:
+        print("# trace: no spans for this trace id (JTPU_TRACE=0, or "
+              "the request has not run yet)")
+        return OK
+    t0 = min(int(r.get("ts", 0)) for r in recs)
+    t1 = max(int(r.get("ts", 0)) + int(r.get("dur", 0) or 0)
+             for r in recs)
+    total = max(t1 - t0, 1)
+    cols = 40
+    namew = max(len(str(r.get("name", "?"))) for r in recs)
+    hostw = max((len(str(r.get("host", ""))) for r in recs),
+                default=0)
+    for r in recs:
+        ts = int(r.get("ts", 0))
+        dur = int(r.get("dur", 0) or 0)
+        a = (cols * (ts - t0)) // total
+        b = max(a + 1, (cols * (ts - t0 + dur) + total - 1) // total)
+        bar = " " * a + ("#" * (b - a) if dur else "|") \
+            + " " * max(0, cols - b)
+        host = str(r.get("host", ""))
+        name = str(r.get("name", "?"))
+        dur_bit = f"{dur / 1e9:>9.4f}s" if dur else "   instant"
+        print(f"# trace: [{bar[:cols]}] {(ts - t0) / 1e9:>9.4f}s "
+              f"{dur_bit}  {host:<{hostw}} {name:<{namew}}")
+    return OK
 
 
 def lint_cmd() -> dict:

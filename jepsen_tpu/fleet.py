@@ -104,6 +104,7 @@ from jepsen_tpu.checker import tpu as T
 from jepsen_tpu.models.core import KernelSpec
 from jepsen_tpu.obs import metrics as obs_metrics
 from jepsen_tpu.obs import observatory as obs_observatory
+from jepsen_tpu.obs import trace as obs_trace
 from jepsen_tpu.ops.encode import PackedHistory
 from jepsen_tpu.resilience import (CARRY_FIELDS, Checkpoint, RetryPolicy,
                                    classify_failure)
@@ -342,14 +343,18 @@ def shard_carry(slice_pool: tuple, level: int, best: int) -> tuple:
 
 
 def save_carry(path: str, carry: tuple, **meta: Any) -> None:
-    """Atomic npz write of a carry plus integer metadata (the
-    Checkpoint format's array layout, tmp+replace like every artifact
-    in this repo). The tmp name is dot-prefixed so a directory scan
-    for ``req_*.npz`` / ``resp_*.npz`` can never observe it
-    half-written."""
+    """Atomic npz write of a carry plus metadata (the Checkpoint
+    format's array layout, tmp+replace like every artifact in this
+    repo). Metadata values are integers (None -> -1) or strings (the
+    request's distributed trace id rides here, as the cols artifact's
+    ``kernel`` name already does). The tmp name is dot-prefixed so a
+    directory scan for ``req_*.npz`` / ``resp_*.npz`` can never
+    observe it half-written."""
     arrays = {f"carry_{n}": np.asarray(v)
               for n, v in zip(CARRY_FIELDS, carry)}
-    marrays = {f"meta_{k}": np.int64(-1 if v is None else v)
+    marrays = {f"meta_{k}": (np.bytes_(v.encode())
+                             if isinstance(v, str)
+                             else np.int64(-1 if v is None else v))
                for k, v in meta.items()}
     tmp = os.path.join(os.path.dirname(path) or ".",
                        f".tmp.{os.path.basename(path)}.{os.getpid()}")
@@ -358,12 +363,21 @@ def save_carry(path: str, carry: tuple, **meta: Any) -> None:
     os.replace(tmp if os.path.exists(tmp) else tmp + ".npz", path)
 
 
-def load_carry(path: str) -> Tuple[tuple, Dict[str, int]]:
+def _meta_value(arr) -> Any:
+    """One ``meta_*`` npz entry back to int or str."""
+    a = np.asarray(arr)
+    if a.dtype.kind in ("S", "U"):
+        v = a.item()
+        return v.decode() if isinstance(v, bytes) else str(v)
+    return int(a)
+
+
+def load_carry(path: str) -> Tuple[tuple, Dict[str, Any]]:
     """Read a carry written by :func:`save_carry`; scalar slots are
     normalized to numpy scalars so jit sees identical avals."""
     with np.load(path) as z:
         carry = tuple(z[f"carry_{n}"] for n in CARRY_FIELDS)
-        meta = {k[len("meta_"):]: int(z[k])
+        meta = {k[len("meta_"):]: _meta_value(z[k])
                 for k in z.files if k.startswith("meta_")}
     carry = (carry[:5]
              + (np.bool_(carry[5]), np.bool_(carry[6]),
@@ -562,9 +576,17 @@ class ProcHost:
                round_idx: int) -> None:
         self._req_n += 1
         cap, win, exp = rung
+        meta: Dict[str, Any] = dict(seg_iters=seg_iters, capacity=cap,
+                                    window=win, expand=exp,
+                                    round=round_idx)
+        if obs_trace.enabled():
+            # propagate the ambient request trace across the process
+            # boundary: the worker's segment spans join the same trace
+            trace_id, _ = obs_trace.current_context()
+            if trace_id:
+                meta["trace"] = trace_id
         save_carry(os.path.join(self.dir, f"req_{self._req_n}.npz"),
-                   carry, seg_iters=seg_iters, capacity=cap, window=win,
-                   expand=exp, round=round_idx)
+                   carry, **meta)
         self._await = self._req_n
 
     def collect(self, deadline_s: float) -> Tuple[tuple, float]:
@@ -645,12 +667,22 @@ def worker_main(host_dir: str) -> int:
     write_heartbeat(host_dir)
     threading.Thread(target=beat_loop, daemon=True,
                      name="jtpu-fleet-heartbeat").start()
+    if obs_trace.enabled():
+        # the worker's own trace artifact: segment spans land here,
+        # carrying the request trace ids the leader ships in req_N
+        # meta; the sync event lets the stitcher align this process's
+        # monotonic epoch with the leader's (same machine, same wall
+        # clock)
+        obs_trace.tracer().attach(
+            os.path.join(host_dir, obs_trace.TRACE_NAME))
+        obs_trace.sync_event()
     cols = None
     kernel = None
     done: set = set()
     while True:
         if os.path.exists(os.path.join(host_dir, "stop")):
             stop_beat.set()
+            obs_trace.tracer().detach()
             return 0
         reqs = []
         for f in os.listdir(host_dir):
@@ -682,15 +714,23 @@ def worker_main(host_dir: str) -> int:
                 os.path.join(host_dir, f"req_{n}.npz"))
             state["state"], state["round"] = ("segment",
                                               meta.get("round"))
+            obs_trace.set_context(meta.get("trace") or None)
             exp = meta.get("expand")
-            fn = T._jit_segment(
-                T._kernel_key(kernel), meta["capacity"],
-                meta["window"], None if exp is None or exp < 0 else exp,
-                T._unroll_factor())
-            out = fn(*(cols[c] for c in T._COLS),
-                     np.int32(meta["seg_iters"]), carry)
-            save_carry(os.path.join(host_dir, f"resp_{n}.npz"),
-                       tuple(np.asarray(x) for x in out))
+            with obs.span("checker.segment",
+                          host=os.path.basename(host_dir) or host_dir,
+                          round=meta.get("round"),
+                          rung=[meta["capacity"], meta["window"],
+                                None if exp is None or exp < 0 else exp],
+                          seg_iters=meta["seg_iters"]):
+                fn = T._jit_segment(
+                    T._kernel_key(kernel), meta["capacity"],
+                    meta["window"],
+                    None if exp is None or exp < 0 else exp,
+                    T._unroll_factor())
+                out = fn(*(cols[c] for c in T._COLS),
+                         np.int32(meta["seg_iters"]), carry)
+                out = tuple(np.asarray(x) for x in out)
+            save_carry(os.path.join(host_dir, f"resp_{n}.npz"), out)
         except Exception as e:  # noqa: BLE001 — relayed to the leader
             tmp = os.path.join(host_dir, f".err.tmp.{os.getpid()}")
             try:
@@ -700,6 +740,7 @@ def worker_main(host_dir: str) -> int:
             except OSError:
                 pass
         done.add(n)
+        obs_trace.clear_context()
         state["state"], state["round"] = "idle", None
         write_heartbeat(host_dir)
 

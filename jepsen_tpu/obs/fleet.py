@@ -303,6 +303,70 @@ def merge(dirs: List[str],
             "summary": summary}
 
 
+def _sync_epoch_wall(records: List[dict]) -> Optional[int]:
+    """The wall-clock ns at this trace's monotonic epoch, from its
+    first ``trace.sync`` anchor event (None without one)."""
+    for r in records:
+        if r.get("name") == "trace.sync" \
+                and isinstance(r.get("wall_ns"), (int, float)):
+            return int(r["wall_ns"]) - int(r.get("ts", 0))
+    return None
+
+
+def stitch_request(run_dir: Optional[str], trace_id: str,
+                   extra_dirs: Optional[List[str]] = None
+                   ) -> Dict[str, Any]:
+    """One request's distributed trace, stitched across every process
+    that touched it: the serve daemon's ``trace.jsonl`` plus any fleet
+    worker host dirs underneath (or passed explicitly). Returns
+    ``{"trace-id", "records", "hosts", "offsets", "method"}`` with
+    records on one aligned timeline, sorted by start time.
+
+    Alignment prefers the ``trace.sync`` wall-clock anchors long-lived
+    tracers emit (exact for same-machine processes); hosts without one
+    fall back to the fleet merge's shared-anchor-span heuristic, and a
+    lone traced process needs no alignment at all."""
+    dirs: List[str] = []
+    if run_dir:
+        dirs.append(run_dir)
+        for d in discover_hosts(run_dir):
+            if d not in dirs:
+                dirs.append(d)
+    for d in extra_dirs or []:
+        if d not in dirs:
+            dirs.append(d)
+    hosts = [h for h in (read_host(d) for d in dirs) if h["trace"]]
+    seen: Dict[str, int] = {}
+    for h in hosts:
+        n = seen.get(h["host"], 0)
+        seen[h["host"]] = n + 1
+        if n:
+            h["host"] = f"{h['host']}~{n}"
+    offsets = {h["host"]: 0 for h in hosts}
+    method = None
+    if len(hosts) >= 2:
+        sync = {h["host"]: _sync_epoch_wall(h["trace"]) for h in hosts}
+        if all(v is not None for v in sync.values()):
+            ref = sync[hosts[0]["host"]]
+            offsets = {host: epoch - ref
+                       for host, epoch in sync.items()}
+            method = "wall-clock"
+        else:
+            offsets, anchor = clock_offsets(hosts)
+            method = f"anchor:{anchor}" if anchor else None
+    records: List[dict] = []
+    for h in hosts:
+        off = offsets.get(h["host"], 0)
+        records.extend(
+            dict(r, ts=int(r.get("ts", 0)) + off, host=h["host"])
+            for r in h["trace"] if r.get("trace") == trace_id)
+    records.sort(key=lambda r: (r["ts"], r.get("host", ""),
+                                r.get("tid", 0)))
+    return {"trace-id": trace_id, "records": records,
+            "hosts": [h["host"] for h in hosts],
+            "offsets": offsets, "method": method}
+
+
 def to_chrome(merged: Dict[str, Any]) -> dict:
     """A merged fleet -> one Chrome/Perfetto document, one process per
     host (vs the single-process :func:`jepsen_tpu.obs.trace.to_chrome`)

@@ -147,7 +147,15 @@ class Histogram(_Metric):
     """Fixed-bucket histogram. Each series is ``[counts..., sum,
     count]`` where ``counts[i]`` is the NON-cumulative tally of
     observations <= bounds[i] and > bounds[i-1]; the exposition emits
-    the cumulative ``le`` form Prometheus expects."""
+    the cumulative ``le`` form Prometheus expects.
+
+    ``observe(..., exemplar={"trace_id": tid})`` additionally remembers
+    the labeled observation as that bucket's **exemplar** — emitted as
+    an OpenMetrics ``# {trace_id="..."} value`` suffix on the bucket
+    line, so a scrape of a tail-latency bucket links straight to the
+    exact slow request's distributed trace. Last-write-wins per bucket
+    (the OpenMetrics model); an exposition without exemplars is
+    byte-identical to the pre-exemplar format."""
 
     kind = "histogram"
 
@@ -155,8 +163,12 @@ class Histogram(_Metric):
                  buckets: Tuple[float, ...] = DEFAULT_BUCKETS):
         super().__init__(name, help, lock)
         self.bounds = tuple(sorted(float(b) for b in buckets))
+        self._exemplars: Dict[Tuple[_LabelKey, int],
+                              Tuple[Dict[str, str], float]] = {}
 
-    def observe(self, value: float, **labels) -> None:
+    def observe(self, value: float,
+                exemplar: Optional[Dict[str, Any]] = None,
+                **labels) -> None:
         key = _labels_key(labels)
         with self._lock:
             s = self._series.get(key)
@@ -172,6 +184,10 @@ class Histogram(_Metric):
             s[i] += 1
             s[-2] += float(value)
             s[-1] += 1
+            if exemplar:
+                self._exemplars[(key, i)] = (
+                    {str(k): str(v) for k, v in exemplar.items()},
+                    float(value))
 
     def series(self, **labels) -> Optional[dict]:
         """{bucket-counts (non-cumulative), sum, count} for one series."""
@@ -194,6 +210,15 @@ class Histogram(_Metric):
                     tot_count += s[-1]
         return {"sum": tot_sum, "count": tot_count}
 
+    def _exemplar_suffix(self, key: _LabelKey, i: int) -> str:
+        ex = self._exemplars.get((key, i))
+        if not ex:
+            return ""
+        labels, value = ex
+        inner = ",".join(f'{k}="{_escape(v)}"'
+                         for k, v in sorted(labels.items()))
+        return f" # {{{inner}}} {_fmt_num(value)}"
+
     def _expose_series(self, key: _LabelKey, s: list) -> List[str]:
         lines = []
         cum = 0
@@ -201,21 +226,29 @@ class Histogram(_Metric):
             cum += s[i]
             lines.append(f"{self.name}_bucket"
                          f"{_fmt_labels(key, [('le', _fmt_num(b))])} "
-                         f"{cum}")
+                         f"{cum}{self._exemplar_suffix(key, i)}")
         cum += s[len(self.bounds)]
         lines.append(f"{self.name}_bucket"
-                     f"{_fmt_labels(key, [('le', '+Inf')])} {cum}")
+                     f"{_fmt_labels(key, [('le', '+Inf')])} {cum}"
+                     f"{self._exemplar_suffix(key, len(self.bounds))}")
         lines.append(f"{self.name}_sum{_fmt_labels(key)} "
                      f"{_fmt_num(s[-2])}")
         lines.append(f"{self.name}_count{_fmt_labels(key)} {s[-1]}")
         return lines
 
     def snapshot(self) -> Any:
-        return {_fmt_labels(k) or "": {
-                    "buckets": list(v[:-2]),
-                    "bounds": list(self.bounds),
-                    "sum": v[-2], "count": v[-1]}
-                for k, v in self._series.items()}
+        out = {}
+        for k, v in self._series.items():
+            doc = {"buckets": list(v[:-2]),
+                   "bounds": list(self.bounds),
+                   "sum": v[-2], "count": v[-1]}
+            exs = {i: {"labels": dict(labels), "value": value}
+                   for (key, i), (labels, value)
+                   in self._exemplars.items() if key == k}
+            if exs:
+                doc["exemplars"] = exs
+            out[_fmt_labels(k) or ""] = doc
+        return out
 
 
 class Registry:

@@ -315,6 +315,11 @@ def format_status(p: Optional[Dict[str, Any]]) -> str:
                 f"inflight {s.get('inflight', 0)}",
                 f"done {s.get('completed', 0)}",
                 f"rejected {s.get('rejected', 0)}"]
+        if s.get("oldest-inflight-s") is not None:
+            # the stuck-request signal: how long the longest-running
+            # in-flight check has been on a worker
+            bits.insert(2, f"oldest-inflight "
+                           f"{s['oldest-inflight-s']:g}s")
         if s.get("batches"):
             gang = f"batches {s['batches']} (max {s.get('max-batch', 0)})"
             bits.append(gang)

@@ -25,6 +25,18 @@ with ``ts``/``dur`` in nanoseconds relative to the tracer's epoch.
 (the ``traceEvents`` array form), which Perfetto and ``chrome://
 tracing`` load directly; the CLI surface is ``jtpu trace export``.
 
+Request-scoped tracing rides a per-thread **trace context**
+(:meth:`Tracer.set_context`): while a context is set, every span and
+event recorded on that thread additionally carries a ``trace`` field
+(the W3C-style 32-hex trace id) and — for root spans with no local
+parent — a ``parent`` field naming the remote parent span id. The
+serve daemon sets the context around each request's execution, ships
+it to fleet workers, and the stitcher (:func:`jepsen_tpu.obs.fleet.
+stitch_request`) reassembles one cross-process waterfall from the
+per-process trace files. :func:`parse_traceparent` /
+:func:`format_traceparent` speak the ``00-<trace>-<span>-<flags>``
+header format.
+
 Kill switch: ``JTPU_TRACE=0`` makes :func:`span`/:func:`event` return
 shared no-op objects — no ring append, no file, no measurable work.
 """
@@ -136,10 +148,38 @@ class _Span:
                "dur": dur, "tid": self.tid, "sid": self.sid}
         if self.pid:
             rec["pid"] = self.pid
+        ctx = self.tracer._ctx()
+        if ctx["trace"] is not None:
+            rec["trace"] = ctx["trace"]
+            if not self.pid and ctx["parent"] is not None:
+                # a context root: parent lives in another process
+                rec["parent"] = ctx["parent"]
         if self.attrs:
             rec.update({k: v for k, v in self.attrs.items()
                         if k not in rec})
         self.tracer._record(rec)
+        return False
+
+
+class _CtxGuard:
+    """Save/set/restore for a thread's trace context (the re-entrant
+    form of :meth:`Tracer.set_context`)."""
+
+    __slots__ = ("tracer", "trace", "parent", "_saved")
+
+    def __init__(self, tracer: "Tracer", trace_id: Optional[str],
+                 parent_span_id: Optional[str]):
+        self.tracer = tracer
+        self.trace = trace_id
+        self.parent = parent_span_id
+
+    def __enter__(self) -> "_CtxGuard":
+        self._saved = self.tracer.current_context()
+        self.tracer.set_context(self.trace, self.parent)
+        return self
+
+    def __exit__(self, *exc):
+        self.tracer.set_context(*self._saved)
         return False
 
 
@@ -171,6 +211,38 @@ class Tracer:
             self._local.stack = st
         return st
 
+    def _ctx(self) -> dict:
+        c = getattr(self._local, "ctx", None)
+        if c is None:
+            c = {"trace": None, "parent": None}
+            self._local.ctx = c
+        return c
+
+    # -- trace context (request-scoped distributed tracing) -----------------
+
+    def set_context(self, trace_id: Optional[str],
+                    parent_span_id: Optional[str] = None) -> None:
+        """Bind this THREAD's spans to one distributed trace: until
+        cleared, every record gains ``trace=trace_id`` (and context
+        roots gain ``parent=parent_span_id``). Thread-local by design —
+        concurrent serve workers each carry their own request's id."""
+        c = self._ctx()
+        c["trace"], c["parent"] = trace_id, parent_span_id
+
+    def clear_context(self) -> None:
+        self.set_context(None, None)
+
+    def current_context(self) -> Tuple[Optional[str], Optional[str]]:
+        c = self._ctx()
+        return c["trace"], c["parent"]
+
+    def context(self, trace_id: Optional[str],
+                parent_span_id: Optional[str] = None) -> "_CtxGuard":
+        """``with tracer().context(tid):`` — set-and-restore, so nested
+        request execution (e.g. a gang member re-run) can't leak its id
+        onto the worker thread's later requests."""
+        return _CtxGuard(self, trace_id, parent_span_id)
+
     # -- recording ----------------------------------------------------------
 
     def span(self, name: str, /, **attrs) -> _Span:
@@ -185,6 +257,11 @@ class Tracer:
         stack = self._stack()
         if stack:
             rec["pid"] = stack[-1]
+        ctx = self._ctx()
+        if ctx["trace"] is not None:
+            rec["trace"] = ctx["trace"]
+            if not stack and ctx["parent"] is not None:
+                rec["parent"] = ctx["parent"]
         if attrs:
             rec.update({k: v for k, v in attrs.items() if k not in rec})
         self._record(rec)
@@ -275,6 +352,76 @@ def event(name: str, /, **attrs) -> None:
         _GLOBAL.event(name, **attrs)
 
 
+def set_context(trace_id: Optional[str],
+                parent_span_id: Optional[str] = None) -> None:
+    """Bind the calling thread's spans to a distributed trace id on the
+    global tracer (no-op storage when JTPU_TRACE=0 — nothing records
+    anyway, but callers needn't gate)."""
+    _GLOBAL.set_context(trace_id, parent_span_id)
+
+
+def clear_context() -> None:
+    _GLOBAL.clear_context()
+
+
+def current_context() -> Tuple[Optional[str], Optional[str]]:
+    return _GLOBAL.current_context()
+
+
+def context(trace_id: Optional[str],
+            parent_span_id: Optional[str] = None):
+    """``with trace.context(tid):`` on the global tracer."""
+    return _GLOBAL.context(trace_id, parent_span_id)
+
+
+# ---------------------------------------------------------------------------
+# W3C-style traceparent (00-<32 hex trace>-<16 hex span>-<2 hex flags>)
+# ---------------------------------------------------------------------------
+
+
+def new_trace_id() -> str:
+    """A fresh 32-hex (128-bit) trace id."""
+    return os.urandom(16).hex()
+
+
+def parse_traceparent(header: Any) -> Optional[Tuple[str, str]]:
+    """``traceparent`` header -> ``(trace_id, parent_span_id)``, or
+    ``None`` for anything malformed (wrong field widths, non-hex,
+    all-zero ids) — an invalid inbound header means *mint a fresh
+    trace*, never a crash."""
+    if not isinstance(header, str):
+        return None
+    parts = header.strip().lower().split("-")
+    if len(parts) < 4:
+        return None
+    ver, tid, sid = parts[0], parts[1], parts[2]
+    if len(ver) != 2 or len(tid) != 32 or len(sid) != 16:
+        return None
+    try:
+        int(ver, 16), int(tid, 16), int(sid, 16)
+    except ValueError:
+        return None
+    if tid == "0" * 32 or sid == "0" * 16:
+        return None
+    return tid, sid
+
+
+def format_traceparent(trace_id: str, span_id: Any = None) -> str:
+    """``(trace_id, span id)`` -> a traceparent header value. Span ids
+    are the tracer's integer sids, rendered 16-hex; with none yet
+    assigned (e.g. echoing at admission), a random non-zero id is
+    minted — the spec forbids all-zero span ids."""
+    if isinstance(span_id, str) and span_id:
+        sid = span_id
+    elif span_id:
+        sid = f"{int(span_id) & (2 ** 64 - 1):016x}"
+    else:
+        sid = os.urandom(8).hex()
+        if sid == "0" * 16:  # astronomically unlikely, spec-forbidden
+            sid = "0" * 15 + "1"
+    return f"00-{trace_id}-{sid}-01"
+
+
 def start_run(store_dir: Optional[str]) -> None:
     """Attach the global tracer's file sink to a run's store directory
     (``core.run`` calls this once the directory exists). No-op when
@@ -289,6 +436,17 @@ def finish_run() -> None:
     _GLOBAL.detach()
 
 
+def sync_event() -> None:
+    """Record a ``trace.sync`` wall-clock anchor (``wall_ns`` =
+    ``time.time_ns()`` at a known monotonic ``ts``). Long-lived
+    processes that share a trace (the serve daemon, fleet workers) emit
+    one after attaching their sink so the stitcher can align their
+    monotonic epochs exactly — same-machine processes share a wall
+    clock even though each tracer's epoch differs."""
+    if enabled():
+        _GLOBAL.event("trace.sync", wall_ns=time.time_ns())
+
+
 # ---------------------------------------------------------------------------
 # Artifact reading + export
 # ---------------------------------------------------------------------------
@@ -298,8 +456,9 @@ def read_trace(path: str) -> Tuple[List[dict], Dict[str, int]]:
     """Torn-tail-tolerant trace.jsonl reader (the WAL reader's contract:
     a run SIGKILLed mid-span-write leaves at most one partial final
     line, dropped silently as ``torn``; an undecodable *earlier* line is
-    real corruption — skipped, counted, warned about)."""
-    stats = {"spans": 0, "torn": 0, "corrupt": 0}
+    real corruption — skipped, counted, warned about). ``stats`` also
+    counts the distinct request trace ids present (``traces``)."""
+    stats = {"spans": 0, "torn": 0, "corrupt": 0, "traces": 0}
     with open(path, "rb") as f:
         data = f.read()
     lines = data.split(b"\n")
@@ -322,7 +481,19 @@ def read_trace(path: str) -> Tuple[List[dict], Dict[str, int]]:
                 stats["corrupt"] += 1
                 log.warning("trace %s: dropping corrupt record at "
                             "line %d", path, i + 1)
+    stats["traces"] = len({r["trace"] for r in out if r.get("trace")})
     return out, stats
+
+
+def by_trace(records: List[dict]) -> Dict[str, List[dict]]:
+    """Group records by their request trace id (records without one —
+    background daemon work — are omitted)."""
+    out: Dict[str, List[dict]] = {}
+    for r in records:
+        t = r.get("trace")
+        if t:
+            out.setdefault(str(t), []).append(r)
+    return out
 
 
 #: Chrome trace-event metadata keys a span record maps onto directly;
@@ -355,9 +526,13 @@ def to_chrome(records: List[dict], process_name: str = "jtpu") -> dict:
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
-def summarize(records: List[dict]) -> Dict[str, Dict[str, Any]]:
+def summarize(records: List[dict],
+              trace: Optional[str] = None) -> Dict[str, Dict[str, Any]]:
     """Per-name rollup: count, total/max duration (ns) — the payload of
-    ``jtpu trace summary`` and the ``# trace:`` recovery line."""
+    ``jtpu trace summary`` and the ``# trace:`` recovery line. With
+    ``trace``, rolls up only that request's spans."""
+    if trace is not None:
+        records = [r for r in records if r.get("trace") == trace]
     out: Dict[str, Dict[str, Any]] = {}
     for r in records:
         s = out.setdefault(str(r.get("name", "?")),
@@ -369,13 +544,17 @@ def summarize(records: List[dict]) -> Dict[str, Dict[str, Any]]:
     return dict(sorted(out.items()))
 
 
-def self_time_rollup(records: List[dict]
+def self_time_rollup(records: List[dict],
+                     trace: Optional[str] = None
                      ) -> Dict[str, Dict[str, Any]]:
     """Per-name SELF-time rollup: each span's duration minus its direct
     children's (via the ``pid`` parent link), so an outer span that
     merely contains a slow inner one stops dominating the table. The
     ``jtpu trace summary --top N`` payload: ``{name: {count, self-ns,
-    p95-ns}}`` with p95 over the per-span self times."""
+    p95-ns}}`` with p95 over the per-span self times. With ``trace``,
+    restricted to one request's spans."""
+    if trace is not None:
+        records = [r for r in records if r.get("trace") == trace]
     child_ns: Dict[int, int] = {}
     for r in records:
         pid = r.get("pid")

@@ -96,6 +96,7 @@ from typing import Any, Dict, Optional, Tuple
 from jepsen_tpu import journal as journal_ns
 from jepsen_tpu.history import History
 from jepsen_tpu.obs import metrics as obs_metrics
+from jepsen_tpu.obs import trace as obs_trace
 
 log = logging.getLogger("jepsen.serve")
 
@@ -274,11 +275,17 @@ class CheckRequest:
     footprint: Optional[int] = None
     dims: Optional[Any] = None         # plan.PlanDims, for gang pricing
     probe: bool = False                # half-open breaker probe
+    trace: Optional[str] = None        # 32-hex distributed trace id
+    trace_parent: Optional[str] = None  # inbound traceparent span id
+    started_at: Optional[float] = None  # monotonic, set at dequeue
+    coalesce_s: float = 0.0            # gang leader's gather wait
 
     def public(self) -> Dict[str, Any]:
         doc = {"id": self.id, "tenant": self.tenant,
                "model": self.model, "state": self.state,
                "submitted": self.submitted}
+        if self.trace:
+            doc["trace"] = self.trace
         if self.bucket is not None:
             doc["bucket"] = list(self.bucket)
         if self.footprint is not None:
@@ -498,7 +505,12 @@ class BatchScheduler:
                 break
             with d._work:
                 d._work.wait(timeout=min(deadline - now, 0.05))
-        _COALESCE_WAIT.observe(time.monotonic() - t0)
+        wait = time.monotonic() - t0
+        leader.coalesce_s = wait
+        _COALESCE_WAIT.observe(
+            wait, tenant=leader.tenant,
+            exemplar=({"trace_id": leader.trace}
+                      if leader.trace else None))
         _BATCH_SIZE.observe(len(gang))
         return gang
 
@@ -694,15 +706,37 @@ class CheckDaemon:
             self._seq += 1
             rid = doc.get("id") if replayed else None
             rid = rid or f"r{self._seq:06d}-{os.getpid()}"
+        # Distributed trace id (doc/observability.md "Request tracing"):
+        # honor an inbound W3C traceparent, keep a replayed request's
+        # journaled id (the replay IS the same request), else mint one
+        # at admission. JTPU_TRACE=0 leaves everything None — the WAL
+        # record, the 202 body, and the result file stay byte-identical.
+        trace_id, trace_parent = None, None
+        if obs_trace.enabled():
+            tp = obs_trace.parse_traceparent(doc.get("traceparent"))
+            if replayed and doc.get("trace"):
+                trace_id = str(doc["trace"])
+                trace_parent = (str(doc["trace-parent"])
+                                if doc.get("trace-parent") else None)
+            elif tp is not None:
+                trace_id, trace_parent = tp
+            else:
+                trace_id = obs_trace.new_trace_id()
         req = CheckRequest(id=rid, tenant=tenant, model=model_name,
                            history=ops, deadline_s=deadline,
                            bucket=bucket, footprint=footprint,
-                           dims=dims, probe=probe)
+                           dims=dims, probe=probe, trace=trace_id,
+                           trace_parent=trace_parent)
         if not replayed:
-            self.journal.append({
+            rec = {
                 "event": "accepted", "id": req.id, "tenant": tenant,
                 "model": model_name, "deadline-s": deadline,
-                "ts": req.submitted, "history": ops})
+                "ts": req.submitted, "history": ops}
+            if trace_id:
+                rec["trace"] = trace_id
+                if trace_parent:
+                    rec["trace-parent"] = trace_parent
+            self.journal.append(rec)
         with self._work:
             q = self._queues.get(tenant)
             if q is None:
@@ -722,7 +756,11 @@ class CheckDaemon:
         body = {"id": req.id, "state": "queued", "tenant": tenant}
         if bucket is not None:
             body["bucket"] = list(bucket)
-        return 202, body, {}
+        hdrs: Dict[str, str] = {}
+        if req.trace:
+            body["trace"] = req.trace
+            hdrs["traceparent"] = obs_trace.format_traceparent(req.trace)
+        return 202, body, hdrs
 
     # -- worker side --------------------------------------------------------
 
@@ -744,6 +782,7 @@ class CheckDaemon:
                         req = q.popleft()
                         self._depth -= 1
                         req.state = "running"
+                        req.started_at = time.monotonic()
                         self._inflight[req.id] = req
                         _QUEUE_DEPTH.set(self._depth)
                         _INFLIGHT.set(len(self._inflight))
@@ -772,6 +811,7 @@ class CheckDaemon:
                     q.popleft()
                     self._depth -= 1
                     head.state = "running"
+                    head.started_at = time.monotonic()
                     self._inflight[head.id] = head
                     _QUEUE_DEPTH.set(self._depth)
                     _INFLIGHT.set(len(self._inflight))
@@ -799,24 +839,104 @@ class CheckDaemon:
                 log.warning("bucket warm failed (%s); checking cold", e)
         return check_safe(checker, {"name": f"serve-{req.id}"}, h)
 
+    @staticmethod
+    def _trace_phases(trace_id: Optional[str]) -> Tuple[float, float]:
+        """(compile_s, device_s) attributed to one trace id from the
+        tracer ring. ``engine.warm`` spans are wholly compile time (the
+        warm ladder's jit calls emit no leaf spans of their own); leaf
+        device spans — ``checker.device.*`` and the resilience
+        supervisor's ``checker.segment``, both carrying
+        ``phase="compile"|"execute"`` — split by phase, except leaves
+        nested under a warm span (already counted as warm). The two
+        leaf families never nest in each other, so the sums are
+        double-count-free."""
+        comp = dev = 0.0
+        if not trace_id:
+            return comp, dev
+        recs = [r for r in obs_trace.tracer().spans()
+                if r.get("trace") == trace_id]
+        parent = {r.get("sid"): r.get("pid") for r in recs}
+        warm_sids = {r.get("sid") for r in recs
+                     if r.get("name") == "engine.warm"}
+
+        def under_warm(rec: dict) -> bool:
+            sid, hops = rec.get("pid"), 0
+            while sid and hops < 64:
+                if sid in warm_sids:
+                    return True
+                sid, hops = parent.get(sid), hops + 1
+            return False
+
+        for rec in recs:
+            name = str(rec.get("name", ""))
+            dur = int(rec.get("dur", 0) or 0) / 1e9
+            if name == "engine.warm":
+                comp += dur
+                continue
+            if name != "checker.segment" \
+                    and not name.startswith("checker.device."):
+                continue
+            if under_warm(rec):
+                continue
+            if rec.get("phase") == "compile":
+                comp += dur
+            elif rec.get("phase") == "execute":
+                dev += dur
+        return comp, dev
+
+    def _phase_doc(self, req: CheckRequest, queue_s: float,
+                   secs: float, extra_trace: Optional[str] = None
+                   ) -> Dict[str, float]:
+        """The per-request phase breakdown (GET /check/<id>):
+        queue/coalesce from the scheduler's own clocks, compile/device
+        from the request's trace spans, verdict_s the remainder of the
+        service wall-clock — the five phases sum to ~queue + service
+        time."""
+        comp, dev = self._trace_phases(req.trace)
+        if extra_trace and extra_trace != req.trace:
+            c2, d2 = self._trace_phases(extra_trace)
+            comp, dev = comp + c2, dev + d2
+        return {
+            "queue_s": round(queue_s, 6),
+            "coalesce_s": round(req.coalesce_s or 0.0, 6),
+            "compile_s": round(comp, 6),
+            "device_s": round(dev, 6),
+            "verdict_s": round(max(0.0, secs - comp - dev), 6)}
+
     def _run_one(self, req: CheckRequest) -> None:
         from jepsen_tpu.resilience import WEDGE, result_failure_class
-        _QUEUE_WAIT.observe(time.monotonic() - req.queued_at)
+        queue_s = time.monotonic() - req.queued_at
+        _QUEUE_WAIT.observe(queue_s, tenant=req.tenant,
+                            exemplar=({"trace_id": req.trace}
+                                      if req.trace else None))
         t0 = time.monotonic()
         box: Dict[str, Any] = {}
         timed_out = False
-        if req.deadline_s:
-            worker = threading.Thread(
-                target=lambda: box.update(r=self._check(req)),
-                daemon=True, name=f"jtpu-serve-check-{req.id}")
-            worker.start()
-            worker.join(req.deadline_s)
-            if worker.is_alive():
-                # the worker is abandoned like a wedged device segment;
-                # its late result (if any) is discarded below
-                timed_out = True
-        else:
-            box["r"] = self._check(req)
+        with obs_trace.context(req.trace, req.trace_parent):
+            with obs_trace.span("serve.request", id=req.id,
+                                tenant=req.tenant, model=req.model,
+                                queue_s=round(queue_s, 6)):
+                if req.deadline_s:
+                    ctx = obs_trace.current_context()
+
+                    def _checked():
+                        # the deadline thread is a context root in this
+                        # trace: _check's spans must join the request
+                        obs_trace.set_context(*ctx)
+                        box.update(r=self._check(req))
+
+                    worker = threading.Thread(
+                        target=_checked, daemon=True,
+                        name=f"jtpu-serve-check-{req.id}")
+                    worker.start()
+                    worker.join(req.deadline_s)
+                    if worker.is_alive():
+                        # the worker is abandoned like a wedged device
+                        # segment; its late result (if any) is
+                        # discarded below
+                        timed_out = True
+                else:
+                    box["r"] = self._check(req)
         if timed_out:
             result = {"valid": "unknown", "error": ":info/timeout",
                       "deadline-s": req.deadline_s,
@@ -831,6 +951,10 @@ class CheckDaemon:
         result["serve"] = {"id": req.id, "tenant": req.tenant,
                            "seconds": round(secs, 6),
                            "timed-out": timed_out}
+        if req.trace:
+            result["serve"]["trace"] = req.trace
+            result["serve"]["phases"] = self._phase_doc(
+                req, queue_s, secs)
         self.breaker.record(req.bucket, result_failure_class(result),
                             req.probe)
         self._finish(req, result, secs)
@@ -850,8 +974,25 @@ class CheckDaemon:
         from jepsen_tpu.resilience import (bisect_poison,
                                            result_failure_class)
         t0 = time.monotonic()
+        leader = gang[0]
+        queue_s = []
         for req in gang:
-            _QUEUE_WAIT.observe(time.monotonic() - req.queued_at)
+            w = time.monotonic() - req.queued_at
+            queue_s.append(w)
+            _QUEUE_WAIT.observe(w, tenant=req.tenant,
+                                exemplar=({"trace_id": req.trace}
+                                          if req.trace else None))
+        # every member's trace gets a join event naming the leader's:
+        # the gang executes under the LEADER's trace context (one device
+        # call), and the link lets a member's stitched waterfall point
+        # at the shared execution
+        if leader.trace:
+            for i, req in enumerate(gang[1:], start=1):
+                if req.trace:
+                    with obs_trace.context(req.trace, req.trace_parent):
+                        obs_trace.event("serve.gang.join", id=req.id,
+                                        leader=leader.trace,
+                                        size=len(gang), index=i)
         # gang membership journaled BEFORE dispatch: a SIGKILL mid-gang
         # replays every member (none has a done record yet), and the
         # record preserves the cohort for replay audits. Replay itself
@@ -882,8 +1023,10 @@ class CheckDaemon:
             return
         if self.config.warm and gang[0].bucket is not None:
             try:
-                self.engine.warm(pks[0], kernel,
-                                 rungs=self.config.warm_rungs)
+                with obs_trace.context(leader.trace,
+                                       leader.trace_parent):
+                    self.engine.warm(pks[0], kernel,
+                                     rungs=self.config.warm_rungs)
             except Exception as e:  # noqa: BLE001 — warming is advisory
                 log.warning("bucket warm failed (%s); checking cold", e)
         now = time.monotonic()
@@ -897,8 +1040,12 @@ class CheckDaemon:
                 [pks[i] for i in span], kernel,
                 deadlines=[deadlines[i] for i in span])
 
-        results, poison, bisections = bisect_poison(
-            list(range(len(gang))), run_gang)
+        with obs_trace.context(leader.trace, leader.trace_parent):
+            with obs_trace.span("serve.gang", size=len(gang),
+                                ids=[r.id for r in gang],
+                                bucket=list(leader.bucket or ())):
+                results, poison, bisections = bisect_poison(
+                    list(range(len(gang))), run_gang)
         poison_set = set(poison)
         if bisections:
             _BATCH_BISECTIONS.inc(bisections)
@@ -915,7 +1062,10 @@ class CheckDaemon:
             if not isinstance(r, dict) or (
                     r.get("valid") is UNKNOWN
                     and r.get("error") != ":info/timeout"):
-                results[i] = self._check(gang[i])
+                with obs_trace.context(gang[i].trace,
+                                       gang[i].trace_parent):
+                    with obs_trace.span("serve.rerun", id=gang[i].id):
+                        results[i] = self._check(gang[i])
                 serial_rerun.add(i)
         if self.config.batch_verify:
             for i, req in enumerate(gang):
@@ -924,7 +1074,10 @@ class CheckDaemon:
                         or not isinstance(r, dict)
                         or r.get("error") == ":info/timeout"):
                     continue
-                serial = self._check(req)
+                # the verify double-run is daemon bookkeeping, not part
+                # of any request's trace — run it context-free
+                with obs_trace.context(None):
+                    serial = self._check(req)
                 keys = ("valid", "levels", "max-linearized-prefix",
                         "final-states", "frontier-op")
                 bad = [k for k in keys if r.get(k) != serial.get(k)]
@@ -961,6 +1114,13 @@ class CheckDaemon:
                 "gang": {"size": len(gang), "index": i,
                          "bisections": bisections,
                          "poison": i in poison_set}}
+            if req.trace:
+                # compile/device attribution: the shared gang execution
+                # ran under the LEADER's trace; a member that was also
+                # re-run serially adds its own spans on top
+                result["serve"]["trace"] = req.trace
+                result["serve"]["phases"] = self._phase_doc(
+                    req, queue_s[i], secs, extra_trace=leader.trace)
             if i in poison_set:
                 _BATCH_POISON.inc(tenant=req.tenant)
                 self.stats["poisoned"] += 1
@@ -988,6 +1148,13 @@ class CheckDaemon:
         if gang is not None:
             done["gang"] = list(gang)
         self.journal.append(done)
+        if req.trace and obs_trace.enabled():
+            # the trace's terminal marker: POST /check ... serve.verdict
+            # is the one-trace-id span the CI gate asserts
+            with obs_trace.context(req.trace, req.trace_parent):
+                obs_trace.event("serve.verdict", id=req.id,
+                                valid=repr(result.get("valid")),
+                                seconds=round(secs, 6))
         with self._work:
             req.result = result
             req.state = "done"
@@ -1034,6 +1201,15 @@ class CheckDaemon:
 
     def start(self) -> "CheckDaemon":
         """Replay the request journal, then start the worker pool."""
+        # the daemon's own trace.jsonl (requests' spans land here); the
+        # trace.sync wall-clock anchor lets the cross-process stitcher
+        # align this file with fleet workers' exactly
+        self._trace_path = None
+        if obs_trace.enabled():
+            self._trace_path = os.path.join(self.config.root,
+                                            obs_trace.TRACE_NAME)
+            obs_trace.tracer().attach(self._trace_path)
+            obs_trace.sync_event()
         pending, stats = RequestJournal.replay(self.journal.path)
         self.replay_stats = dict(stats, requeued=len(pending))
         for doc in pending:
@@ -1086,6 +1262,12 @@ class CheckDaemon:
         for t in self._threads:
             t.join(timeout=2.0)
         self.journal.close()
+        tr = obs_trace.tracer()
+        if getattr(self, "_trace_path", None) and \
+                tr.path == self._trace_path:
+            # detach only OUR sink — a test daemon stopping must not
+            # close a sink a newer daemon (or a run) attached since
+            tr.detach()
         self._publish(force=True, state="stopped")
 
     # -- introspection ------------------------------------------------------
@@ -1095,7 +1277,38 @@ class CheckDaemon:
             req = self._by_id.get(rid)
             return req.public() if req else None
 
+    def resolve_trace(self, token: str) -> Optional[str]:
+        """A request id (live, or journaled by a previous incarnation)
+        or a literal 32-hex trace id -> the trace id, else None."""
+        with self._lock:
+            req = self._by_id.get(token)
+        if req is not None:
+            return req.trace
+        t = token.strip().lower()
+        if len(t) == 32 and all(c in "0123456789abcdef" for c in t):
+            return t
+        try:
+            records, _ = journal_ns.read_json_records(self.journal.path)
+        except (OSError, ValueError):
+            return None
+        for r in records:
+            if r.get("event") == "accepted" and r.get("id") == token:
+                return r.get("trace")
+        return None
+
+    def _oldest_inflight_s(self) -> Optional[float]:
+        """Age (s) of the longest-RUNNING in-flight request — the
+        stuck-request signal on /healthz and the watch line."""
+        now = time.monotonic()
+        with self._lock:
+            if not self._inflight:
+                return None
+            return max(now - (r.started_at if r.started_at is not None
+                              else r.queued_at)
+                       for r in self._inflight.values())
+
     def healthz(self) -> Dict[str, Any]:
+        oldest = self._oldest_inflight_s()
         with self._lock:
             tenants = {t: len(q) for t, q in self._queues.items() if q}
             depth = self._depth
@@ -1107,6 +1320,8 @@ class CheckDaemon:
             "uptime-s": round(time.time() - self._started, 3),
             "queue-depth": depth, "queue-max": self.config.queue_max,
             "inflight": inflight, "workers": len(self._threads),
+            "oldest-inflight-s": (round(oldest, 3)
+                                  if oldest is not None else None),
             "tenants": tenants, "tenant-max": self.config.tenant_max,
             "committed-bytes": committed,
             "budget-bytes": self._budget(),
@@ -1135,6 +1350,7 @@ class CheckDaemon:
         if not force and now - self._progress_last < 0.1:
             return
         self._progress_last = now
+        oldest = self._oldest_inflight_s()
         with self._lock:
             doc = {
                 "state": state or ("draining" if self.draining
@@ -1143,6 +1359,9 @@ class CheckDaemon:
                 "serve": {
                     "queue-depth": self._depth,
                     "inflight": len(self._inflight),
+                    "oldest-inflight-s": (round(oldest, 3)
+                                          if oldest is not None
+                                          else None),
                     "admitted": self.stats["admitted"],
                     "rejected": self.stats["rejected"],
                     "completed": self.stats["completed"],
@@ -1213,6 +1432,11 @@ def make_handler(daemon: CheckDaemon, root: str = "store"):
                 except (ValueError, TypeError) as e:
                     return _json(self, 400, {"error": "bad-request",
                                              "detail": str(e)})
+                # inbound W3C trace context: the header wins over a
+                # body field only when the body carries none
+                tp = self.headers.get("traceparent")
+                if tp and not doc.get("traceparent"):
+                    doc["traceparent"] = tp
                 code, body, hdrs = self.daemon.submit(doc)
                 return _json(self, code, body, hdrs)
             if path == "/drain":
@@ -1239,12 +1463,33 @@ def make_handler(daemon: CheckDaemon, root: str = "store"):
             serve = (result.get("serve") or {}
                      if isinstance(result, dict) else {})
             code = 500 if (serve.get("gang") or {}).get("poison") else 200
-            return _json(self, code, doc)
+            hdrs = ({"traceparent": obs_trace.format_traceparent(
+                        doc["trace"])} if doc.get("trace") else None)
+            return _json(self, code, doc, hdrs)
+        if path.startswith("/trace/request/"):
+            # must intercept BEFORE web.Handler's /trace/<run> route,
+            # which would misparse the request id as a run directory
+            token = path[len("/trace/request/"):].strip("/")
+            return _trace_request(self, token)
         return web.Handler.do_GET(self)
+
+    def _trace_request(self, token: str):
+        from jepsen_tpu.obs import fleet as obs_fleet
+        tid = self.daemon.resolve_trace(token)
+        if not tid:
+            return self._page(
+                "404", f"<p>No trace id for <code>"
+                       f"{web.html.escape(token)}</code> (unknown "
+                       f"request id, or JTPU_TRACE=0).</p>", code=404)
+        stitched = obs_fleet.stitch_request(self.daemon.config.root,
+                                            tid)
+        self._page(f"trace request {token}",
+                   web.request_trace_html(stitched))
 
     ServeHandler.do_POST = do_POST
     ServeHandler._authorized = _authorized
     ServeHandler.do_GET = do_GET
+    ServeHandler._trace_request = _trace_request
     return ServeHandler
 
 

@@ -542,16 +542,23 @@ def _waterfall_html(records, stats, cap: int = 2000) -> str:
     positioned by percentage offsets on the run's timeline, grouped by
     thread, durations inline. Links the raw artifact for Perfetto-level
     digging (`jtpu trace export --format chrome`)."""
-    spans = [r for r in records if r.get("dur", 0) > 0]
+    # instants (dur 0 — verdict markers, gang joins, faults) draw as
+    # tick marks on the same timeline, except the tracer's own
+    # trace.sync clock anchors, which are plumbing, not a phase
+    spans = [r for r in records if r.get("dur", 0) > 0
+             or (r.get("name") != "trace.sync" and "ts" in r)]
     if not spans:
         return (f"<p>No spans ({stats['torn']} torn, "
                 f"{stats['corrupt']} corrupt line(s)).</p>")
     t0 = min(r["ts"] for r in spans)
-    t1 = max(r["ts"] + r["dur"] for r in spans)
+    t1 = max(r["ts"] + r.get("dur", 0) for r in spans)
     total = max(t1 - t0, 1)
+    # stitched cross-process records carry a "host" attribute: group
+    # per (host, thread) so two processes' colliding tids stay apart
     by_tid = {}
     for r in spans:
-        by_tid.setdefault(r.get("tid", 0), []).append(r)
+        by_tid.setdefault((str(r.get("host", "")), r.get("tid", 0)),
+                          []).append(r)
     names = sorted({str(r["name"]) for r in spans})
     color = {n: _TRACE_COLORS[i % len(_TRACE_COLORS)]
              for i, n in enumerate(names)}
@@ -561,19 +568,24 @@ def _waterfall_html(records, stats, cap: int = 2000) -> str:
              f"</code> &rarr; ui.perfetto.dev</p>",
              "<div style='font-size:11px'>"]
     shown = 0
-    for tid in sorted(by_tid):
-        rows = sorted(by_tid[tid], key=lambda r: r["ts"])
-        parts.append(f"<h3>thread {tid}</h3>")
+    for host, tid in sorted(by_tid):
+        rows = sorted(by_tid[(host, tid)], key=lambda r: r["ts"])
+        head = (f"{html.escape(host)} thread {tid}" if host
+                else f"thread {tid}")
+        parts.append(f"<h3>{head}</h3>")
         for r in rows:
             if shown >= cap:
                 break
             shown += 1
             left = 100.0 * (r["ts"] - t0) / total
-            width = max(100.0 * r["dur"] / total, 0.1)
-            label = html.escape(f"{r['name']} ({_fmt_ns(r['dur'])})")
+            dur = r.get("dur", 0)
+            width = max(100.0 * dur / total, 0.1)
+            label = html.escape(
+                f"{r['name']} ({_fmt_ns(dur)})" if dur
+                else f"{r['name']} @{_fmt_ns(r['ts'] - t0)}")
             attrs = {k: v for k, v in r.items()
                      if k not in ("name", "ts", "dur", "tid", "sid",
-                                  "pid")}
+                                  "pid", "host")}
             tip = html.escape(json.dumps(attrs, default=repr)) \
                 if attrs else ""
             parts.append(
@@ -589,6 +601,26 @@ def _waterfall_html(records, stats, cap: int = 2000) -> str:
         parts.append(f"<p>{len(spans) - shown} span(s) elided "
                      f"(cap {cap}).</p>")
     return "".join(parts)
+
+
+def request_trace_html(stitched: dict, cap: int = 2000) -> str:
+    """One stitched request trace (:func:`jepsen_tpu.obs.fleet.
+    stitch_request`) -> the single-request waterfall the serve daemon's
+    ``/trace/request/<id>`` page shows: every process's spans for one
+    trace id on one aligned timeline."""
+    records = stitched.get("records") or []
+    stats = {"spans": len(records), "torn": 0, "corrupt": 0}
+    hosts = stitched.get("hosts") or []
+    method = stitched.get("method")
+    tid = str(stitched.get("trace-id", ""))
+    head = (f"<p>trace <code>{html.escape(tid)}</code>: "
+            f"{len(records)} record(s) across "
+            f"{max(len(hosts), 1)} process(es)"
+            + (f"; clocks aligned via <code>{html.escape(method)}"
+               f"</code>" if method else "")
+            + f". CLI: <code>jtpu trace request {html.escape(tid)}"
+              f"</code></p>")
+    return head + _waterfall_html(records, stats, cap=cap)
 
 
 def serve(host: str = "127.0.0.1", port: int = 8080,

@@ -588,3 +588,45 @@ class TestProcHostWorker:
         hb = fleet.read_heartbeat(str(tmp_path / "w0"))
         assert hb and hb.get("pid")
         assert h.state == "dead"     # stopped at run end
+
+    def test_two_worker_spans_join_one_request_trace(self, tmp_path,
+                                                     monkeypatch):
+        """Request tracing across the req_N.npz seam: with an ambient
+        trace context set, BOTH worker subprocesses' segment spans
+        carry the request's trace id, and the stitcher renders them on
+        one aligned timeline next to the leader's spans."""
+        from jepsen_tpu.obs import fleet as obs_fleet
+        from jepsen_tpu.obs import trace as obs_trace
+        monkeypatch.delenv("JTPU_TRACE", raising=False)
+        p, kernel = _packed(seed=3, n=120)
+        tid = obs_trace.new_trace_id()
+        tr = obs_trace.tracer()
+        tr.attach(str(tmp_path / "trace.jsonl"))
+        obs_trace.sync_event()
+        try:
+            with tr.context(tid):
+                with tr.span("serve.request", id="r-fleet"):
+                    hosts = [
+                        fleet.ProcHost("w0", str(tmp_path / "w0")),
+                        fleet.ProcHost("w1", str(tmp_path / "w1"))]
+                    out = check_packed_fleet(p, kernel, hosts=hosts,
+                                             segment_iters=16)
+        finally:
+            tr.detach()
+        assert out["valid"] == check_packed(p, kernel)["valid"]
+        for w in ("w0", "w1"):
+            recs, stats = obs_trace.read_trace(
+                str(tmp_path / w / "trace.jsonl"))
+            assert stats["corrupt"] == 0
+            segs = [r for r in recs
+                    if r["name"] == "checker.segment"
+                    and r.get("trace") == tid]
+            assert segs, f"worker {w} emitted no traced segments"
+            assert all(r.get("host") == w for r in segs)
+            assert any(r["name"] == "trace.sync" for r in recs)
+        stitched = obs_fleet.stitch_request(str(tmp_path), tid)
+        assert stitched["method"] == "wall-clock"
+        seen_hosts = {r.get("host") for r in stitched["records"]}
+        assert {"w0", "w1"} <= seen_hosts
+        names = {r["name"] for r in stitched["records"]}
+        assert "serve.request" in names      # the leader's span too

@@ -121,14 +121,16 @@ class TestTraceArtifact:
                 pass
         tr.detach()
         recs, stats = obs_trace.read_trace(p)
-        assert stats == {"spans": 2, "torn": 0, "corrupt": 0}
+        assert stats == {"spans": 2, "torn": 0, "corrupt": 0,
+                         "traces": 0}
         assert [r["name"] for r in recs] == ["b", "a"]
         # a SIGKILL mid-write leaves a torn, unterminated tail: dropped
         # silently, earlier records intact
         with open(p, "ab") as f:
             f.write(b'{"name": "torn", "ts": 12')
         recs, stats = obs_trace.read_trace(p)
-        assert stats == {"spans": 2, "torn": 1, "corrupt": 0}
+        assert stats == {"spans": 2, "torn": 1, "corrupt": 0,
+                         "traces": 0}
         # a corrupt MIDDLE line (terminated) counts as corruption
         with open(p, "ab") as f:
             f.write(b'3, "dur": 0}garbage\n')
@@ -907,3 +909,286 @@ class TestTraceInJitLint:
                                           root=REPO)
             assert not [f for f in findings
                         if f.rule == "JAX-TRACE-IN-JIT"], rel
+
+
+# ---------------------------------------------------------------------------
+# Request-scoped distributed tracing (doc/observability.md, "Request
+# tracing"): W3C traceparent plumbing, the thread-local trace-context
+# slot, and the cross-process stitcher
+# ---------------------------------------------------------------------------
+
+
+class TestTraceContext:
+    def test_traceparent_roundtrip(self):
+        tid = obs_trace.new_trace_id()
+        assert len(tid) == 32 and int(tid, 16) >= 0
+        hdr = obs_trace.format_traceparent(tid, "00f067aa0ba902b7")
+        assert hdr == f"00-{tid}-00f067aa0ba902b7-01"
+        assert obs_trace.parse_traceparent(hdr) == \
+            (tid, "00f067aa0ba902b7")
+
+    def test_format_traceparent_renders_integer_sids(self):
+        hdr = obs_trace.format_traceparent("ab" * 16, 7)
+        assert hdr == f"00-{'ab' * 16}-{7:016x}-01"
+        # with no span id yet (echoing at admission) a random non-zero
+        # one is minted — the spec forbids all-zero span ids
+        minted = obs_trace.format_traceparent("ab" * 16)
+        _, sid = obs_trace.parse_traceparent(minted)
+        assert int(sid, 16) != 0
+
+    def test_parse_traceparent_rejects_malformed(self):
+        bad = (None, 7, "", "garbage", "00-short-beef-01",
+               "00-" + "g" * 32 + "-" + "1" * 16 + "-01",   # non-hex
+               "00-" + "0" * 32 + "-" + "1" * 16 + "-01",   # zero tid
+               "00-" + "1" * 32 + "-" + "0" * 16 + "-01")   # zero sid
+        for hdr in bad:
+            assert obs_trace.parse_traceparent(hdr) is None, hdr
+
+    def test_context_stamps_records_and_guard_restores(self):
+        tr = obs_trace.Tracer()
+        tid = obs_trace.new_trace_id()
+        with tr.span("untraced"):
+            pass
+        with tr.context(tid, "00f067aa0ba902b7"):
+            assert tr.current_context() == (tid, "00f067aa0ba902b7")
+            with tr.span("outer"):
+                with tr.span("inner"):
+                    pass
+            # nested guard (a gang member re-run) restores the OUTER
+            # request's id, not None
+            other = obs_trace.new_trace_id()
+            with tr.context(other):
+                tr.event("rerun")
+            assert tr.current_context()[0] == tid
+        assert tr.current_context() == (None, None)
+        recs = {r["name"]: r for r in tr.spans()}
+        assert "trace" not in recs["untraced"]
+        assert recs["outer"]["trace"] == tid
+        assert recs["inner"]["trace"] == tid
+        assert recs["rerun"]["trace"] == other
+        # only the context ROOT carries the inbound parent span id
+        assert recs["outer"]["parent"] == "00f067aa0ba902b7"
+        assert "parent" not in recs["inner"]
+
+    def test_context_is_thread_local(self):
+        tr = obs_trace.Tracer()
+        tid = obs_trace.new_trace_id()
+        seen = {}
+
+        def worker():
+            seen["ctx"] = tr.current_context()
+            with tr.span("other-thread"):
+                pass
+
+        with tr.context(tid):
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+        assert seen["ctx"] == (None, None)
+        recs = {r["name"]: r for r in tr.spans()}
+        assert "trace" not in recs["other-thread"]
+
+    def test_by_trace_groups_and_read_trace_counts(self, tmp_path):
+        p = str(tmp_path / "trace.jsonl")
+        tr = obs_trace.Tracer(path=p)
+        t1, t2 = obs_trace.new_trace_id(), obs_trace.new_trace_id()
+        with tr.context(t1):
+            with tr.span("a"):
+                pass
+        with tr.context(t2):
+            with tr.span("b"):
+                pass
+        with tr.span("background"):
+            pass
+        tr.detach()
+        recs, stats = obs_trace.read_trace(p)
+        assert stats["traces"] == 2
+        groups = obs_trace.by_trace(recs)
+        assert set(groups) == {t1, t2}
+        assert [r["name"] for r in groups[t1]] == ["a"]
+
+    def test_sync_event_carries_wall_anchor(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("JTPU_TRACE", raising=False)
+        p = str(tmp_path / "trace.jsonl")
+        obs_trace.tracer().attach(p)
+        try:
+            obs_trace.sync_event()
+        finally:
+            obs_trace.tracer().detach()
+        recs, _ = obs_trace.read_trace(p)
+        sync = [r for r in recs if r["name"] == "trace.sync"]
+        assert sync and isinstance(sync[0]["wall_ns"], int)
+        assert sync[0]["wall_ns"] > 10 ** 18   # nanoseconds since 1970
+
+
+class TestStitchRequest:
+    def _host(self, d, tid, names, epoch_wall, ts0=1000, step=500):
+        """Write one fake host dir: a trace.sync anchor claiming this
+        tracer's monotonic epoch began at ``epoch_wall`` ns wall time,
+        then spans under ``tid``."""
+        os.makedirs(d, exist_ok=True)
+        recs = [{"name": "trace.sync", "ts": 0, "dur": 0, "tid": 1,
+                 "sid": 1, "wall_ns": epoch_wall}]
+        ts = ts0
+        for i, name in enumerate(names):
+            recs.append({"name": name, "ts": ts, "dur": 100, "tid": 1,
+                         "sid": i + 2, "trace": tid})
+            ts += step
+        with open(os.path.join(d, "trace.jsonl"), "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+
+    def test_two_processes_align_on_wall_clock(self, tmp_path):
+        from jepsen_tpu.obs import fleet as obs_fleet
+        tid = obs_trace.new_trace_id()
+        base = 1_700_000_000_000_000_000
+        self._host(str(tmp_path), tid, ["serve.request"], base)
+        # the worker booted 5000ns later: identical raw ts values must
+        # land AFTER the daemon's on the aligned timeline
+        self._host(str(tmp_path / "w0"), tid, ["checker.segment"],
+                   base + 5000)
+        out = obs_fleet.stitch_request(str(tmp_path), tid)
+        assert out["trace-id"] == tid and out["method"] == "wall-clock"
+        assert len(out["hosts"]) == 2
+        names = [r["name"] for r in out["records"]]
+        assert names == ["serve.request", "checker.segment"]
+        seg = out["records"][1]
+        assert seg["ts"] == 1000 + 5000 and seg["host"] == "w0"
+
+    def test_filters_to_one_trace_and_tolerates_extra_dirs(
+            self, tmp_path):
+        from jepsen_tpu.obs import fleet as obs_fleet
+        tid, noise = obs_trace.new_trace_id(), obs_trace.new_trace_id()
+        base = 1_700_000_000_000_000_000
+        self._host(str(tmp_path / "main"), tid,
+                   ["serve.request"], base)
+        self._host(str(tmp_path / "elsewhere"), noise,
+                   ["other.request"], base)
+        out = obs_fleet.stitch_request(
+            str(tmp_path / "main"), tid,
+            extra_dirs=[str(tmp_path / "elsewhere"),
+                        str(tmp_path / "vanished")])
+        assert [r["name"] for r in out["records"]] == ["serve.request"]
+
+    def test_single_process_needs_no_alignment(self, tmp_path):
+        from jepsen_tpu.obs import fleet as obs_fleet
+        tid = obs_trace.new_trace_id()
+        self._host(str(tmp_path), tid, ["a", "b"],
+                   1_700_000_000_000_000_000)
+        out = obs_fleet.stitch_request(str(tmp_path), tid)
+        assert out["method"] is None
+        assert [r["name"] for r in out["records"]] == ["a", "b"]
+
+    def test_to_chrome_renders_one_process_per_host(self, tmp_path):
+        from jepsen_tpu.obs import fleet as obs_fleet
+        tid = obs_trace.new_trace_id()
+        base = 1_700_000_000_000_000_000
+        self._host(str(tmp_path), tid, ["serve.request"], base)
+        self._host(str(tmp_path / "w0"), tid, ["checker.segment"],
+                   base)
+        out = obs_fleet.stitch_request(str(tmp_path), tid)
+        doc = obs_fleet.to_chrome({"hosts": out["hosts"],
+                                   "trace": out["records"]})
+        metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+        assert len(metas) == 2
+        spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert {e["pid"] for e in spans} == {1, 2}
+
+    def test_request_trace_html_renders_waterfall(self, tmp_path):
+        from jepsen_tpu import web
+        from jepsen_tpu.obs import fleet as obs_fleet
+        tid = obs_trace.new_trace_id()
+        base = 1_700_000_000_000_000_000
+        self._host(str(tmp_path), tid, ["serve.request"], base)
+        self._host(str(tmp_path / "w0"), tid, ["checker.segment"],
+                   base + 1000)
+        out = obs_fleet.stitch_request(str(tmp_path), tid)
+        html_text = web.request_trace_html(out)
+        assert tid in html_text
+        assert "serve.request" in html_text
+        assert "checker.segment" in html_text
+        assert "w0" in html_text
+
+
+class TestTraceSummaryIntegrity:
+    def test_summary_surfaces_torn_corrupt_and_json(self, tmp_path,
+                                                    capsys):
+        from jepsen_tpu import cli
+        d = tmp_path / "run"
+        d.mkdir()
+        tr = obs_trace.Tracer(path=str(d / "trace.jsonl"))
+        with tr.context(obs_trace.new_trace_id()):
+            with tr.span("checker.segment", phase="execute"):
+                pass
+        tr.detach()
+        with open(d / "trace.jsonl", "ab") as f:
+            f.write(b'{"name": "mid", "ts": 1}garbage\n')  # corrupt
+            f.write(b'{"name": "torn", "ts": 12')          # torn tail
+        rc = cli.run(cli.default_commands(),
+                     ["trace", "summary", "--store", str(d)])
+        assert rc == cli.OK
+        out = capsys.readouterr().out
+        assert "# trace: integrity: 1 torn, 1 corrupt line(s); " \
+               "1 request trace id(s)" in out
+        rc = cli.run(cli.default_commands(),
+                     ["trace", "summary", "--store", str(d),
+                      "--format", "json"])
+        assert rc == cli.OK
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["stats"]["torn"] == 1
+        assert doc["stats"]["corrupt"] == 1
+        assert doc["stats"]["traces"] == 1
+
+
+class TestTraceRequestCLI:
+    def _serve_store(self, tmp_path):
+        """A dead serve store: serve.wal maps a request id to its
+        trace id, trace.jsonl holds the spans."""
+        from jepsen_tpu import serve as serve_ns
+        d = tmp_path / "serve"
+        d.mkdir()
+        tid = obs_trace.new_trace_id()
+        j = serve_ns.RequestJournal(str(d / "serve.wal"))
+        j.append({"event": "accepted", "id": "r-000001", "trace": tid})
+        j.append({"event": "done", "id": "r-000001"})
+        j.close()
+        tr = obs_trace.Tracer(path=str(d / "trace.jsonl"))
+        with tr.context(tid):
+            with tr.span("serve.request", id="r-000001"):
+                with tr.span("checker.segment", phase="execute"):
+                    pass
+            tr.event("serve.verdict", id="r-000001")
+        tr.detach()
+        return str(d), tid
+
+    def test_request_id_resolves_through_wal(self, tmp_path, capsys):
+        from jepsen_tpu import cli
+        d, tid = self._serve_store(tmp_path)
+        rc = cli.run(cli.default_commands(),
+                     ["trace", "request", "r-000001", "--store", d])
+        assert rc == cli.OK
+        out = capsys.readouterr().out
+        assert tid in out
+        for name in ("serve.request", "checker.segment",
+                     "serve.verdict"):
+            assert name in out
+
+    def test_literal_trace_id_and_json_format(self, tmp_path, capsys):
+        from jepsen_tpu import cli
+        d, tid = self._serve_store(tmp_path)
+        rc = cli.run(cli.default_commands(),
+                     ["trace", "request", tid, "--store", d,
+                      "--format", "json"])
+        assert rc == cli.OK
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["trace-id"] == tid
+        assert [r["name"] for r in doc["records"]] == \
+            ["serve.request", "checker.segment", "serve.verdict"]
+
+    def test_unresolvable_id_fails_cleanly(self, tmp_path, capsys):
+        from jepsen_tpu import cli
+        d, _ = self._serve_store(tmp_path)
+        rc = cli.run(cli.default_commands(),
+                     ["trace", "request", "r-nope", "--store", d])
+        assert rc == cli.INVALID_ARGS
+        assert "couldn't resolve" in capsys.readouterr().err

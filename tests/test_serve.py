@@ -1145,3 +1145,373 @@ class TestAuth:
         monkeypatch.setenv("JTPU_SERVE_TOKEN", "from-env")
         cfg = serve_ns.ServeConfig(root=str(tmp_path / "serve"))
         assert cfg.auth_token == "from-env"
+
+
+# ---------------------------------------------------------------------------
+# Request-scoped distributed tracing (doc/observability.md, "Request
+# tracing"): one trace id from POST /check to verdict
+# ---------------------------------------------------------------------------
+
+
+class TestRequestTrace:
+    def test_inbound_traceparent_honored_and_echoed(self, tmp_path):
+        from jepsen_tpu.obs import trace as obs_trace
+        tid = obs_trace.new_trace_id()
+        d = _daemon(tmp_path, start=True)
+        code, body, hdrs = d.submit(
+            {"model": "cas-register", "history": _ops(),
+             "traceparent": f"00-{tid}-00f067aa0ba902b7-01"})
+        assert code == 202
+        assert body["trace"] == tid
+        got = obs_trace.parse_traceparent(hdrs.get("traceparent"))
+        assert got is not None and got[0] == tid
+        doc = _wait_done(d, body["id"])
+        assert doc["trace"] == tid
+        assert doc["result"]["serve"]["trace"] == tid
+        d.stop()
+
+    def test_minted_when_absent_or_malformed(self, tmp_path):
+        d = _daemon(tmp_path, start=True)
+        _, b1, _ = d.submit({"model": "cas-register",
+                             "history": _ops()})
+        _, b2, _ = d.submit({"model": "cas-register",
+                             "history": _ops(3),
+                             "traceparent": "garbage-header"})
+        assert len(b1["trace"]) == 32 and int(b1["trace"], 16) >= 0
+        assert len(b2["trace"]) == 32
+        assert b1["trace"] != b2["trace"]   # one id PER request
+        for b in (b1, b2):
+            _wait_done(d, b["id"])
+        d.stop()
+
+    def test_phase_breakdown_sums_to_wall_time(self, tmp_path):
+        d = _daemon(tmp_path, start=True)
+        code, body, _ = d.submit({"model": "cas-register",
+                                  "history": _ops()})
+        assert code == 202
+        doc = _wait_done(d, body["id"])
+        serve_doc = doc["result"]["serve"]
+        ph = serve_doc["phases"]
+        assert set(ph) == {"queue_s", "coalesce_s", "compile_s",
+                           "device_s", "verdict_s"}
+        assert all(v >= 0 for v in ph.values())
+        # the three check-side phases partition the measured wall time
+        check_side = ph["compile_s"] + ph["device_s"] + ph["verdict_s"]
+        assert abs(check_side - serve_doc["seconds"]) < 0.05
+        assert ph["device_s"] > 0           # the check ran on device
+        d.stop()
+
+    def test_trace_artifact_spans_admission_to_verdict(self, tmp_path):
+        from jepsen_tpu.obs import trace as obs_trace
+        d = _daemon(tmp_path, start=True)
+        code, body, _ = d.submit({"model": "cas-register",
+                                  "history": _ops()})
+        assert code == 202
+        tid = body["trace"]
+        _wait_done(d, body["id"])
+        d.stop()                            # flushes + detaches sink
+        path = os.path.join(d.config.root, "trace.jsonl")
+        recs, stats = obs_trace.read_trace(path)
+        assert stats["torn"] == 0 and stats["corrupt"] == 0
+        assert any(r["name"] == "trace.sync" for r in recs)
+        mine = obs_trace.by_trace(recs).get(tid, [])
+        names = {r["name"] for r in mine}
+        assert {"serve.request", "serve.verdict"} <= names
+        # the device segment joined the same trace (a previously-run
+        # suite may have warmed the engine's bucket already, in which
+        # case engine.warm legitimately never runs — the fresh-process
+        # CI gate asserts the full ≥4-phase waterfall)
+        assert names & {"checker.segment", "engine.warm"}
+        req_spans = [r for r in mine if r["name"] == "serve.request"]
+        assert req_spans and req_spans[0]["id"] == body["id"]
+
+    def test_replay_keeps_original_trace_id(self, tmp_path):
+        from jepsen_tpu import journal as journal_ns
+        from jepsen_tpu.obs import trace as obs_trace
+        tid = obs_trace.new_trace_id()
+        d1 = _daemon(tmp_path)
+        code, body, _ = d1.submit(
+            {"model": "cas-register", "history": _ops(),
+             "traceparent": f"00-{tid}-00f067aa0ba902b7-01"})
+        assert code == 202 and body["trace"] == tid
+        d1.journal.close()                  # SIGKILL before any work
+        d2 = _daemon(tmp_path, start=True)
+        assert d2.replay_stats["requeued"] == 1
+        with d2._lock:
+            rid = next(iter(d2._by_id))
+        doc = _wait_done(d2, rid)
+        assert doc["trace"] == tid          # NOT a fresh mint
+        assert doc["result"]["serve"]["trace"] == tid
+        d2.stop()
+        records, _ = journal_ns.read_json_records(d2.journal.path)
+        accepted = [r for r in records if r.get("event") == "accepted"]
+        assert accepted and all(r["trace"] == tid for r in accepted)
+
+    def test_gang_members_traced_and_verdicts_bit_identical(
+            self, tmp_path):
+        """Tracing ON must not perturb gang verdicts: every member's
+        verdict matches the offline serial path bit-for-bit, each
+        member keeps its OWN trace id, and non-leaders link to the
+        leader's trace via serve.gang.join."""
+        from jepsen_tpu.obs import trace as obs_trace
+        histories = [_ops(3), _ops(4, value=9), _ops(5, value=20)]
+        d1 = _daemon(tmp_path)
+        for ops in histories:
+            code, _, _ = d1.submit({"model": "cas-register",
+                                    "history": ops})
+            assert code == 202
+        d1.journal.close()
+        d2 = _daemon(tmp_path, start=True, workers=1,
+                     batch_wait_ms=200.0)
+        with d2._lock:
+            rids = list(d2._by_id)
+        docs = [_wait_done(d2, rid) for rid in rids]
+        assert d2.stats["batches"] >= 1
+        d2.stop()
+        tids = [doc["trace"] for doc in docs]
+        assert len(set(tids)) == len(tids)  # one id per member
+        for doc in docs:
+            assert doc["result"]["serve"]["gang"]["size"] >= 2
+            assert doc["result"]["serve"]["trace"] == doc["trace"]
+            assert "phases" in doc["result"]["serve"]
+        served = sorted(repr(doc["result"]["valid"]) for doc in docs)
+        offline = sorted(repr(_offline(o)["valid"]) for o in histories)
+        assert served == offline
+        recs, _ = obs_trace.read_trace(
+            os.path.join(d2.config.root, "trace.jsonl"))
+        joins = [r for r in recs if r["name"] == "serve.gang.join"]
+        assert joins                         # non-leaders linked
+        leader_tid = joins[0]["leader"]
+        assert leader_tid in tids
+        assert all(j["trace"] != leader_tid for j in joins)
+        gang_spans = [r for r in recs if r["name"] == "serve.gang"]
+        assert gang_spans and \
+            gang_spans[0]["trace"] == leader_tid
+
+    def test_http_roundtrip_carries_traceparent(self, tmp_path):
+        from jepsen_tpu.obs import trace as obs_trace
+        tid = obs_trace.new_trace_id()
+        cfg = serve_ns.ServeConfig(root=str(tmp_path / "serve"),
+                                   backend="tpu")
+        daemon, server = serve_ns.run_daemon(
+            cfg, host="127.0.0.1", port=0,
+            store_root=str(tmp_path / "store"))
+        port = server.server_port
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/check",
+                data=json.dumps({"model": "cas-register",
+                                 "history": _ops()}).encode(),
+                method="POST",
+                headers={"traceparent":
+                         f"00-{tid}-00f067aa0ba902b7-01"})
+            with urllib.request.urlopen(req) as r:
+                assert r.status == 202
+                body = json.load(r)
+                assert body["trace"] == tid
+                echoed = r.headers.get("traceparent")
+            assert obs_trace.parse_traceparent(echoed)[0] == tid
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/check/"
+                        f"{body['id']}") as r:
+                    doc = json.load(r)
+                    hdr = r.headers.get("traceparent")
+                if doc["state"] == "done":
+                    break
+                time.sleep(0.05)
+            assert doc["state"] == "done"
+            assert doc["result"]["serve"]["phases"]["device_s"] > 0
+            assert obs_trace.parse_traceparent(hdr)[0] == tid
+            # the stitched waterfall renders over HTTP too
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/trace/request/"
+                    f"{body['id']}") as r:
+                page = r.read().decode()
+            assert tid in page and "serve.request" in page
+        finally:
+            server.shutdown()
+            daemon.stop()
+
+
+class TestTraceKillSwitch:
+    def test_off_leaves_no_trace_anywhere(self, tmp_path, monkeypatch):
+        """JTPU_TRACE=0 is the identity: no trace ids minted, no
+        traceparent echoed, no trace keys in the WAL, no trace.jsonl,
+        no phases in results — byte-compatible with the pre-tracing
+        daemon."""
+        from jepsen_tpu import journal as journal_ns
+        monkeypatch.setenv("JTPU_TRACE", "0")
+        d = _daemon(tmp_path, start=True)
+        code, body, hdrs = d.submit(
+            {"model": "cas-register", "history": _ops(),
+             "traceparent": "00-" + "ab" * 16
+                            + "-00f067aa0ba902b7-01"})
+        assert code == 202
+        assert "trace" not in body
+        assert "traceparent" not in hdrs
+        doc = _wait_done(d, body["id"])
+        assert "trace" not in doc
+        assert "trace" not in doc["result"]["serve"]
+        assert "phases" not in doc["result"]["serve"]
+        d.stop()
+        assert not os.path.exists(
+            os.path.join(d.config.root, "trace.jsonl"))
+        records, _ = journal_ns.read_json_records(d.journal.path)
+        assert all("trace" not in r for r in records)
+
+    def test_verdicts_identical_on_and_off(self, tmp_path,
+                                           monkeypatch):
+        ops = _ops(3)
+        monkeypatch.setenv("JTPU_TRACE", "0")
+        d_off = _daemon(tmp_path / "off", start=True)
+        _, b_off, _ = d_off.submit({"model": "cas-register",
+                                    "history": ops})
+        r_off = _wait_done(d_off, b_off["id"])["result"]
+        d_off.stop()
+        monkeypatch.setenv("JTPU_TRACE", "1")
+        d_on = _daemon(tmp_path / "on", start=True)
+        _, b_on, _ = d_on.submit({"model": "cas-register",
+                                  "history": ops})
+        r_on = _wait_done(d_on, b_on["id"])["result"]
+        d_on.stop()
+        for key in ("valid", "levels", "rung", "work"):
+            assert r_off.get(key) == r_on.get(key)
+
+
+class TestTenantLatencyLabels:
+    def test_queue_wait_labeled_per_tenant_with_exemplars(
+            self, tmp_path):
+        """Satellite: the queue-wait histogram is labeled per tenant
+        (fairness is observable per tenant, not just in aggregate) and
+        traced requests leave OpenMetrics exemplars pointing at their
+        trace ids."""
+        before = {
+            t: serve_ns._QUEUE_WAIT.snapshot().get(
+                f'{{tenant="{t}"}}', {"count": 0})["count"]
+            for t in ("tenA", "tenB")}
+        d = _daemon(tmp_path, start=True)
+        rids, tids = [], {}
+        for i in range(4):
+            tenant = "tenA" if i % 2 == 0 else "tenB"
+            code, body, _ = d.submit({"model": "cas-register",
+                                      "tenant": tenant,
+                                      "history": _ops(2 + i)})
+            assert code == 202
+            rids.append(body["id"])
+            tids[body["id"]] = body["trace"]
+        for rid in rids:
+            _wait_done(d, rid)
+        d.stop()
+        snap = serve_ns._QUEUE_WAIT.snapshot()
+        # fairness: BOTH tenants' waits were observed, two each —
+        # neither tenant's latency hides in the other's series
+        for t in ("tenA", "tenB"):
+            series = snap.get(f'{{tenant="{t}"}}')
+            assert series is not None, snap.keys()
+            assert series["count"] - before[t] == 2
+        lines = serve_ns._QUEUE_WAIT.expose()
+        ex_lines = [ln for ln in lines if " # {trace_id=" in ln]
+        assert ex_lines, "no exemplar on any queue-wait bucket"
+        assert any(tid in ln for ln in ex_lines
+                   for tid in tids.values())
+
+    def test_coalesce_wait_labeled_per_tenant(self, tmp_path):
+        d1 = _daemon(tmp_path)
+        for v in (1, 5):
+            d1.submit({"model": "cas-register", "tenant": "gangT",
+                       "history": _ops(3, value=v)})
+        d1.journal.close()
+        d2 = _daemon(tmp_path, start=True, workers=1,
+                     batch_wait_ms=200.0)
+        with d2._lock:
+            rids = list(d2._by_id)
+        for rid in rids:
+            _wait_done(d2, rid)
+        assert d2.stats["batches"] >= 1
+        d2.stop()
+        snap = serve_ns._COALESCE_WAIT.snapshot()
+        series = snap.get('{tenant="gangT"}')
+        assert series is not None and series["count"] >= 1
+
+
+class TestOldestInflight:
+    def test_healthz_and_watch_line_surface_age(self, tmp_path):
+        from jepsen_tpu.obs import observatory
+        d = _daemon(tmp_path)
+        assert d.healthz()["oldest-inflight-s"] is None
+        req = serve_ns.CheckRequest(id="r-stuck", tenant="t",
+                                    model="cas-register", history=[])
+        req.started_at = time.monotonic() - 12.5
+        with d._lock:
+            d._inflight[req.id] = req
+        age = d.healthz()["oldest-inflight-s"]
+        assert age is not None and 12.0 < age < 14.0
+        d._publish(force=True)
+        p = observatory.read_progress(d.config.root)
+        line = observatory.format_status(p)
+        assert "oldest-inflight 12." in line
+        with d._lock:
+            del d._inflight[req.id]
+        d._publish(force=True)
+        p = observatory.read_progress(d.config.root)
+        assert "oldest-inflight" not in observatory.format_status(p)
+
+    def test_age_counts_from_dequeue_not_submit(self, tmp_path):
+        d = _daemon(tmp_path)
+        req = serve_ns.CheckRequest(id="r-q", tenant="t",
+                                    model="cas-register", history=[])
+        req.queued_at = time.monotonic() - 100.0   # long queue wait
+        req.started_at = time.monotonic() - 2.0    # just dequeued
+        with d._lock:
+            d._inflight[req.id] = req
+        age = d.healthz()["oldest-inflight-s"]
+        assert age is not None and age < 5.0
+
+
+class TestTracerAttachRace:
+    def test_attach_detach_races_serve_workers(self, tmp_path):
+        """Satellite: re-pointing the tracer sink while serve workers
+        stream spans must neither raise nor tear lines — every record
+        lands whole in whichever file held the sink."""
+        from jepsen_tpu.obs import trace as obs_trace
+        d = _daemon(tmp_path, start=True, workers=2)
+        paths = [str(tmp_path / f"alt{i}.jsonl") for i in range(2)]
+        stop = threading.Event()
+        errors = []
+
+        def flipper():
+            i = 0
+            try:
+                while not stop.is_set():
+                    obs_trace.tracer().attach(paths[i % 2])
+                    i += 1
+                    time.sleep(0.001)
+            except Exception as e:          # pragma: no cover
+                errors.append(e)
+
+        t = threading.Thread(target=flipper)
+        t.start()
+        try:
+            rids = []
+            for i in range(6):
+                code, body, _ = d.submit({"model": "cas-register",
+                                          "history": _ops(2 + i % 3)})
+                assert code == 202
+                rids.append(body["id"])
+            for rid in rids:
+                _wait_done(d, rid)
+        finally:
+            stop.set()
+            t.join()
+            obs_trace.tracer().detach()
+            d.stop()
+        assert not errors
+        total = 0
+        for p in paths:
+            if os.path.exists(p):
+                recs, stats = obs_trace.read_trace(p)
+                assert stats["torn"] == 0 and stats["corrupt"] == 0
+                total += stats["spans"]
+        assert total > 0                    # the races did overlap

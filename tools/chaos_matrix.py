@@ -54,6 +54,13 @@ qualify a new accelerator image before trusting it with long runs):
                    daemon replays its request journal (serve.wal),
                    re-checks both, and both verdicts are identical to
                    the offline analyze path
+  trace-request-kill  SIGKILL the daemon mid-check on a request
+                   admitted with an inbound W3C traceparent: the
+                   restarted daemon's journal replay keeps the
+                   ORIGINAL trace id (not a fresh mint), the re-run
+                   joins the same trace in trace.jsonl, and the
+                   single-request stitched waterfall (`jtpu trace
+                   request <id>`) still renders end to end
   serve-batch-poison  a 4-request same-bucket burst with ONE poison
                    member OOMing every gang that contains it: the gang
                    scheduler bisects to isolate it — 3 survivors
@@ -986,6 +993,157 @@ def scenario_serve_kill(seed):
     return ok, "; ".join(details)
 
 
+def scenario_trace_request_kill(seed):
+    """SIGKILL the daemon mid-check on a TRACED request (admitted with
+    an inbound traceparent). The restarted daemon's serve.wal replay
+    must keep the ORIGINAL trace id, the re-run's spans must join the
+    same trace, and the stitched single-request waterfall must still
+    render — the request tracing layer's crash-safety proof
+    (doc/observability.md, "Request tracing")."""
+    import tempfile
+    import urllib.request
+
+    from jepsen_tpu import serve as serve_ns
+    from jepsen_tpu import web
+    from jepsen_tpu.obs import fleet as obs_fleet
+    from jepsen_tpu.obs import trace as trace_ns
+    from jepsen_tpu.testing import simulate_register_history
+
+    root = tempfile.mkdtemp(prefix="jepsen-chaos-tracereq-")
+    serve_dir = os.path.join(root, "serve")
+    port_file = os.path.join(root, "port.json")
+    h1 = simulate_register_history(300, n_procs=5, n_vals=4, seed=seed)
+    ops1 = [o.to_dict() for o in h1]
+    trace_id = trace_ns.new_trace_id()
+
+    child_src = (
+        "import json, sys\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "from jepsen_tpu import serve as S\n"
+        f"cfg = S.ServeConfig(root={serve_dir!r}, backend='tpu', "
+        "workers=1)\n"
+        f"d, srv = S.run_daemon(cfg, host='127.0.0.1', port=0, "
+        f"store_root={root!r})\n"
+        f"json.dump({{'port': srv.server_port}}, "
+        f"open({port_file!r}, 'w'))\n"
+        "d.drained.wait()\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", JTPU_TRACE="1")
+    proc = subprocess.Popen([sys.executable, "-c", child_src], env=env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+
+    def post(port, doc, traceparent=None):
+        hdrs = {"traceparent": traceparent} if traceparent else {}
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/check",
+            data=json.dumps(doc).encode(), method="POST",
+            headers=hdrs)
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.load(r)
+
+    def get_state(port, rid):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/check/{rid}",
+                timeout=10) as r:
+            return json.load(r)["state"]
+
+    try:
+        deadline = time.time() + 60
+        port = None
+        while time.time() < deadline:
+            if os.path.exists(port_file):
+                try:
+                    with open(port_file) as f:
+                        port = json.load(f)["port"]
+                    break
+                except (OSError, ValueError):
+                    pass
+            if proc.poll() is not None:
+                return False, (f"daemon exited rc={proc.returncode} "
+                               f"at boot")
+            time.sleep(0.1)
+        if port is None:
+            return False, "daemon never published its port"
+        body = post(port, {"tenant": "traced", "model": "cas-register",
+                           "history": ops1},
+                    traceparent=trace_ns.format_traceparent(trace_id))
+        if body.get("trace") != trace_id:
+            return False, (f"admission answered trace "
+                           f"{body.get('trace')!r}, want the inbound "
+                           f"{trace_id}")
+        rid = body["id"]
+        # kill in the exact window: the request is mid-check
+        while time.time() < deadline:
+            if get_state(port, rid) == "running":
+                break
+            time.sleep(0.02)
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    # restart (in-process incarnation on the same journal)
+    d2 = serve_ns.CheckDaemon(
+        serve_ns.ServeConfig(root=serve_dir, backend="tpu", workers=1))
+    d2.start()
+    if d2.replay_stats.get("requeued") != 1:
+        d2.stop()
+        return False, (f"replay requeued "
+                       f"{d2.replay_stats.get('requeued')}, want 1")
+    with d2._lock:
+        rid2 = next(iter(d2._by_id))
+    deadline = time.time() + 120
+    doc = None
+    while time.time() < deadline:
+        doc = d2.status(rid2)
+        if doc and doc["state"] == "done":
+            break
+        time.sleep(0.05)
+    resolved = d2.resolve_trace(rid2)
+    d2.drain(timeout_s=10)
+    d2.stop()
+    if not doc or doc.get("state") != "done":
+        return False, f"replayed request never finished: {doc}"
+    details = []
+    if doc.get("trace") != trace_id:
+        return False, (f"replayed request re-minted trace "
+                       f"{doc.get('trace')!r}, want the original "
+                       f"{trace_id}")
+    details.append("replayed request kept its original trace id")
+    if resolved != trace_id:
+        return False, (f"resolve_trace({rid2}) -> {resolved!r}, want "
+                       f"{trace_id}")
+    phases = doc["result"].get("serve", {}).get("phases", {})
+    if "device_s" not in phases:
+        return False, f"re-run verdict lost its phase breakdown: {doc}"
+    details.append("re-run verdict carries a phase breakdown")
+    # the stitched waterfall: both incarnations' spans, one trace
+    stitched = obs_fleet.stitch_request(serve_dir, trace_id)
+    names = {r["name"] for r in stitched["records"]}
+    if not {"serve.request", "serve.verdict"} <= names:
+        return False, (f"stitched waterfall incomplete after SIGKILL: "
+                       f"{sorted(names)}")
+    # spans are written at EXIT, so the killed incarnation's open
+    # serve.request span is legitimately absent — but its sync anchor
+    # (written at attach) proves it shared the file, and the re-run's
+    # complete waterfall lives under the ORIGINAL trace id
+    raw, _ = trace_ns.read_trace(
+        os.path.join(serve_dir, trace_ns.TRACE_NAME))
+    anchors = [r for r in raw if r["name"] == "trace.sync"]
+    if len(anchors) < 2:
+        return False, (f"{len(anchors)} trace.sync anchor(s) in "
+                       f"trace.jsonl, want one per incarnation")
+    details.append(f"stitched waterfall renders "
+                   f"{len(stitched['records'])} span(s); both "
+                   f"incarnations anchored the shared trace.jsonl")
+    page = web.request_trace_html(stitched)
+    if trace_id not in page or "serve.verdict" not in page:
+        return False, "web waterfall page failed to render the trace"
+    details.append("web waterfall renders")
+    return True, "; ".join(details)
+
+
 def scenario_serve_batch_poison(seed):
     """A 4-request same-bucket burst against a REAL daemon (HTTP, warm
     engine, gang scheduler on) with ONE poison member: the injected
@@ -1151,6 +1309,7 @@ SCENARIOS = (
     ("plan-rejects", scenario_plan_rejects),
     ("fleet-host-kill", scenario_fleet_host_kill),
     ("serve-kill", scenario_serve_kill),
+    ("trace-request-kill", scenario_trace_request_kill),
     ("serve-batch-poison", scenario_serve_batch_poison),
 )
 

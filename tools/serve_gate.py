@@ -19,6 +19,12 @@ served request. This gate IS that request:
   dispatch at least one batch of size >= 2 (healthz ``stats.batches``
   / ``stats.max-batch``), proving concurrent batching survives CI;
 * ``/healthz`` must report the completed request and a warm bucket;
+* request tracing must span the whole path: the 202 carries a trace id
+  (echoed as a ``traceparent`` header), the done verdict carries a
+  phase breakdown, the daemon's trace.jsonl holds >= 4 distinct span
+  names under that ONE trace id (admission -> warm/compile -> device
+  segment -> verdict), and at least one /metrics histogram bucket
+  carries an OpenMetrics exemplar pointing at a trace id;
 * ``POST /drain`` must finish in-flight work and release the daemon
   (exit-0 contract).
 
@@ -48,15 +54,21 @@ def _post(port, path, doc):
         method="POST")
     try:
         with urllib.request.urlopen(req, timeout=10) as r:
-            return r.status, json.load(r)
+            return r.status, json.load(r), dict(r.headers)
     except urllib.error.HTTPError as e:
-        return e.code, json.load(e)
+        return e.code, json.load(e), dict(e.headers)
 
 
 def _get(port, path):
     with urllib.request.urlopen(
             f"http://127.0.0.1:{port}{path}", timeout=10) as r:
         return r.status, json.load(r)
+
+
+def _get_text(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return r.status, r.read().decode()
 
 
 def main() -> int:
@@ -93,13 +105,22 @@ def main() -> int:
     problems = []
     verdict = None
     try:
-        code, body = _post(port, "/check",
-                           {"tenant": "gate", "model": "cas-register",
-                            "history": history})
+        code, body, hdrs = _post(
+            port, "/check", {"tenant": "gate",
+                             "model": "cas-register",
+                             "history": history})
         if code != 202:
             problems.append(f"POST /check answered {code}: {body}")
         else:
             rid = body["id"]
+            trace_id = body.get("trace")
+            if not trace_id:
+                problems.append("202 body carries no trace id")
+            echoed = (hdrs.get("traceparent") or "")
+            if trace_id and trace_id not in echoed:
+                problems.append(
+                    f"traceparent header {echoed!r} does not echo "
+                    f"trace {trace_id}")
             deadline = time.time() + args.budget
             doc = {}
             while time.time() < deadline:
@@ -127,16 +148,42 @@ def main() -> int:
                     problems.append(
                         f"served verdict {verdict!r} != offline "
                         f"{offline.get('valid')!r}")
+                # the tracing leg: one trace id spans POST -> verdict
+                serve_doc = doc["result"].get("serve", {})
+                phases = serve_doc.get("phases", {})
+                want = {"queue_s", "coalesce_s", "compile_s",
+                        "device_s", "verdict_s"}
+                if not want <= set(phases):
+                    problems.append(
+                        f"phase breakdown incomplete: {phases}")
+                if serve_doc.get("trace") != trace_id:
+                    problems.append(
+                        f"verdict carries trace "
+                        f"{serve_doc.get('trace')!r}, admission "
+                        f"promised {trace_id!r}")
+                from jepsen_tpu.obs import trace as trace_ns
+                trecs, _ = trace_ns.read_trace(
+                    os.path.join(cfg.root, trace_ns.TRACE_NAME))
+                names = {r["name"] for r in trecs
+                         if r.get("trace") == trace_id}
+                if len(names) < 4:
+                    problems.append(
+                        f"trace {trace_id} spans only {sorted(names)}"
+                        f", want >= 4 phases POST -> verdict")
+                if not {"serve.request", "serve.verdict"} <= names:
+                    problems.append(
+                        f"trace {trace_id} missing admission/verdict "
+                        f"spans: {sorted(names)}")
         # 3. the gang scheduler: a same-bucket burst must coalesce into
         # at least one batched dispatch of size >= 2 (doc/serve.md,
         # "Concurrent batching") — the first request warmed the bucket,
         # so the burst exercises the batched device path end to end
         burst = []
         for i in range(3):
-            code, body = _post(port, "/check",
-                               {"tenant": f"burst-{i % 2}",
-                                "model": "cas-register",
-                                "history": history})
+            code, body, _ = _post(port, "/check",
+                                  {"tenant": f"burst-{i % 2}",
+                                   "model": "cas-register",
+                                   "history": history})
             if code == 202:
                 burst.append(body["id"])
             else:
@@ -162,7 +209,13 @@ def main() -> int:
                             f"{stats}")
         if not health.get("engine", {}).get("warm-buckets"):
             problems.append("healthz reports no warm bucket")
-        code, drained = _post(port, "/drain", None)
+        if "oldest-inflight-s" not in health:
+            problems.append("healthz lost the oldest-inflight-s field")
+        _, metrics_text = _get_text(port, "/metrics")
+        if ' # {trace_id="' not in metrics_text:
+            problems.append("no OpenMetrics exemplar on any /metrics "
+                            "histogram bucket")
+        code, drained, _ = _post(port, "/drain", None)
         if code != 200 or not drained.get("drained"):
             problems.append(f"drain answered {code}: {drained}")
         if not daemon.drained.wait(timeout=5):
