@@ -244,12 +244,19 @@ def child_main(platform: str) -> int:
 
 
 def _search_axes(results):
-    """Rebalance axes for the bench record: total remesh/steal counts
-    and the peak shard-imbalance ratio across the measured checks
-    (fleet results carry a ``fleet`` entry, sharded results a
-    ``shard-balance`` entry; plain runs gate at 0/0/1.0)."""
+    """Rebalance + search-analytics axes for the bench record: total
+    remesh/steal counts and the peak shard-imbalance ratio across the
+    measured checks (fleet results carry a ``fleet`` entry, sharded
+    results a ``shard-balance`` entry; plain runs gate at 0/0/1.0),
+    plus the counter-lane rollup where a result carries one
+    (``searchstats``: dup-rate / frontier-area / prune-efficiency,
+    doc/observability.md "Search analytics") — a pruning regression is
+    attributed the way the compile/execute phases are. JTPU_TRACE=0
+    runs carry no rollup and gate at 0.0/0/0.0."""
     remesh = steal = 0
     imb = 1.0
+    dup = prune = 0.0
+    area = 0
     for r in results:
         if not isinstance(r, dict):
             continue
@@ -261,8 +268,14 @@ def _search_axes(results):
                          "imbalance-ratio")):
             if isinstance(cand, (int, float)):
                 imb = max(imb, float(cand))
+        ss = r.get("searchstats") or {}
+        dup = max(dup, float(ss.get("dup-rate") or 0.0))
+        prune = max(prune, float(ss.get("prune-efficiency") or 0.0))
+        area += int(ss.get("frontier-area") or 0)
     return {"remesh_count": remesh, "steal_count": steal,
-            "imbalance_ratio": round(imb, 3)}
+            "imbalance_ratio": round(imb, 3),
+            "dup_rate": round(dup, 4), "frontier_area": area,
+            "prune_efficiency": round(prune, 4)}
 
 
 def _search_line(label, result, wall_s):
@@ -289,6 +302,10 @@ def _search_line(label, result, wall_s):
         if result.get("transfer-bytes"):
             line += (f", {result['transfer-bytes'] / 1e6:.1f} MB "
                      f"transferred")
+        ss = result.get("searchstats")
+        if ss:
+            line += (f", dup-rate={ss.get('dup-rate', 0.0):.0%}"
+                     f", trunc-losses={ss.get('trunc-losses', 0)}")
         bal = result.get("shard-balance")
         if bal:
             line += (f", shard-imbalance={bal['imbalance-ratio']}x "

@@ -156,9 +156,14 @@ class Engine:
 
     def jit_single(self, kernel_id: int, capacity: int, window: int,
                    expand: Optional[int] = None, unroll: int = 1,
-                   shard_axis: Optional[str] = None):
+                   shard_axis: Optional[str] = None,
+                   stats: bool = False):
         """The monolithic single-history executable (one while_loop to
-        a verdict) — body identical to the pre-Engine ``_jit_single``."""
+        a verdict) — body identical to the pre-Engine ``_jit_single``.
+        ``stats=True`` compiles the per-level counter lane
+        (T.SEARCHSTAT_COLS) and returns it as a 9th output; the flag is
+        part of the cache key so counters-off callers keep the original
+        executable."""
         import jax
         kernel = T._KERNELS_BY_ID[kernel_id]
 
@@ -167,21 +172,25 @@ class Engine:
                        cinv, cps, nr, ini):
                 search = T._search_fn(kernel.step, f.shape[0],
                                       cf.shape[0], capacity, window,
-                                      expand, unroll, shard_axis)
+                                      expand, unroll, shard_axis,
+                                      stats=stats)
                 return search(f, v1, v2, ro, fr, inv, ret, sm, cf, cv1,
                               cv2, cinv, cps, nr, ini)
 
             return jax.jit(single)
 
         return self._get(("single", kernel_id, capacity, window, expand,
-                          unroll, shard_axis), build)
+                          unroll, shard_axis, stats), build)
 
     def jit_segment(self, kernel_id: int, capacity: int, window: int,
                     expand: Optional[int] = None, unroll: int = 1,
-                    shard_axis: Optional[str] = None):
+                    shard_axis: Optional[str] = None,
+                    stats: bool = False):
         """One bounded-iteration checkpointed segment (the supervised
         mode's executable; traced seg_iters, so changing segment length
-        never recompiles) — body identical to ``_jit_segment``."""
+        never recompiles) — body identical to ``_jit_segment``.
+        ``stats=True`` carries the per-level counter lane as a 14th
+        carry element (extracted host-side at segment barriers)."""
         import jax
         kernel = T._KERNELS_BY_ID[kernel_id]
 
@@ -191,14 +200,14 @@ class Engine:
                 search = T._search_fn(kernel.step, f.shape[0],
                                       cf.shape[0], capacity, window,
                                       expand, unroll, shard_axis,
-                                      segment=True)
+                                      segment=True, stats=stats)
                 return search(f, v1, v2, ro, fr, inv, ret, sm, cf, cv1,
                               cv2, cinv, cps, nr, ini, seg_iters, carry)
 
             return jax.jit(seg)
 
         return self._get(("segment", kernel_id, capacity, window,
-                          expand, unroll, shard_axis), build)
+                          expand, unroll, shard_axis, stats), build)
 
     def jit_batch(self, kernel_id: int, capacity: int, window: int,
                   expand: Optional[int] = None, unroll: int = 1,
@@ -379,20 +388,31 @@ class Engine:
                    else T._segment_config(None))
             kid = T._kernel_key(kernel)
             unroll = T._unroll_factor()
+            # warm the executable real calls will select: with tracing
+            # on they carry the per-level stats lane (part of the cache
+            # key), with it off the original stats-less shape
+            stats = obs_trace.enabled()
+            lmax = T._level_budget(cols["f"].shape[0],
+                                   cols["cf"].shape[0])
             for cap, win, exp in ladder:
                 if seg:
-                    fn = self.jit_segment(kid, cap, win, exp, unroll)
-                    carry = T._carry0_host(cap, win, cols["cf"].shape[0],
-                                           cols["ini"], 0)
+                    fn = self.jit_segment(kid, cap, win, exp, unroll,
+                                          stats=stats)
+                    carry = T._carry0_host(
+                        cap, win, cols["cf"].shape[0], cols["ini"], 0,
+                        stats_rows=(lmax + 1) if stats else 0)
                     args = ([cols[c] for c in T._COLS]
                             + [np.int32(seg), carry])
                     shape_key = ("segment", kid, cap, win, exp, unroll,
-                                 cols["f"].shape[0], cols["cf"].shape[0])
+                                 cols["f"].shape[0], cols["cf"].shape[0],
+                                 stats)
                 else:
-                    fn = self.jit_single(kid, cap, win, exp, unroll)
+                    fn = self.jit_single(kid, cap, win, exp, unroll,
+                                         stats=stats)
                     args = [cols[c] for c in T._COLS]
                     shape_key = ("single", kid, cap, win, exp, unroll,
-                                 cols["f"].shape[0], cols["cf"].shape[0])
+                                 cols["f"].shape[0], cols["cf"].shape[0],
+                                 stats)
                 try:
                     # AOT compile: feeds the persistent cache; cheap to
                     # follow with the trivial execution, which fills the

@@ -161,10 +161,23 @@ def _shr_by_mw(m, t, MW: int):
     return (a >> bs) | hi
 
 
+#: Per-level search-analytics counter columns (doc/observability.md,
+#: "Search analytics"). One int32 row per search level when a factory is
+#: built with ``stats=True``:
+#:   expanded   live pool rows expanded at this level
+#:   dup        successor rows killed as adjacent duplicates
+#:   dominated  successor rows killed by subset dominance
+#:   trunc      unique rows lost to pool truncation (the lossy signal)
+#:   frontier   live pool rows surviving into the next level
+SEARCHSTAT_COLS = ("expanded", "dup", "dominated", "trunc", "frontier")
+NSTAT = len(SEARCHSTAT_COLS)
+
+
 def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
                expand: Optional[int] = None, unroll: int = 1,
                shard_axis: Optional[str] = None,
-               tiebreak: str = "lex", segment: bool = False):
+               tiebreak: str = "lex", segment: bool = False,
+               stats: bool = False):
     """Build the single-key search. ``n`` is the (static, padded) length of
     the *required* section — ops with finite return, sorted by return index.
     ``n_cr`` is the (static, padded) width of the *crashed* section — 'info'
@@ -214,6 +227,14 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
       tested against its group's first few rows (the likeliest
       dominators) — a bounded, fixed-shape approximation that only ever
       prunes genuinely dominated rows.
+
+    ``stats=True`` appends one extra carry lane: a ``[LMAX+1, NSTAT]``
+    int32 per-level counter log (:data:`SEARCHSTAT_COLS`) written with
+    pure ``.at[].set`` indexing inside the traced body — zero host sync;
+    the host extracts it at segment barriers (segment mode returns the
+    raw carry) or from the appended final output (monolithic mode
+    returns it as a 9th element). ``stats=False`` compiles the original
+    13-lane carry, byte-identical to the pre-analytics executable.
     """
     C, W, CR = capacity, window, n_cr
     E = min(expand or C, C)
@@ -338,13 +359,18 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
                   n_required == 0, jnp.bool_(False), jnp.bool_(False),
                   jnp.int32(0), jnp.int32(0),
                   k0, state0, alive0)
+        if stats:
+            # per-level counter log, level-indexed (NOT pool-row-indexed:
+            # it never shrinks with the pool and is left unsharded —
+            # [LMAX+1, NSTAT] int32 is a few KB at worst)
+            carry0 = carry0 + (jnp.zeros((LMAX + 1, NSTAT), jnp.int32),)
 
         def active(c):
             return (~c[5]) & jnp.any(c[4]) & (c[8] <= LMAX)
 
         def body(c):
             (k, mask, cmask, state, alive, done, lossy, wovf, level,
-             best, _pk, _ps, _pa) = c
+             best, _pk, _ps, _pa) = c[:13]
 
             # -- select the top-E pool rows for expansion (the pool is
             # sorted deepest-first; invalid rows sank in the merge sort) --
@@ -612,6 +638,17 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
                     cm3 = _sc(cm3)
             new = (k3, m3, cm3, s3, a3, done2, lossy2, wovf2,
                    level + 1, best2, k, state, alive)
+            if stats:
+                # pure in-kernel counter write: one [NSTAT] int32 row at
+                # the level just expanded — no host sync, no shape change
+                row = jnp.clip(level, 0, LMAX)
+                counts = jnp.stack([
+                    jnp.sum(a_e, dtype=jnp.int32),
+                    jnp.sum(dup, dtype=jnp.int32),
+                    jnp.sum(dominated, dtype=jnp.int32),
+                    jnp.sum(uniq[C:], dtype=jnp.int32),
+                    jnp.sum(a3, dtype=jnp.int32)])
+                new = new + (c[13].at[row].set(counts),)
             # Masked update: lanes finished under vmap must not mutate.
             act = active(c)
             return tuple(jnp.where(act, nw, old) for nw, old in zip(new, c))
@@ -654,6 +691,8 @@ def _search_fn(step, n: int, n_cr: int, capacity: int, window: int,
         # Stopped at the iteration budget with work left: incomplete, so a
         # non-done outcome must not read as a refutation.
         lossy = lossy | (~done & jnp.any(alive_out))
+        if stats:
+            return done, lossy, wovf, best, level, pk, ps, pa, out[13]
         return done, lossy, wovf, best, level, pk, ps, pa
 
     return search
@@ -931,14 +970,14 @@ def _engine():
 
 def _jit_single(kernel_id: int, capacity: int, window: int,
                 expand: Optional[int] = None, unroll: int = 1,
-                shard_axis: Optional[str] = None):
+                shard_axis: Optional[str] = None, stats: bool = False):
     return _engine().jit_single(kernel_id, capacity, window, expand,
-                                unroll, shard_axis)
+                                unroll, shard_axis, stats)
 
 
 def _jit_segment(kernel_id: int, capacity: int, window: int,
                  expand: Optional[int] = None, unroll: int = 1,
-                 shard_axis: Optional[str] = None):
+                 shard_axis: Optional[str] = None, stats: bool = False):
     """One bounded-iteration device segment of the single-history search
     (the checkpointed mode jepsen_tpu.resilience drives): takes the packed
     columns, a traced per-call iteration bound, and the search carry;
@@ -950,7 +989,7 @@ def _jit_segment(kernel_id: int, capacity: int, window: int,
     merge-sort barrier, so the host carry snapshot between segments IS a
     consistent cross-host checkpoint)."""
     return _engine().jit_segment(kernel_id, capacity, window, expand,
-                                 unroll, shard_axis)
+                                 unroll, shard_axis, stats)
 
 
 def _popcount32_host(a: np.ndarray) -> np.ndarray:
@@ -997,12 +1036,14 @@ def _pool_sort_host(k, mask, cmask, state, alive) -> np.ndarray:
 
 
 def _carry0_host(capacity: int, window: int, n_cr: int, init_state,
-                 n_required: int) -> tuple:
+                 n_required: int, stats_rows: int = 0) -> tuple:
     """Host-side initial search carry, mirroring _search_fn's carry0
     layout exactly (k, mask, cmask, state, alive, done, lossy, wovf,
     level, best_k, pool_k, pool_state, pool_alive). Built on host so the
     segment supervisor owns the carry end to end — it IS the checkpoint
-    format (doc/resilience.md)."""
+    format (doc/resilience.md). ``stats_rows > 0`` appends the 14th
+    per-level counter lane ([stats_rows, NSTAT] int32 — must equal the
+    factory's LMAX+1) for stats-enabled segment executables."""
     MW = (window + 31) // 32
     MC = max((n_cr + 31) // 32, 1)
     k0 = np.zeros(capacity, np.int32)
@@ -1010,10 +1051,13 @@ def _carry0_host(capacity: int, window: int, n_cr: int, init_state,
     cmask0 = np.zeros((capacity, MC), np.uint32)
     state0 = np.full(capacity, int(np.int32(init_state)), np.int32)
     alive0 = np.arange(capacity) == 0
-    return (k0, mask0, cmask0, state0, alive0,
-            np.bool_(n_required == 0), np.bool_(False), np.bool_(False),
-            np.int32(0), np.int32(0),
-            k0.copy(), state0.copy(), alive0.copy())
+    carry = (k0, mask0, cmask0, state0, alive0,
+             np.bool_(n_required == 0), np.bool_(False), np.bool_(False),
+             np.int32(0), np.int32(0),
+             k0.copy(), state0.copy(), alive0.copy())
+    if stats_rows:
+        carry = carry + (np.zeros((stats_rows, NSTAT), np.int32),)
+    return carry
 
 
 def _carry_active(carry, lmax: int) -> bool:
@@ -1432,16 +1476,29 @@ def check_packed_tpu(p: PackedHistory, kernel: KernelSpec,
 
 def _check_packed_ladder(p, kernel, ladder, cols, plan_entry, work,
                          cost_entries) -> Dict[str, Any]:
+    from jepsen_tpu.obs import searchstats as obs_searchstats
     out: Dict[str, Any] = {}
+    # Search analytics (doc/observability.md): with tracing on, the
+    # single-history executable carries the per-level counter lane and
+    # returns it as a 9th output; JTPU_TRACE=0 keeps the stats-off
+    # executable (separate cache key), so verdicts and artifacts stay
+    # byte-identical to the pre-analytics tree.
+    stats = obs.enabled()
     for cap, win, exp in ladder:
         unroll = _unroll_factor()
-        fn = _jit_single(_kernel_key(kernel), cap, win, exp, unroll)
+        fn = _jit_single(_kernel_key(kernel), cap, win, exp, unroll,
+                         stats=stats)
         shape_key = ("single", _kernel_key(kernel), cap, win, exp,
-                     unroll, cols["f"].shape[0], cols["cf"].shape[0])
+                     unroll, cols["f"].shape[0], cols["cf"].shape[0],
+                     stats)
         outs, _, _ = _timed_call(
             "single", shape_key, fn, [cols[c] for c in _COLS],
             rung=(cap, win, exp))
-        done, lossy, wovf, best, levels, pk, ps, pa = outs
+        if stats:
+            done, lossy, wovf, best, levels, pk, ps, pa, slog = outs
+        else:
+            done, lossy, wovf, best, levels, pk, ps, pa = outs
+            slog = None
         _LEVELS_TOTAL.inc(int(levels))
         out = _result(bool(done), bool(lossy), bool(wovf), int(best),
                       int(levels), p, pool=(pk, ps, pa))
@@ -1465,6 +1522,12 @@ def _check_packed_ladder(p, kernel, ladder, cols, plan_entry, work,
                     levels=int(levels), **cost))
         if cost_entries:
             out["cost"] = [dict(e) for e in cost_entries]
+        if slog is not None:
+            # roll the counter log up into the result (and, when a run
+            # directory is attached, searchstats.json + the live bits)
+            lv = np.asarray(slog)[:int(levels)]
+            obs_searchstats.record(lv, rung=(cap, win, exp))
+            out["searchstats"] = obs_searchstats.rollup(lv)
         if out["valid"] is not UNKNOWN:
             return out
         if bool(wovf) and win >= MAX_WINDOW and not bool(lossy):

@@ -420,6 +420,36 @@ def _add_analysis_opts(p: argparse.ArgumentParser) -> None:
                         "re-check into <run>/profile/ (JTPU_PROF=1)")
 
 
+def _search_analytics_line(out) -> Optional[str]:
+    """The ``# search:`` analytics line for analyze/recover output:
+    dup-rate / prune-efficiency / frontier-area / truncation-loss from
+    the counter lane the device search rolls up into the result's
+    ``searchstats`` entry (doc/observability.md "Search analytics").
+    None when the check ran without stats (JTPU_TRACE=0, or a backend
+    that doesn't carry the lane)."""
+    ss = (out or {}).get("searchstats")
+    if not isinstance(ss, dict):
+        return None
+    return ("# search: dup-rate {dr:.0%}, prune-efficiency {pe:.0%}, "
+            "frontier area {fa} (peak {fp}), truncation-losses {tr} "
+            "over {lv} level(s)").format(
+                dr=ss.get("dup-rate", 0.0),
+                pe=ss.get("prune-efficiency", 0.0),
+                fa=ss.get("frontier-area", 0),
+                fp=ss.get("frontier-peak", 0),
+                tr=ss.get("trunc-losses", 0),
+                lv=ss.get("levels", 0))
+
+
+def _print_contention_forecast(history) -> None:
+    """The ``# contention:`` decomposability forecast lines
+    (jepsen_tpu.analysis.contention) analyze/recover/plan print under
+    the ``# plan:`` summary. Never raises."""
+    from jepsen_tpu.analysis import contention
+    for ln in contention.forecast_lines(contention.profile(history)):
+        print(ln)
+
+
 def analyze_cmd() -> dict:
     """The 'analyze' subcommand: offline re-check of a saved run — load
     a store directory's history and re-run the linearizable checker on
@@ -473,6 +503,9 @@ def analyze_cmd() -> dict:
         from jepsen_tpu.checker import plan as plan_mod
         print(plan_mod.summary_line(test.get("history") or [],
                                     models[opts["model"]]()))
+        # Contention forecast (doc/perf.md): is this history
+        # key-decomposable, and what speedup would decomposing buy?
+        _print_contention_forecast(test.get("history") or [])
         checker = linearizable(models[opts["model"]](),
                                backend=opts["backend"],
                                algorithm=opts["algorithm"])
@@ -496,6 +529,9 @@ def analyze_cmd() -> dict:
         # wall-clock attribution: cold-compile / execute / transfer
         # (doc/observability.md "Compile accounting")
         print(tpu_ns.compile_line(tpu_ns.compile_delta(comp0), wall))
+        sline = _search_analytics_line(out)
+        if sline:
+            print(sline)
         print(_json.dumps(out, indent=2, default=repr))
         return OK if out.get("valid") is True else TEST_FAILED
 
@@ -605,6 +641,7 @@ def recover_cmd() -> dict:
             from jepsen_tpu.checker import plan as plan_mod
             print(plan_mod.summary_line(rec["history"],
                                         models[opts["model"]]()))
+            _print_contention_forecast(rec["history"])
             errs = hl.errors(findings)
             if errs:
                 for f in errs[:10]:
@@ -628,6 +665,9 @@ def recover_cmd() -> dict:
             out = repl.recheck(test, checker)
             print(tpu_ns.compile_line(tpu_ns.compile_delta(comp0),
                                       _time.perf_counter() - t0))
+            sline = _search_analytics_line(out)
+            if sline:
+                print(sline)
             store.write_results(d, out)
             store.write_state(d, "done", recovered=True, recovery=s)
             print(f"# recovery: {d}: verdict valid={out.get('valid')}")
@@ -636,6 +676,57 @@ def recover_cmd() -> dict:
         return worst
 
     return {"recover": {"parser": build_parser, "run": run_}}
+
+
+def explain_cmd() -> dict:
+    """The 'explain' subcommand: why did a stored run get its verdict?
+    Renders jepsen_tpu.explain's report — search-shape summary with a
+    frontier sparkline for valid runs, the violating level / blocking
+    ops / minimal witness region for invalid ones, and the cause chain
+    (lossy truncation, window overflow, plan rejection, device faults —
+    each citing its trail event) for unknowns. Torn-tolerant: a
+    SIGKILLed run's partial artifacts degrade the report, they never
+    crash it."""
+
+    def build_parser():
+        p = Parser(prog="explain",
+                   description="Explain a stored run's verdict from "
+                               "its artifacts (results, searchstats, "
+                               "attempts trail).")
+        p.add_argument("--store", default=None,
+                       help="run directory (default: latest under "
+                            "--store-root)")
+        p.add_argument("--store-root", default="store")
+        p.add_argument("--model", default="cas-register",
+                       choices=list(MODEL_CHOICES),
+                       help="model for the counterexample re-pack "
+                            "(invalid verdicts only)")
+        p.add_argument("--format", default="text",
+                       choices=["text", "json"])
+        return p
+
+    def run_(opts) -> int:
+        import json as _json
+        import os as _os
+
+        from jepsen_tpu import explain as explain_mod
+        from jepsen_tpu import store
+        d = opts.get("store")
+        if d is None:
+            t = store.latest(opts.get("store_root") or "store")
+            d = t.get("store-dir") if t else None
+        if not d or not _os.path.isdir(d):
+            print(f"no such store directory: {d}", file=sys.stderr)
+            return INVALID_ARGS
+        model = _model_registry()[opts["model"]]()
+        report = explain_mod.explain_report(d, model=model)
+        if opts["format"] == "json":
+            print(_json.dumps(report, indent=2, default=repr))
+        else:
+            print(explain_mod.render_text(report))
+        return OK if report.get("valid") is True else TEST_FAILED
+
+    return {"explain": {"parser": build_parser, "run": run_}}
 
 
 def watch_cmd() -> dict:
@@ -1146,6 +1237,7 @@ def plan_cmd() -> dict:
         from jepsen_tpu.models.core import kernel_spec_for
         model = _model_registry()[opts["model"]]()
         kernel = kernel_spec_for(model)
+        hist = None
         if opts.get("history"):
             import os as _os
             if not _os.path.exists(opts["history"]):
@@ -1155,6 +1247,7 @@ def plan_cmd() -> dict:
             from jepsen_tpu.history import History
             with open(opts["history"], encoding="utf-8") as f:
                 h = History.from_jsonl(f.read())
+            hist = h
             dims = plan_mod.PlanDims.from_history(h, model)
             if dims is None:
                 print(f"model {opts['model']} has no integer kernel; "
@@ -1218,6 +1311,11 @@ def plan_cmd() -> dict:
                   f"window<={d['window-needed']} keys={d['keys']}, "
                   f"limit "
                   f"{'unchecked' if lim is None else f'{lim} B'}")
+            if hist is not None:
+                # --history plans also get the contention forecast:
+                # whether decomposing (ROADMAP item 2) beats raising
+                # the rung that this plan is about to select
+                _print_contention_forecast(hist)
             for i in report["issues"]:
                 if not i.get("label"):   # dims-level, not per-candidate
                     print(f"# plan: {i['severity'].upper()} "
@@ -1289,11 +1387,11 @@ def main(subcommands: Dict[str, dict],
 
 def default_commands() -> dict:
     """The stock subcommand set: runner + analyzer + recovery + linter
-    + plan verifier + trace tooling + live watch + server (what
-    ``python -m jepsen_tpu`` dispatches)."""
+    + plan verifier + trace tooling + live watch + server + verdict
+    explainer (what ``python -m jepsen_tpu`` dispatches)."""
     return merge_commands(suite_run_cmd(), analyze_cmd(), recover_cmd(),
                           lint_cmd(), plan_cmd(), trace_cmd(),
-                          watch_cmd(), serve_cmd())
+                          watch_cmd(), serve_cmd(), explain_cmd())
 
 
 if __name__ == "__main__":  # default main

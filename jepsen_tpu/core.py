@@ -482,6 +482,9 @@ def run(test: dict) -> dict:
             # directory — what `watch` and /live/<test> read.
             obs.start_run(test["store-dir"])
             obs.observatory.attach(test["store-dir"])
+            # per-level search analytics mirror to searchstats.json in
+            # the same directory — what `jtpu explain` reads
+            obs.searchstats.attach(test["store-dir"])
         except ImportError:
             store = None
 

@@ -54,6 +54,7 @@ from jepsen_tpu.obs import metrics  # noqa: F401
 from jepsen_tpu.obs import devices  # noqa: F401
 from jepsen_tpu.obs import observatory  # noqa: F401
 from jepsen_tpu.obs import profiler  # noqa: F401
+from jepsen_tpu.obs import searchstats  # noqa: F401
 from jepsen_tpu.obs import fleet  # noqa: F401
 from jepsen_tpu.obs import trace as _trace
 

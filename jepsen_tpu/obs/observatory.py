@@ -133,7 +133,9 @@ class Observatory:
                 headroom: Optional[float] = None,
                 warmup: bool = False,
                 imbalance: Optional[float] = None,
-                fleet: Optional[Dict[str, Any]] = None) -> None:
+                fleet: Optional[Dict[str, Any]] = None,
+                dup_rate: Optional[float] = None,
+                trunc: Optional[int] = None) -> None:
         """One segment boundary's worth of progress. ``expansions`` is
         the candidate configurations explored this segment (levels x
         expanded rows) — the configs-explored/s numerator. ``warmup``
@@ -146,7 +148,12 @@ class Observatory:
         ``# search:`` line; ``fleet`` is the elastic-fleet heartbeat
         ({hosts, remeshes, steals} — jepsen_tpu.fleet piggybacks its
         per-round state on this publication, which is exactly what the
-        fleet supervisor's host-loss detection reads back)."""
+        fleet supervisor's host-loss detection reads back).
+        ``dup_rate``/``trunc`` are this segment's search-analytics bits
+        (jepsen_tpu.obs.searchstats): the duplicate-kill fraction of
+        the sorted candidate rows and the unique rows lost to pool
+        truncation — so pruning health and lossiness are visible in the
+        `watch` ticker while the search runs."""
         if warmup:
             inst = einst = None
         else:
@@ -178,6 +185,11 @@ class Observatory:
                 p["headroom"] = round(float(headroom), 4)
             if imbalance is not None:
                 p["imbalance"] = round(float(imbalance), 3)
+            if dup_rate is not None:
+                p["dup-rate"] = round(float(dup_rate), 4)
+            if trunc is not None:
+                p["trunc-losses"] = int(trunc) + int(
+                    p.get("trunc-losses") or 0)
             if fleet is not None:
                 p["fleet"] = dict(fleet)
             p["levels-per-s"] = (round(self._rate, 3)
@@ -356,6 +368,10 @@ def format_status(p: Optional[Dict[str, Any]]) -> str:
         bits.append(f"headroom {100 * p['headroom']:.0f}%")
     if p.get("imbalance") is not None:
         bits.append(f"imbalance {p['imbalance']:.2f}x")
+    if p.get("dup-rate") is not None:
+        bits.append(f"dup-rate {100 * p['dup-rate']:.0f}%")
+    if p.get("trunc-losses"):
+        bits.append(f"trunc {p['trunc-losses']}")
     fl = p.get("fleet")
     if fl:
         fbit = f"fleet {fl.get('hosts')} host(s)"

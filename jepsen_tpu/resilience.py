@@ -58,6 +58,7 @@ from jepsen_tpu.models.core import KernelSpec, Model
 from jepsen_tpu.obs import devices as obs_devices
 from jepsen_tpu.obs import metrics as obs_metrics
 from jepsen_tpu.obs import observatory as obs_observatory
+from jepsen_tpu.obs import searchstats as obs_searchstats
 from jepsen_tpu.ops.encode import PackedHistory, pack_with_init
 
 log = logging.getLogger("jepsen.resilience")
@@ -332,6 +333,13 @@ CARRY_FIELDS = ("k", "mask", "cmask", "state", "alive", "done", "lossy",
                 "wovf", "level", "best", "pool_k", "pool_state",
                 "pool_alive")
 
+#: Optional 14th carry slot: the per-level search-analytics counter log
+#: ([LMAX+1, T.NSTAT] int32, level-indexed — doc/observability.md,
+#: "Search analytics"). Present only on stats-enabled executables;
+#: checkpoints save/load it when present, so pre-analytics checkpoints
+#: keep loading and JTPU_TRACE=0 checkpoints stay byte-identical.
+CARRY_STATS_FIELD = "slog"
+
 
 @dataclass
 class Checkpoint:
@@ -364,8 +372,9 @@ class Checkpoint:
                                 else self.expand_eff),
             crash_width=np.int64(self.crash_width),
             segment=np.int64(self.segment))
+        names = CARRY_FIELDS + (CARRY_STATS_FIELD,)
         arrays = {f"carry_{n}": np.asarray(v)
-                  for n, v in zip(CARRY_FIELDS, self.carry)}
+                  for n, v in zip(names, self.carry)}
         np.savez(path, **meta, **arrays)
 
     @classmethod
@@ -375,6 +384,8 @@ class Checkpoint:
                          for x in z["rung"])
             exp = int(z["expand_eff"])
             carry = tuple(z[f"carry_{n}"] for n in CARRY_FIELDS)
+            if f"carry_{CARRY_STATS_FIELD}" in z.files:
+                carry = carry + (z[f"carry_{CARRY_STATS_FIELD}"],)
             # scalars round-trip as 0-d arrays; normalize the flag/count
             # slots back to numpy scalars so jit sees identical avals
             carry = (carry[:5]
@@ -395,14 +406,29 @@ def _shrink_carry(carry: tuple, new_cap: int) -> tuple:
     off, the search is lossy from here on — a completion is still a
     witness, but pool death no longer refutes."""
     (k, mask, cmask, state, alive, done, lossy, wovf, level, best,
-     pk, ps, pa) = carry
+     pk, ps, pa) = carry[:13]
     dropped = bool(np.any(np.asarray(alive)[new_cap:]))
     lossy = np.bool_(bool(lossy) or dropped)
+    # the stats lane (carry[13], when present) is level-indexed, not
+    # pool-row-indexed — it rides through a pool shrink unchanged
     return ((np.asarray(k)[:new_cap], np.asarray(mask)[:new_cap],
              np.asarray(cmask)[:new_cap], np.asarray(state)[:new_cap],
              np.asarray(alive)[:new_cap], done, lossy, wovf, level, best,
              np.asarray(pk)[:new_cap], np.asarray(ps)[:new_cap],
-             np.asarray(pa)[:new_cap]), dropped)
+             np.asarray(pa)[:new_cap]) + tuple(carry[13:]), dropped)
+
+
+def _fit_carry_stats(carry: tuple, stats: bool, lmax: int) -> tuple:
+    """Match a carry's optional stats lane to the executable about to
+    run it: a resumed checkpoint may predate the analytics lane (or have
+    been saved with tracing toggled the other way). Appending a zero
+    lane under-counts the pre-resume levels — acceptable for telemetry,
+    and the verdict lanes are untouched either way."""
+    if stats and len(carry) == 13:
+        return carry + (np.zeros((lmax + 1, T.NSTAT), np.int32),)
+    if not stats and len(carry) > 13:
+        return carry[:13]
+    return carry
 
 
 # ---------------------------------------------------------------------------
@@ -552,6 +578,12 @@ def _supervised_check_packed(p: PackedHistory, kernel: KernelSpec,
     crw = T._crash_width(p.n - p.n_required) or 0
     cr_pad = cols["cf"].shape[0]
     lmax = T._level_budget(cols["f"].shape[0], cr_pad)
+    # Search analytics (doc/observability.md): with tracing on the
+    # segment executables carry the per-level counter lane, extracted
+    # here at each segment barrier; JTPU_TRACE=0 selects the stats-off
+    # executable and the original 13-slot carry — byte-identical
+    # checkpoints and artifacts.
+    stats = obs.enabled()
     # A prior mid-run wedge in this process routes new work straight to
     # the CPU fallback — the run-time extension of accel's init verdict.
     fallback = accel.cpu_device() if accel.runtime_wedged() else None
@@ -617,7 +649,9 @@ def _supervised_check_packed(p: PackedHistory, kernel: KernelSpec,
                         "rows (predicted %s B)", cap, blim, cap_s, pred)
                     cap_eff, exp_eff = cap_s, exp_s
             carry = T._carry0_host(cap_eff, win, cr_pad, cols["ini"],
-                                   int(cols["nr"]))
+                                   int(cols["nr"]),
+                                   stats_rows=(lmax + 1) if stats else 0)
+        carry = _fit_carry_stats(carry, stats, lmax)
         transients = ooms = 0
         preempted = False
         abort: Optional[str] = None
@@ -656,7 +690,7 @@ def _supervised_check_packed(p: PackedHistory, kernel: KernelSpec,
                     100 * headroom, 100 * hr_min, cap_eff)
             unroll = T._unroll_factor()
             fn = T._jit_segment(T._kernel_key(kernel), cap_eff, win,
-                                exp_eff, unroll)
+                                exp_eff, unroll, stats=stats)
             ctx = {"rung": (cap, win, exp),
                    "effective": (cap_eff, win, exp_eff),
                    "segment": seg_idx, "level": int(carry[8]),
@@ -664,7 +698,7 @@ def _supervised_check_packed(p: PackedHistory, kernel: KernelSpec,
                                else "default")}
             shape_key = ("segment", T._kernel_key(kernel), cap_eff, win,
                          exp_eff, unroll, cols["f"].shape[0],
-                         cols["cf"].shape[0])
+                         cols["cf"].shape[0], stats)
             # phase decided up front, marked executed only on success: a
             # segment that dies mid-compile pays compile again on retry
             phase = ("compile" if shape_key not in T._EXECUTED_SHAPES
@@ -820,6 +854,19 @@ def _supervised_check_packed(p: PackedHistory, kernel: KernelSpec,
                             rung=[cap_eff, win, exp_eff],
                             unroll=unroll, levels=0, **cost)
                     ent["levels"] += lvl1 - lvl0
+                # search analytics: the counter lane rows this segment
+                # advanced through, rolled into searchstats.json and the
+                # live dup-rate/truncation bits (host code BETWEEN
+                # device segments — never inside the traced body)
+                dup_rate = trunc = None
+                if stats and len(carry) > 13:
+                    slog = np.asarray(carry[13])
+                    obs_searchstats.record(slog[:lvl1],
+                                           rung=(cap_eff, win, exp_eff))
+                    seg_rows = slog[lvl0:lvl1]
+                    if seg_rows.size:
+                        dup_rate = obs_searchstats.dup_rate(seg_rows)
+                        trunc = int(seg_rows[:, 3].sum())
                 # live heartbeat: level / frontier / rate / ETA into the
                 # observatory gauges + progress.json (the watch surface)
                 obs_observatory.publish(
@@ -829,7 +876,8 @@ def _supervised_check_packed(p: PackedHistory, kernel: KernelSpec,
                     * min(exp_eff or cap_eff, cap_eff),
                     rung=(cap_eff, win, exp_eff),
                     backend=ctx["backend"], headroom=headroom,
-                    warmup=phase == "compile")
+                    warmup=phase == "compile",
+                    dup_rate=dup_rate, trunc=trunc)
                 if checkpoint_path or on_checkpoint is not None:
                     cp = Checkpoint(carry=carry, rung=(cap, win, exp),
                                     window=win, expand_eff=exp_eff,
@@ -872,6 +920,10 @@ def _supervised_check_packed(p: PackedHistory, kernel: KernelSpec,
         out["segment-levels"] = list(seg_levels)
         out["frontier-hwm"] = frontier_hwm
         out["transfer-bytes"] = transfer_bytes
+        if stats and len(carry) > 13:
+            ss = obs_searchstats.rollup(np.asarray(carry[13])[:levels])
+            out["searchstats"] = ss
+            obs_searchstats.finalize(ss)
         if cost_entries:
             # per-executable XLA cost-model accounting: flops / bytes
             # are per while-iteration, "levels" is what this shape ran

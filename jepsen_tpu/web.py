@@ -178,6 +178,8 @@ class Handler(BaseHTTPRequestHandler):
                                   query=url.query)
             if path.startswith("/trace/"):
                 return self.trace(path[len("/trace/"):])
+            if path.startswith("/explain/"):
+                return self.explain(path[len("/explain/"):])
             if path.startswith("/files/"):
                 return self.files(path[len("/files/"):],
                                   zip_requested=url.query == "zip")
@@ -211,6 +213,8 @@ class Handler(BaseHTTPRequestHandler):
                                            "trace.jsonl")):
                 trace_cell = (f"<a href='/trace/{quote(name)}/"
                               f"{quote(ts)}'>trace</a>")
+            explain_cell = (f"<a href='/explain/{quote(name)}/"
+                            f"{quote(ts)}'>explain</a>")
             rows.append(
                 f"<tr style='background:{color}'>"
                 f"<td class=valid>{html.escape(str(valid))}</td>"
@@ -218,9 +222,10 @@ class Handler(BaseHTTPRequestHandler):
                 f"<td><a href='{link}'>{html.escape(ts)}</a></td>"
                 f"<td>{html.escape(badge)}</td>"
                 f"<td>{trace_cell}</td>"
+                f"<td>{explain_cell}</td>"
                 f"<td><a href='{link[:-1]}?zip'>zip</a></td></tr>")
         body = ("<table><tr><th>valid</th><th>test</th><th>time</th>"
-                "<th>state</th><th>trace</th><th></th></tr>"
+                "<th>state</th><th>trace</th><th>why</th><th></th></tr>"
                 + "".join(rows) + "</table>"
                 if rows else "<p>No tests run yet.</p>")
         body += ("<p><a href='/metrics'>/metrics</a> — Prometheus "
@@ -377,6 +382,37 @@ class Handler(BaseHTTPRequestHandler):
                    + _waterfall_html(records, stats,
                                      cap=self.TRACE_ROW_CAP))
 
+    def explain(self, rel: str):
+        """``/explain/<test>/<ts>`` — the verdict explanation page
+        (jepsen_tpu.explain): search-shape summary + frontier sparkline
+        for valid runs, violating level / blocking ops / witness region
+        for invalid ones, the cited cause chain for unknowns. The
+        report readers are torn-tolerant and this handler catches its
+        own failures — a SIGKILLed run's partial artifacts render a
+        degraded page, never a 500 (the explain-kill chaos scenario
+        holds it to that)."""
+        run_dir = os.path.join(self.root, rel.strip("/"))
+        if not _within(self.root, run_dir):
+            return self._page("403", "<p>Forbidden.</p>", code=403)
+        if not os.path.isdir(run_dir):
+            return self._page("404", "<p>No such run.</p>", code=404)
+        try:
+            from jepsen_tpu import explain as explain_mod
+            report = explain_mod.explain_report(run_dir)
+            text = explain_mod.render_text(report)
+        except Exception as e:  # noqa: BLE001 — degrade, never 500
+            report = {"kind": "unrenderable"}
+            text = f"# explain: report unavailable: {e!r}"
+        badge = {"valid": "#6DB6FF", "invalid": "#FF6D6D",
+                 "unknown": "#FFAA6D"}.get(report.get("kind"), "#ddd")
+        body = (
+            f"<p><span style='background:{badge};padding:2px 8px;"
+            f"border-radius:4px'>{html.escape(str(report.get('kind')))}"
+            f"</span> &mdash; <a href='/files/{quote(rel.strip('/'), safe='/')}"
+            f"/'>artifacts</a></p>"
+            f"<pre>{html.escape(text)}</pre>")
+        self._page(f"explain {rel}", body)
+
     def files(self, rel: str, zip_requested: bool = False):
         """Static file / dir browser / zip download (web.clj:194-271)."""
         target = os.path.join(self.root, rel)
@@ -506,6 +542,10 @@ def _progress_strip_html(rel: str) -> str:
         "' levels/s');\n"
         " if(p.imbalance!=null)bits.push('imbalance '+p.imbalance+"
         "'x');\n"
+        " if(p['dup-rate']!=null)bits.push('dup '+"
+        "Math.round(100*p['dup-rate'])+'%');\n"
+        " if(p['trunc-losses'])bits.push('trunc '+"
+        "p['trunc-losses']);\n"
         " if(p.fleet)bits.push('fleet '+p.fleet.hosts+' host(s)'+"
         "(p.fleet.remeshes?' '+p.fleet.remeshes+' remesh(es)':'')+"
         "(p.fleet.steals?' '+p.fleet.steals+' steal(s)':''));\n"

@@ -63,6 +63,12 @@ def pytest_configure(config):
         "admission control / backpressure / fair dequeue, per-bucket "
         "circuit breakers, per-request deadlines, drain, and the "
         "JTPU_SERVE kill-switch identity")
+    config.addinivalue_line(
+        "markers", "explain: search-analytics + verdict-explain tests "
+        "(tests/test_searchstats.py): the per-level counter lane and "
+        "its JTPU_TRACE=0 byte-identity, searchstats rollups, the "
+        "contention/decomposability profiler, and the jtpu explain "
+        "report for valid/invalid/unknown fixtures")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -72,11 +72,14 @@ def _median(vals):
 #: from the registry deltas around its cold+warm checks, and the
 #: `search` sub-record's rebalance axes — remesh/steal counts and the
 #: peak shard-imbalance ratio — so an elastic-fleet regression is
-#: attributed like the compile/execute phases are).
+#: attributed like the compile/execute phases are, plus the counter-
+#: lane analytics axes — dup-rate and frontier-area — so a pruning
+#: regression names itself the same way).
 ATTRIBUTION_AXES = ("compile_s", "execute_s", "transfer_mb",
                     "compile.cold_compile_s", "compile.warm_execute_s",
                     "search.remesh_count", "search.steal_count",
-                    "search.imbalance_ratio")
+                    "search.imbalance_ratio",
+                    "search.dup_rate", "search.frontier_area")
 
 
 def _get_path(rec, path):

@@ -32,6 +32,11 @@ qualify a new accelerator image before trusting it with long runs):
                    dead, no 500), the `watch` CLI degrades to a
                    graceful status line, and recovery still renders
                    a verdict
+  explain-kill     SIGKILL a localkv run mid-search, then tear its
+                   searchstats.json: `recover` still renders a
+                   verdict, `jtpu explain` still renders a report,
+                   and the web /explain/<test>/<ts> page answers
+                   200 (never a 500) from the partial artifacts
   prof-kill        SIGKILL a --profile (JTPU_PROF=1) localkv run while
                    the device profiler is mid-capture: the partial
                    capture reads tail-tolerantly, `recover` still
@@ -621,6 +626,103 @@ def scenario_watched_kill(seed):
                 f"{doc.get('progress') is not None}; watch rc="
                 f"{watch_rc}; recover rc={rc} "
                 f"status={store.run_status(run_dir)}")
+
+
+def scenario_explain_kill(seed):
+    """SIGKILL a localkv run mid-search; assert the verdict-explain
+    surfaces stay torn-tolerant: a partial (or absent) searchstats.json
+    never breaks them — `recover` turns the WAL back into a verdict,
+    `jtpu explain` renders a report from whatever survived, and the web
+    `/explain/<test>/<ts>` page answers 200, never a 500."""
+    import contextlib
+    import io
+    import json as _json
+    import tempfile
+    import urllib.request
+
+    from jepsen_tpu import cli, store, web
+
+    root = tempfile.mkdtemp(prefix="jepsen-chaos-explain-")
+    run_dir = os.path.join(root, "local-kv", "run")
+    ports_file = os.path.join(root, "ports.json")
+    child_src = (
+        "import json, sys\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "from jepsen_tpu import core\n"
+        "from jepsen_tpu.suites.localkv import localkv_test\n"
+        "test = localkv_test({'time-limit': 60, 'nemesis-period': 3})\n"
+        f"test['store-dir'] = {run_dir!r}\n"
+        f"json.dump(test['localkv-ports'], open({ports_file!r}, 'w'))\n"
+        "core.run(test)\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", JTPU_TRACE="1")
+    proc = subprocess.Popen([sys.executable, "-c", child_src], env=env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    wal = os.path.join(run_dir, "history.wal")
+    deadline = time.time() + 90
+    lines = 0
+    try:
+        while time.time() < deadline:
+            if os.path.exists(wal):
+                with open(wal, "rb") as f:
+                    lines = sum(1 for _ in f)
+                if lines >= 40:
+                    break
+            if proc.poll() is not None:
+                return False, (f"child exited rc={proc.returncode} "
+                               f"before the kill (wal lines={lines})")
+            time.sleep(0.2)
+        else:
+            return False, f"workload never reached 40 WAL ops ({lines})"
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()
+    finally:
+        try:
+            with open(ports_file) as f:
+                _kill_kvnodes(json.load(f))
+        except OSError:
+            pass
+
+    # simulate the worst tear: a half-written searchstats.json (the
+    # kill may have landed mid-os.replace on some filesystems)
+    torn = os.path.join(run_dir, "searchstats.json")
+    with open(torn, "w") as f:
+        f.write('{"ts": 1, "levels": [[3, 1')
+    # recover rebuilds the history and re-checks to a verdict
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli.run(cli.default_commands(),
+                     ["recover", "--store-root", root])
+    if rc != 0 or store.run_status(run_dir) != "recovered":
+        return False, (f"recover rc={rc} "
+                       f"status={store.run_status(run_dir)!r}")
+    # jtpu explain renders a report from the recovered artifacts,
+    # shrugging off the torn searchstats.json
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        exp_rc = cli.run(cli.default_commands(),
+                         ["explain", "--store", run_dir])
+    exp_out = buf.getvalue()
+    if exp_rc not in (0, 1) or "# explain:" not in exp_out:
+        return False, (f"explain rc={exp_rc}; "
+                       f"output: {exp_out[:200]!r}")
+    # and the web page answers 200, never a 500
+    server = web.serve_background(root=root)
+    try:
+        url = (f"http://127.0.0.1:{server.server_port}"
+               f"/explain/local-kv/run")
+        with urllib.request.urlopen(url, timeout=10) as r:
+            page_ok = r.status == 200
+            page = r.read().decode()
+    except Exception as e:  # noqa: BLE001 — an erroring page fails
+        return False, f"/explain page died on the torn run: {e!r}"
+    finally:
+        server.shutdown()
+    ok = page_ok and "# explain:" in page
+    return ok, (f"recover rc={rc}; explain rc={exp_rc} "
+                f"({len(exp_out.splitlines())} line(s)); /explain "
+                f"status={'200' if page_ok else 'not 200'} with torn "
+                f"searchstats.json")
 
 
 def scenario_prof_kill(seed):
@@ -1305,6 +1407,7 @@ SCENARIOS = (
     ("malformed-history", scenario_malformed_history),
     ("trace-integrity", scenario_trace_integrity),
     ("watched-kill", scenario_watched_kill),
+    ("explain-kill", scenario_explain_kill),
     ("prof-kill", scenario_prof_kill),
     ("plan-rejects", scenario_plan_rejects),
     ("fleet-host-kill", scenario_fleet_host_kill),
