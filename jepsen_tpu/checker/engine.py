@@ -94,6 +94,19 @@ def _env_max_warm_buckets() -> int:
         return 0
 
 
+def _env_max_warm_bytes() -> int:
+    """JTPU_ENGINE_BYTES_BUDGET: byte budget for the warm-bucket claim
+    (each warm record carries its bucket's plan-predicted device
+    footprint; past the budget the stalest claims are dropped). 0 /
+    absent / malformed mean unbounded."""
+    import os
+    try:
+        return max(0, int(os.environ.get("JTPU_ENGINE_BYTES_BUDGET")
+                          or "0"))
+    except ValueError:
+        return 0
+
+
 class Engine:
     """An explicit, thread-safe cache of compiled search executables.
 
@@ -121,6 +134,7 @@ class Engine:
         self.max_warm_buckets = (_env_max_warm_buckets()
                                  if max_warm_buckets is None
                                  else max(0, int(max_warm_buckets)))
+        self.max_warm_bytes = _env_max_warm_bytes()
         self.evictions = 0
         self.builds = 0
         self.hits = 0
@@ -297,13 +311,34 @@ class Engine:
         with self._lock:
             return list(self._warm)
 
+    def warm_bytes(self) -> int:
+        """Total plan-predicted device bytes of the warm-bucket claim
+        (sum of each warm record's ``bytes``)."""
+        with self._lock:
+            return sum(int(r.get("bytes") or 0)
+                       for r in self._warm.values())
+
+    def _warm_bytes_locked(self) -> int:
+        return sum(int(r.get("bytes") or 0) for r in self._warm.values())
+
+    def _evict_one_locked(self, why: str) -> tuple:
+        b, _ = self._warm.popitem(last=False)
+        self.evictions += 1
+        _ENGINE_EVICTIONS.inc()
+        log.info("engine %s: evicted warm bucket %s (%s)",
+                 self.name, b, why)
+        return b
+
     def _trim_warm_locked(self) -> None:
         while 0 < self.max_warm_buckets < len(self._warm):
-            b, _ = self._warm.popitem(last=False)
-            self.evictions += 1
-            _ENGINE_EVICTIONS.inc()
-            log.info("engine %s: evicted warm bucket %s (cap %d)",
-                     self.name, b, self.max_warm_buckets)
+            self._evict_one_locked(f"cap {self.max_warm_buckets}")
+        # the byte-based tier: trim stalest-first while the claim's
+        # predicted footprint overruns the byte budget. The NEWEST
+        # claim always survives — evicting the bucket in active use
+        # would thrash re-warms without freeing anything it needs.
+        while self.max_warm_bytes > 0 and len(self._warm) > 1 \
+                and self._warm_bytes_locked() > self.max_warm_bytes:
+            self._evict_one_locked(f"bytes budget {self.max_warm_bytes}")
 
     def set_max_warm_buckets(self, n: int) -> None:
         """(Re)cap the warm-bucket table — the serve daemon wires
@@ -316,6 +351,44 @@ class Engine:
         with self._lock:
             self.max_warm_buckets = max(0, int(n))
             self._trim_warm_locked()
+
+    def set_max_warm_bytes(self, n: int) -> None:
+        """(Re)cap the warm claim by PREDICTED BYTES instead of bucket
+        count (JTPU_ENGINE_BYTES_BUDGET): each warm record carries its
+        bucket's cheapest-rung plan footprint, and the stalest claims
+        are dropped while the sum overruns. 0 = unbounded."""
+        with self._lock:
+            self.max_warm_bytes = max(0, int(n))
+            self._trim_warm_locked()
+
+    def evict_below_headroom(self, min_ratio: float,
+                             poll=None) -> int:
+        """Evict stalest warm claims while LIVE device headroom
+        (``jtpu_device_headroom_ratio``, :func:`jepsen_tpu.obs.devices.
+        headroom_ratio`) sits below ``min_ratio`` — eviction driven by
+        observed memory pressure, not bucket count. ``poll`` overrides
+        the device poll (tests inject a gauge; None on CPU leaves the
+        table untouched). Dropping a claim releases the bucket to
+        re-warm later; the jit table's own LRU then ages out its
+        executables. The newest claim always survives. Returns the
+        number of buckets evicted."""
+        if poll is None:
+            from jepsen_tpu.obs import devices as obs_devices
+            poll = obs_devices.headroom_ratio
+        evicted = 0
+        while True:
+            try:
+                ratio = poll()
+            except Exception:  # noqa: BLE001 — the gauge is advisory
+                return evicted
+            if ratio is None or ratio >= min_ratio:
+                return evicted
+            with self._lock:
+                if len(self._warm) <= 1:
+                    return evicted
+                self._evict_one_locked(
+                    f"headroom {ratio:.3f} < {min_ratio:.3f}")
+            evicted += 1
 
     # -- ahead-of-time warming ---------------------------------------------
 
@@ -365,8 +438,17 @@ class Engine:
             sp.set(shapes=shapes)
         secs = time.perf_counter() - t0
         _WARM_SECONDS.inc(secs)
+        # price the claim for the byte-budget tier: the bucket's plan
+        # footprint is what its resident working set costs the device
+        fp = None
+        try:
+            from jepsen_tpu.checker import plan as plan_mod
+            fp = plan_mod.request_footprint(
+                plan_mod.PlanDims.from_packed(p))
+        except Exception:  # noqa: BLE001 — pricing is advisory
+            fp = None
         rec = {"shapes": shapes, "seconds": round(secs, 6),
-               "ts": time.time()}
+               "ts": time.time(), "bytes": int(fp or 0)}
         with self._lock:
             self._warm.setdefault(bucket, rec)
             self._warm.move_to_end(bucket)

@@ -934,7 +934,7 @@ def request_footprint(dims: PlanDims,
 
 
 def gang_footprint(dims: PlanDims, size: int,
-                   kind: str = "segment") -> Optional[int]:
+                   kind: str = "segment", hosts: int = 1) -> Optional[int]:
     """Predicted device bytes of a ``size``-member GANG over these
     dims — :func:`request_footprint` scaled by the gang size, because
     batched execution (checker.tpu.check_packed_gang) stacks every
@@ -944,8 +944,17 @@ def gang_footprint(dims: PlanDims, size: int,
     (doc/serve.md "Concurrent batching") and caps the gang at the
     largest size that fits the admission byte budget — the gang-shaped
     extension of the per-request 429 contract. None when the dims
-    cannot plan at all."""
+    cannot plan at all.
+
+    With ``hosts`` > 1 the gang's lanes shard over a fleet
+    (doc/serve.md "Fleet-backed serving"): the returned bytes are the
+    WIDEST single host's share — ``ceil(size / hosts)`` lanes — so the
+    per-host admission budget prices what any one device will actually
+    hold, and fleet-wide capacity is ``hosts`` of these."""
     if size < 1:
         return None
     fp = request_footprint(dims, kind=kind)
-    return None if fp is None else int(fp) * int(size)
+    if fp is None:
+        return None
+    lanes = -(-int(size) // max(1, int(hosts)))
+    return int(fp) * lanes

@@ -1588,10 +1588,25 @@ def check_packed_gang(pks: Sequence[PackedHistory], kernel: KernelSpec,
     if _GANG_FAULT is not None:
         _GANG_FAULT(pks)
     results: List[Optional[Dict[str, Any]]] = [None] * len(pks)
-    # Per-member early outs (the _prep_single trivial / crashed-set-
-    # overflow cases), then group survivors by their exact escalation
-    # ladder: members needing different window buckets must escalate
-    # exactly as they would serially, not on a merged ladder.
+    groups = _gang_groups(pks, results)
+    if not groups:
+        return results
+    from jepsen_tpu import accel
+    accel.ensure_usable("check_packed_gang")
+    # gangs always run segmented: the segment barrier IS the per-member
+    # cancellation point, so a 0/monolithic config still segments
+    seg = _segment_config(segment_iters) or DEFAULT_SEGMENT_ITERS
+    for ladder, idx in groups.items():
+        _gang_ladder(pks, kernel, idx, ladder, seg, deadlines, results)
+    return results
+
+
+def _gang_groups(pks, results) -> Dict[tuple, list]:
+    """Per-member early outs (the _prep_single trivial / crashed-set-
+    overflow cases) written into ``results``, then group survivors by
+    their exact escalation ladder: members needing different window
+    buckets must escalate exactly as they would serially, not on a
+    merged ladder."""
     groups: Dict[tuple, list] = {}
     for i, p in enumerate(pks):
         if p.n_required == 0:
@@ -1604,16 +1619,7 @@ def check_packed_gang(pks: Sequence[PackedHistory], kernel: KernelSpec,
         else:
             groups.setdefault(
                 _ladder_for(_window_needed(p)), []).append(i)
-    if not groups:
-        return results
-    from jepsen_tpu import accel
-    accel.ensure_usable("check_packed_gang")
-    # gangs always run segmented: the segment barrier IS the per-member
-    # cancellation point, so a 0/monolithic config still segments
-    seg = _segment_config(segment_iters) or DEFAULT_SEGMENT_ITERS
-    for ladder, idx in groups.items():
-        _gang_ladder(pks, kernel, idx, ladder, seg, deadlines, results)
-    return results
+    return groups
 
 
 def _gang_ladder(pks, kernel, idx, ladder, seg, deadlines,
@@ -1700,6 +1706,270 @@ def _gang_ladder(pks, kernel, idx, ladder, seg, deadlines,
                     bool(wovf) and win >= MAX_WINDOW
                     and not bool(lossy)):
                 still.append(i)
+        pending = still
+
+
+def check_packed_gang_fleet(pks: Sequence[PackedHistory],
+                            kernel: KernelSpec,
+                            hosts: Sequence[Any],
+                            deadlines: Optional[Sequence[Optional[float]]]
+                            = None,
+                            segment_iters: Optional[int] = None,
+                            on_round: Optional[Any] = None,
+                            max_retries: int = 2,
+                            segment_deadline_s: float = 120.0,
+                            stats: Optional[Dict[str, int]] = None,
+                            trail: Optional[list] = None
+                            ) -> List[Dict[str, Any]]:
+    """:func:`check_packed_gang`, placed onto FLEET HOSTS instead of
+    the local device: each segment round shards the gang's vmapped
+    lanes over the live hosts (contiguous chunks), merges the advanced
+    carries back at the leader-held barrier, and re-meshes the next
+    round onto the survivors when a host dies mid-segment — the
+    orphaned lanes simply keep their pre-round carry and re-run on the
+    surviving mesh, so no verdict is lost with the host.
+
+    Failure discipline at the shard boundary (the serve-side DCN-vs-
+    poison split): :class:`jepsen_tpu.fleet.HostLostError` and
+    :data:`jepsen_tpu.resilience.RETRYABLE` worker failures
+    (DCN/TRANSIENT) are absorbed HERE — bounded in-place retry, then
+    host-lost — and never reach :func:`jepsen_tpu.resilience.
+    bisect_poison`, which must only ever see deterministic per-request
+    failures (OOM/WEDGE/FATAL raise through as before). When EVERY
+    host is gone, still-searching lanes return ``{"valid": "unknown",
+    "error": "all fleet hosts lost", "fleet-lost": True}`` with no
+    error-class: the serve daemon's UNKNOWN-rerun loop then escalates
+    them on the serial/CPU path with zero breaker impact.
+
+    ``on_round(round_idx, hosts)`` is the chaos seam (fires after each
+    merge barrier); ``stats``/``trail`` collect placer counters and
+    replayable events. Per-member verdicts remain identical to
+    :func:`check_packed_gang`'s (same ladder, same lane body, same
+    summaries)."""
+    pks = list(pks)
+    if not pks:
+        return []
+    if _GANG_FAULT is not None:
+        _GANG_FAULT(pks)
+    results: List[Optional[Dict[str, Any]]] = [None] * len(pks)
+    groups = _gang_groups(pks, results)
+    if not groups:
+        return results
+    from jepsen_tpu import accel
+    accel.ensure_usable("check_packed_gang_fleet")
+    seg = _segment_config(segment_iters) or DEFAULT_SEGMENT_ITERS
+    for ladder, idx in groups.items():
+        _gang_ladder_fleet(pks, kernel, idx, ladder, seg, deadlines,
+                           results, hosts, on_round, max_retries,
+                           segment_deadline_s, stats, trail)
+    return results
+
+
+def _fleet_lost_result(lane_levels: int) -> Dict[str, Any]:
+    """The all-hosts-lost lane shape — UNKNOWN with no error-class, so
+    the serve daemon re-runs it serially instead of counting a breaker
+    failure or a poison."""
+    return {"valid": UNKNOWN, "backend": "tpu",
+            "error": "all fleet hosts lost", "fleet-lost": True,
+            "levels": lane_levels}
+
+
+def _gang_ladder_fleet(pks, kernel, idx, ladder, seg, deadlines,
+                       results, hosts, on_round, max_retries,
+                       segment_deadline_s, stats, trail) -> None:
+    """One ladder-homogeneous gang group, sharded over fleet hosts
+    per segment round (see :func:`check_packed_gang_fleet`)."""
+    from jepsen_tpu import resilience
+    from jepsen_tpu.fleet import HostLostError
+
+    def bump(key, n=1):
+        if stats is not None:
+            stats[key] = stats.get(key, 0) + n
+
+    def note(event, **kw):
+        if trail is not None:
+            trail.append(dict({"event": event}, **kw))
+
+    breq = max(_bucket(pks[i].n_required) for i in idx)
+    crw = max(_crash_width(pks[i].n - pks[i].n_required) for i in idx)
+    cols = {i: _split_packed(pks[i], breq, crw, kernel) for i in idx}
+    work: Dict[int, list] = {i: [] for i in idx}
+    dead: set = set()
+    pending = list(idx)
+    round_idx = 0
+    for cap, win, exp in ladder:
+        if not pending:
+            return
+        rows = [cols[i] for i in pending]
+        arrays = [np.stack([np.asarray(c[col]) for c in rows])
+                  for col in _COLS]
+        cr_pad = int(rows[0]["cf"].shape[0])
+        lmax = _level_budget(breq, cr_pad)
+        carry_b = tuple(
+            np.stack(lanes) for lanes in zip(*(
+                _carry0_host(cap, win, cr_pad, c["ini"], int(c["nr"]))
+                for c in rows)))
+        lane_live = [True] * len(pending)
+        timed_out: set = set()
+        fleet_lost = False
+        while any(lane_live):
+            # pre-round liveness sweep: a host that died BETWEEN rounds
+            # (no shard outstanding) shrinks the mesh here, before any
+            # lane is placed on it
+            swept = False
+            for h in hosts:
+                if id(h) not in dead and not h.alive():
+                    dead.add(id(h))
+                    swept = True
+                    bump("host-losses")
+                    note("host-lost", host=getattr(h, "name", "?"),
+                         round=round_idx)
+            live = [h for h in hosts if id(h) not in dead]
+            if not live:
+                fleet_lost = True
+                break
+            if swept:
+                bump("remeshes")
+                note("remesh", round=round_idx, live=len(live),
+                     rung=[cap, win, exp])
+            # shard ALL pending lanes over the live hosts: inactive
+            # lanes no-op in-device (their while-condition is false),
+            # which keeps every host's shard shape round-stable
+            nshards = min(len(live), len(pending))
+            sels = [s for s in np.array_split(np.arange(len(pending)),
+                                              nshards) if s.size]
+            new_carry = tuple(np.array(x) for x in carry_b)
+            subs = []
+            for h, sel in zip(live, sels):
+                sub_cols = [np.ascontiguousarray(a[sel])
+                            for a in arrays]
+                sub_carry = tuple(np.ascontiguousarray(c[sel])
+                                  for c in carry_b)
+                h.submit_gang(sub_cols, sub_carry, kernel, seg,
+                              (cap, win, exp), round_idx)
+                subs.append((h, sel, sub_cols, sub_carry))
+            advanced: set = set()
+            lost_this_round = False
+            for h, sel, sub_cols, sub_carry in subs:
+                attempt = 0
+                while True:
+                    try:
+                        out, _secs = h.collect_gang(segment_deadline_s)
+                        for tgt, c in zip(new_carry, out):
+                            tgt[sel] = c
+                        advanced.update(int(j) for j in sel)
+                        break
+                    except HostLostError as e:
+                        # the shard's lanes keep their pre-round carry
+                        # (merge-back for free) and re-run on the
+                        # survivors next round
+                        dead.add(id(h))
+                        lost_this_round = True
+                        bump("host-losses")
+                        note("host-lost",
+                             host=getattr(h, "name", "?"),
+                             round=round_idx, error=str(e))
+                        break
+                    except RuntimeError as e:
+                        cls = resilience.classify_failure(e)
+                        if cls not in resilience.RETRYABLE:
+                            # deterministic per-request failure:
+                            # bisect_poison's territory — raise
+                            raise
+                        if attempt < max_retries and h.alive():
+                            attempt += 1
+                            bump("dcn-retries")
+                            note("host-retry",
+                                 host=getattr(h, "name", "?"),
+                                 round=round_idx, attempt=attempt,
+                                 **{"class": cls})
+                            h.submit_gang(sub_cols, sub_carry, kernel,
+                                          seg, (cap, win, exp),
+                                          round_idx)
+                            continue
+                        # retries exhausted: a persistently flaky
+                        # interconnect is a lost host, not a poison
+                        dead.add(id(h))
+                        lost_this_round = True
+                        bump("host-losses")
+                        note("host-lost",
+                             host=getattr(h, "name", "?"),
+                             round=round_idx, error=str(e),
+                             **{"class": cls})
+                        break
+            carry_b = new_carry
+            _SEGMENTS_TOTAL.inc()
+            bump("rounds")
+            if lost_this_round:
+                bump("remeshes")
+                n_live = sum(1 for h in hosts
+                             if id(h) not in dead and h.alive())
+                verdict = None
+                try:
+                    from jepsen_tpu.checker import plan as plan_mod
+                    verdict = plan_mod.check_remesh(
+                        pks[pending[0]], max(1, n_live), cap, win, exp)
+                except Exception:  # noqa: BLE001 — advisory only
+                    verdict = None
+                note("remesh", round=round_idx, live=n_live,
+                     rung=[cap, win, exp],
+                     ok=None if verdict is None else verdict.get("ok"))
+            if on_round is not None:
+                on_round(round_idx, hosts)
+            round_idx += 1
+            now = _hosttime.monotonic()
+            for j, i in enumerate(pending):
+                if not lane_live[j]:
+                    continue
+                # only a lane that actually advanced this round can be
+                # declared finished; a lost shard's lanes stay live on
+                # their pre-round carry
+                if j in advanced:
+                    lane = tuple(a[j] for a in carry_b)
+                    if not _carry_active(lane, lmax):
+                        lane_live[j] = False
+                        continue
+                dl = deadlines[i] if deadlines else None
+                if dl is not None and now >= dl:
+                    carry_b[4][j, ...] = False
+                    lane_live[j] = False
+                    timed_out.add(i)
+        still = []
+        for j, i in enumerate(pending):
+            lane = tuple(a[j] for a in carry_b)
+            if i in timed_out:
+                results[i] = {
+                    "valid": UNKNOWN, "error": ":info/timeout",
+                    "error-class": "wedge", "backend": "tpu",
+                    "levels": int(lane[8]), "rung": (cap, win, exp),
+                    "gang-cancelled": True}
+                continue
+            if fleet_lost and lane_live[j]:
+                results[i] = _fleet_lost_result(int(lane[8]))
+                continue
+            done, lossy, wovf, best, levels, pool = \
+                _summarize_carry(lane)
+            _LEVELS_TOTAL.inc(levels)
+            out = _result(done, lossy, wovf, best, levels, pks[i],
+                          pool=pool)
+            out["rung"] = (cap, win, exp)
+            out["crash-width"] = _crash_width(
+                pks[i].n - pks[i].n_required) or 0
+            out["tiebreak"] = "lex"
+            work[i].append(((cap, win, exp), out["crash-width"], "lex",
+                            levels))
+            out["work"] = list(work[i])
+            out["gang-size"] = len(pending)
+            out["fleet"] = True
+            results[i] = out
+            if out["valid"] is UNKNOWN and not (
+                    bool(wovf) and win >= MAX_WINDOW
+                    and not bool(lossy)):
+                still.append(i)
+        if fleet_lost:
+            # no capacity to escalate: lanes already holding a genuine
+            # rung summary keep it (UNKNOWNs re-run serially upstream)
+            return
         pending = still
 
 

@@ -291,6 +291,16 @@ def serve_cmd() -> dict:
                        help="LRU-evict warmed engine buckets past this "
                             "count; 0 = unbounded "
                             "(JTPU_ENGINE_MAX_BUCKETS)")
+        p.add_argument("--fleet", type=int, default=None, metavar="N",
+                       help="place gangs onto N fleet worker hosts "
+                            "with host-loss re-meshing; 0 or 1 = "
+                            "single-host dispatch (JTPU_SERVE_FLEET; "
+                            "doc/serve.md 'Fleet-backed serving')")
+        p.add_argument("--rate-limit", type=float, default=None,
+                       metavar="R",
+                       help="per-tenant POST /check token bucket: R "
+                            "requests/s sustained, 429 + Retry-After "
+                            "past it; 0 = off (JTPU_SERVE_RATE)")
         return p
 
     def run(opts) -> int:
@@ -331,6 +341,10 @@ def serve_cmd() -> dict:
             cfg.auth_token = opts["auth_token"] or None
         if opts.get("engine_max_buckets") is not None:
             cfg.engine_max_buckets = opts["engine_max_buckets"]
+        if opts.get("fleet") is not None:
+            cfg.fleet_hosts = opts["fleet"]
+        if opts.get("rate_limit") is not None:
+            cfg.rate_limit = opts["rate_limit"]
         daemon, server = serve_ns.run_daemon(
             cfg, host=opts["host"], port=opts["port"],
             store_root=opts["store_root"])

@@ -374,17 +374,71 @@ def _meta_value(arr) -> Any:
 
 def load_carry(path: str) -> Tuple[tuple, Dict[str, Any]]:
     """Read a carry written by :func:`save_carry`; scalar slots are
-    normalized to numpy scalars so jit sees identical avals."""
+    normalized to numpy scalars so jit sees identical avals. A gang
+    (batched) carry keeps its ``(G,)``-shaped flag/level lanes — only
+    the dtypes are pinned, since ``np.bool_`` on an array would be a
+    shape change (and an ambiguity error for G > 1)."""
     with np.load(path) as z:
         carry = tuple(z[f"carry_{n}"] for n in CARRY_FIELDS)
         meta = {k[len("meta_"):]: _meta_value(z[k])
                 for k in z.files if k.startswith("meta_")}
-    carry = (carry[:5]
-             + (np.bool_(carry[5]), np.bool_(carry[6]),
-                np.bool_(carry[7]), np.int32(carry[8]),
-                np.int32(carry[9]))
-             + carry[10:])
+    if np.asarray(carry[5]).ndim:
+        carry = (carry[:5]
+                 + tuple(np.asarray(carry[i], dtype=np.bool_)
+                         for i in (5, 6, 7))
+                 + tuple(np.asarray(carry[i], dtype=np.int32)
+                         for i in (8, 9))
+                 + carry[10:])
+    else:
+        carry = (carry[:5]
+                 + (np.bool_(carry[5]), np.bool_(carry[6]),
+                    np.bool_(carry[7]), np.int32(carry[8]),
+                    np.int32(carry[9]))
+                 + carry[10:])
     return carry, meta
+
+
+def save_gang_request(path: str, cols: Sequence[Any], carry: tuple,
+                      kernel_name: str, **meta: Any) -> None:
+    """Atomic npz write of a GANG shard request: the stacked packed
+    columns (``(G, ...)`` per :data:`jepsen_tpu.checker.tpu._COLS`
+    name), the batched carry, and the kernel name travel TOGETHER —
+    unlike per-search ``cols.npz``, a serve gang's columns differ per
+    request, so the worker cannot pre-load them at admission."""
+    arrays = {f"col_{n}": np.asarray(a)
+              for n, a in zip(T._COLS, cols)}
+    arrays.update({f"carry_{n}": np.asarray(v)
+                   for n, v in zip(CARRY_FIELDS, carry)})
+    marrays = {f"meta_{k}": (np.bytes_(v.encode())
+                             if isinstance(v, str)
+                             else np.int64(-1 if v is None else v))
+               for k, v in meta.items()}
+    tmp = os.path.join(os.path.dirname(path) or ".",
+                       f".tmp.{os.path.basename(path)}.{os.getpid()}")
+    np.savez(tmp, kernel=np.bytes_(kernel_name.encode()),
+             **arrays, **marrays)
+    os.replace(tmp if os.path.exists(tmp) else tmp + ".npz", path)
+
+
+def load_gang_request(path: str
+                      ) -> Tuple[list, tuple, str, Dict[str, Any]]:
+    """Read a gang shard request written by :func:`save_gang_request`:
+    ``(cols, carry, kernel_name, meta)`` with ``cols`` in
+    :data:`~jepsen_tpu.checker.tpu._COLS` order and the carry's
+    ``(G,)`` flag/level lanes dtype-pinned like :func:`load_carry`."""
+    with np.load(path) as z:
+        cols = [z[f"col_{n}"] for n in T._COLS]
+        carry = tuple(z[f"carry_{n}"] for n in CARRY_FIELDS)
+        kname = bytes(z["kernel"]).decode()
+        meta = {k[len("meta_"):]: _meta_value(z[k])
+                for k in z.files if k.startswith("meta_")}
+    carry = (carry[:5]
+             + tuple(np.asarray(carry[i], dtype=np.bool_)
+                     for i in (5, 6, 7))
+             + tuple(np.asarray(carry[i], dtype=np.int32)
+                     for i in (8, 9))
+             + carry[10:])
+    return cols, carry, kname, meta
 
 
 def kernel_by_name(name: str) -> KernelSpec:
@@ -422,8 +476,11 @@ class LocalHost:
         self._killed = False
         self._pending: Optional[tuple] = None
 
-    def start(self, cols: dict, kernel: KernelSpec,
+    def start(self, cols: Optional[dict] = None,
+              kernel: Optional[KernelSpec] = None,
               model_name: Optional[str] = None) -> None:
+        """``cols``/``kernel`` may be ``None`` for a serve-fleet host:
+        gang requests ship their own columns per submission."""
         self._cols = cols
         self._kernel = kernel
         self.state = "live"
@@ -456,6 +513,34 @@ class LocalHost:
         t0 = time.perf_counter()
         out = fn(*(self._cols[c] for c in T._COLS),
                  np.int32(seg_iters), carry)
+        out = tuple(np.asarray(x) for x in out)
+        return out, time.perf_counter() - t0
+
+    # -- gang shards (serve fleet placement) --------------------------------
+
+    def submit_gang(self, cols: Sequence[Any], carry: tuple,
+                    kernel: KernelSpec, seg_iters: int, rung: tuple,
+                    round_idx: int) -> None:
+        """Submit a slice of a vmapped gang: ``cols`` are the stacked
+        ``(G, ...)`` columns for this host's lanes, ``carry`` the
+        matching batched carry."""
+        self._gang_pending = (cols, carry, kernel, seg_iters, rung,
+                              round_idx)
+
+    def collect_gang(self, deadline_s: float) -> Tuple[tuple, float]:
+        if self._killed:
+            raise HostLostError(f"host {self.name} is gone")
+        cols, carry, kernel, seg_iters, (cap, win, exp), round_idx = \
+            self._gang_pending
+        ctx = {"host": self.name, "round": round_idx,
+               "rung": (cap, win, exp),
+               "gang": int(np.asarray(cols[0]).shape[0])}
+        if self.chaos is not None:
+            self.chaos(ctx)
+        fn = T._jit_batch_segment(T._kernel_key(kernel), cap, win, exp,
+                                  T._unroll_factor())
+        t0 = time.perf_counter()
+        out = fn(*cols, np.int32(seg_iters), carry)
         out = tuple(np.asarray(x) for x in out)
         return out, time.perf_counter() - t0
 
@@ -495,15 +580,17 @@ class ProcHost:
 
     # -- lifecycle ----------------------------------------------------------
 
-    def start(self, cols: dict, kernel: KernelSpec,
+    def start(self, cols: Optional[dict] = None,
+              kernel: Optional[KernelSpec] = None,
               model_name: Optional[str] = None) -> None:
         os.makedirs(self.dir, exist_ok=True)
-        name = kernel.name
-        arrays = {f"col_{c}": np.asarray(cols[c]) for c in T._COLS}
-        tmp = os.path.join(self.dir, f"cols.tmp.{os.getpid()}")
-        np.savez(tmp, kernel=np.bytes_(name.encode()), **arrays)
-        os.replace(tmp if os.path.exists(tmp) else tmp + ".npz",
-                   os.path.join(self.dir, "cols.npz"))
+        if cols is not None and kernel is not None:
+            name = kernel.name
+            arrays = {f"col_{c}": np.asarray(cols[c]) for c in T._COLS}
+            tmp = os.path.join(self.dir, f"cols.tmp.{os.getpid()}")
+            np.savez(tmp, kernel=np.bytes_(name.encode()), **arrays)
+            os.replace(tmp if os.path.exists(tmp) else tmp + ".npz",
+                       os.path.join(self.dir, "cols.npz"))
         if self.spawn and self.proc is None:
             # the worker must import THIS jepsen_tpu regardless of the
             # leader's cwd; its stderr lands in the host dir so a
@@ -593,8 +680,13 @@ class ProcHost:
         n = self._await
         if n is None:
             raise HostLostError(f"host {self.name}: nothing submitted")
-        resp = os.path.join(self.dir, f"resp_{n}.npz")
-        errf = os.path.join(self.dir, f"resp_{n}.err")
+        return self._collect_file(f"resp_{n}.npz", f"resp_{n}.err",
+                                  deadline_s)
+
+    def _collect_file(self, resp_name: str, err_name: str,
+                      deadline_s: float) -> Tuple[tuple, float]:
+        resp = os.path.join(self.dir, resp_name)
+        errf = os.path.join(self.dir, err_name)
         t0 = time.perf_counter()
         t_end = time.monotonic() + deadline_s
         while True:
@@ -614,6 +706,36 @@ class ProcHost:
                     f"host {self.name}: shard segment exceeded its "
                     f"{deadline_s:.1f}s deadline")
             time.sleep(0.02)
+
+    # -- gang shards (serve fleet placement) --------------------------------
+
+    def submit_gang(self, cols: Sequence[Any], carry: tuple,
+                    kernel: KernelSpec, seg_iters: int, rung: tuple,
+                    round_idx: int) -> None:
+        """Ship a gang slice (stacked ``(G, ...)`` columns + batched
+        carry + kernel name in ONE ``greq_N.npz``) to the worker. Gang
+        requests share the ``req_N`` numbering so the worker answers
+        both kinds strictly in submission order."""
+        self._req_n += 1
+        cap, win, exp = rung
+        meta: Dict[str, Any] = dict(seg_iters=seg_iters, capacity=cap,
+                                    window=win, expand=exp,
+                                    round=round_idx)
+        if obs_trace.enabled():
+            trace_id, _ = obs_trace.current_context()
+            if trace_id:
+                meta["trace"] = trace_id
+        save_gang_request(
+            os.path.join(self.dir, f"greq_{self._req_n}.npz"),
+            cols, carry, kernel.name, **meta)
+        self._gawait = self._req_n
+
+    def collect_gang(self, deadline_s: float) -> Tuple[tuple, float]:
+        n = getattr(self, "_gawait", None)
+        if n is None:
+            raise HostLostError(f"host {self.name}: nothing submitted")
+        return self._collect_file(f"gresp_{n}.npz", f"gresp_{n}.err",
+                                  deadline_s)
 
 
 # ---------------------------------------------------------------------------
@@ -686,17 +808,66 @@ def worker_main(host_dir: str) -> int:
             return 0
         reqs = []
         for f in os.listdir(host_dir):
-            if not (f.startswith("req_") and f.endswith(".npz")):
+            if not f.endswith(".npz"):
+                continue
+            if f.startswith("req_"):
+                kind, stem = "seg", f[len("req_"):-len(".npz")]
+            elif f.startswith("greq_"):
+                kind, stem = "gang", f[len("greq_"):-len(".npz")]
+            else:
                 continue
             try:
-                reqs.append(int(f[len("req_"):-len(".npz")]))
+                reqs.append((int(stem), kind))
             except ValueError:
                 continue  # a tmp/foreign file must never kill the host
-        pending = [n for n in sorted(reqs) if n not in done]
+        pending = [r for r in sorted(reqs) if r not in done]
         if not pending:
             time.sleep(0.02)
             continue
-        n = pending[0]
+        n, kind = pending[0]
+        if kind == "gang":
+            # a serve gang shard: its columns + kernel ride inside the
+            # request itself (per-gang columns differ, unlike the
+            # per-search cols.npz), so no cols wait applies
+            try:
+                gcols, gcarry, kname, meta = load_gang_request(
+                    os.path.join(host_dir, f"greq_{n}.npz"))
+                state["state"], state["round"] = ("segment",
+                                                  meta.get("round"))
+                obs_trace.set_context(meta.get("trace") or None)
+                exp = meta.get("expand")
+                exp = None if exp is None or exp < 0 else exp
+                g = int(np.asarray(gcols[0]).shape[0])
+                with obs.span("checker.segment",
+                              host=os.path.basename(host_dir) or host_dir,
+                              round=meta.get("round"),
+                              rung=[meta["capacity"], meta["window"],
+                                    exp],
+                              seg_iters=meta["seg_iters"], gang=g):
+                    fn = T._jit_batch_segment(
+                        T._kernel_key(kernel_by_name(kname)),
+                        meta["capacity"], meta["window"], exp,
+                        T._unroll_factor())
+                    out = fn(*gcols, np.int32(meta["seg_iters"]),
+                             gcarry)
+                    out = tuple(np.asarray(x) for x in out)
+                save_carry(os.path.join(host_dir, f"gresp_{n}.npz"),
+                           out, gang=g)
+            except Exception as e:  # noqa: BLE001 — relayed to leader
+                tmp = os.path.join(host_dir,
+                                   f".err.tmp.{os.getpid()}")
+                try:
+                    with open(tmp, "w") as f:
+                        f.write(f"{type(e).__name__}: {e}")
+                    os.replace(tmp, os.path.join(host_dir,
+                                                 f"gresp_{n}.err"))
+                except OSError:
+                    pass
+            done.add((n, kind))
+            obs_trace.clear_context()
+            state["state"], state["round"] = "idle", None
+            write_heartbeat(host_dir)
+            continue
         if cols is None:
             cpath = os.path.join(host_dir, "cols.npz")
             if not os.path.exists(cpath):
@@ -739,7 +910,7 @@ def worker_main(host_dir: str) -> int:
                 os.replace(tmp, os.path.join(host_dir, f"resp_{n}.err"))
             except OSError:
                 pass
-        done.add(n)
+        done.add((n, kind))
         obs_trace.clear_context()
         state["state"], state["round"] = "idle", None
         write_heartbeat(host_dir)

@@ -340,6 +340,16 @@ def format_status(p: Optional[Dict[str, Any]]) -> str:
                         f"(bisections {s.get('bisections', 0)})")
         if s.get("breakers-open"):
             bits.append(f"breakers-open {s['breakers-open']}")
+        if s.get("fleet-hosts") is not None:
+            # fleet-backed serving: live/spawned hosts, plus re-mesh
+            # count once a host has been lost mid-gang
+            fbit = (f"fleet {s.get('fleet-live', 0)}/"
+                    f"{s['fleet-hosts']} host(s)")
+            if s.get("remeshes"):
+                fbit += f" | remesh {s['remeshes']}"
+            bits.append(fbit)
+        if s.get("rate-limited") is not None:
+            bits.append(f"rate-limited {s['rate-limited']}")
         if s.get("warm-buckets") is not None:
             bits.append(f"warm {s['warm-buckets']} bucket(s)")
         if p.get("state") and p["state"] != "serving":

@@ -131,7 +131,14 @@ _DCN_MARKERS = ("collective", "all-reduce", "all_reduce", "all-gather",
                 "all_gather", "AllReduce", "AllGather", "NCCL",
                 "DCN", "cross-host", "cross_host", "barrier timed out",
                 "coordination service", "distributed runtime",
-                "heartbeat")
+                "heartbeat", "HostLostError")
+
+#: Failure classes the fleet layer retries (or re-meshes around)
+#: internally. The serve boundary treats these as NEUTRAL for breaker
+#: accounting: a flaky interconnect the fleet already absorbed must not
+#: trip a shape bucket open and 503 healthy tenants — and equally must
+#: not be mistaken for a poison request by gang bisection.
+RETRYABLE = (DCN, TRANSIENT)
 
 
 def classify_failure(exc: BaseException) -> str:

@@ -119,7 +119,7 @@ _REJECTED = obs_metrics.counter(
     "jtpu_serve_rejected_total",
     "requests refused by admission control, labeled reason "
     "(queue-full|tenant-quota|footprint|headroom|breaker-open|draining"
-    "|malformed|bad-request)")
+    "|malformed|bad-request|rate-limited)")
 _COMPLETED = obs_metrics.counter(
     "jtpu_serve_completed_total",
     "requests checked to a verdict, labeled valid")
@@ -155,6 +155,17 @@ _BATCH_POISON = obs_metrics.counter(
     "jtpu_serve_batch_poison_total",
     "requests isolated as the poison member of a failed gang, labeled "
     "tenant — only these count toward their bucket's circuit breaker")
+_FLEET_LIVE = obs_metrics.gauge(
+    "jtpu_serve_fleet_live",
+    "live fleet worker hosts backing the serve placer (0 when "
+    "fleet-backed serving is off)")
+_FLEET_REMESH = obs_metrics.counter(
+    "jtpu_serve_fleet_remesh_total",
+    "gang re-mesh rounds after a fleet host was lost mid-segment")
+_RATE_LIMITED = obs_metrics.counter(
+    "jtpu_serve_rate_limited_total",
+    "requests answered 429 by the per-tenant token bucket, labeled "
+    "tenant")
 
 
 def serve_enabled() -> bool:
@@ -255,6 +266,53 @@ class ServeConfig:
     #: evictions surface as jtpu_engine_evictions_total and /healthz.
     engine_max_buckets: int = field(
         default_factory=lambda: _env_int("JTPU_ENGINE_MAX_BUCKETS", 0))
+    # -- fleet-backed serving (doc/serve.md "Fleet-backed serving") ---------
+    #: Kill switch + sizing: the number of fleet hosts the FleetPlacer
+    #: spawns (`serve --fleet N` / JTPU_SERVE_FLEET). Below 2 no placer
+    #: exists at all — the worker loop is the single-host dispatch,
+    #: byte-identical; JTPU_SERVE_FLEET=0 in the environment overrides
+    #: even an explicit fleet_hosts (see :attr:`fleet_enabled`).
+    fleet_hosts: int = field(
+        default_factory=lambda: _env_int("JTPU_SERVE_FLEET", 0))
+    #: Host backend: "proc" spawns real worker processes (ProcHost —
+    #: the chaos/CI seam), "local" runs shards in-process (LocalHost —
+    #: the CPU-simulated mesh tier-1 tests drive).
+    fleet_backend: str = field(
+        default_factory=lambda: os.environ.get(
+            "JTPU_SERVE_FLEET_BACKEND", "proc"))
+    #: Per-shard-segment collect deadline on fleet hosts (a wedged
+    #: worker becomes a host loss after this many seconds).
+    fleet_deadline_s: float = field(
+        default_factory=lambda: _env_float(
+            "JTPU_SERVE_FLEET_DEADLINE_S", 120.0))
+    #: Per-tenant token-bucket rate limit on POST /check: sustained
+    #: requests/s (0 = off) and the bucket's burst depth (0 = derive
+    #: from the rate).
+    rate_limit: float = field(
+        default_factory=lambda: _env_float("JTPU_SERVE_RATE", 0.0))
+    rate_burst: int = field(
+        default_factory=lambda: _env_int("JTPU_SERVE_RATE_BURST", 0))
+    #: Byte budget for the Engine's warm claim (0 = unbounded): warm
+    #: records carry their bucket's plan footprint and the stalest are
+    #: evicted while the sum overruns (JTPU_ENGINE_BYTES_BUDGET).
+    engine_bytes_budget: int = field(
+        default_factory=lambda: _env_int("JTPU_ENGINE_BYTES_BUDGET", 0))
+    #: Live-pressure eviction: after each served request, drop stalest
+    #: warm claims while jtpu_device_headroom_ratio sits below this
+    #: (0 = off; JTPU_ENGINE_HEADROOM_MIN).
+    engine_headroom_min: float = field(
+        default_factory=lambda: _env_float(
+            "JTPU_ENGINE_HEADROOM_MIN", 0.0))
+
+    @property
+    def fleet_enabled(self) -> bool:
+        """Whether the FleetPlacer is constructed. Read at call time so
+        JTPU_SERVE_FLEET=0 restores the single-host path even against
+        an explicitly configured ``fleet_hosts`` — the kill switch
+        always wins."""
+        if os.environ.get("JTPU_SERVE_FLEET", "").strip() == "0":
+            return False
+        return int(self.fleet_hosts) >= 2
 
 
 @dataclass
@@ -353,12 +411,20 @@ class CircuitBreaker:
         breaker with doubled cooldown); success resets."""
         if bucket is None:
             return
-        from jepsen_tpu.resilience import OOM, WEDGE
+        from jepsen_tpu.resilience import OOM, RETRYABLE, WEDGE
         failed = failure_class in (OOM, WEDGE)
         now = time.monotonic()
         with self._lock:
             rec = self._rec(bucket)
-            if failed:
+            if failure_class in RETRYABLE:
+                # DCN/TRANSIENT: the fleet retries (or re-meshes
+                # around) these internally, so a flaky interconnect
+                # must not trip a bucket open and 503 healthy tenants.
+                # NEUTRAL: no trip progress, no reset of genuine fail
+                # counts — but a half-open probe slot is returned so
+                # the next probe isn't starved.
+                rec["probing"] = False
+            elif failed:
                 rec["fails"] += 1
                 if rec["state"] == "half-open" or \
                         rec["fails"] >= self.fails:
@@ -397,6 +463,29 @@ class CircuitBreaker:
         with self._lock:
             return sum(1 for r in self._b.values()
                        if r["state"] == "open")
+
+
+class TokenBucket:
+    """A per-tenant admission rate limiter (doc/serve.md knob table):
+    ``rate`` tokens/s refill lazily up to ``burst``. :meth:`take`
+    returns 0.0 on admit, else the seconds until a token frees — the
+    429's Retry-After. Callers hold the daemon lock; no lock here."""
+
+    def __init__(self, rate: float, burst: float):
+        self.rate = max(1e-9, float(rate))
+        self.burst = max(1.0, float(burst))
+        self.tokens = self.burst
+        self._t = time.monotonic()
+
+    def take(self) -> float:
+        now = time.monotonic()
+        self.tokens = min(self.burst,
+                          self.tokens + (now - self._t) * self.rate)
+        self._t = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return 0.0
+        return (1.0 - self.tokens) / self.rate
 
 
 class RequestJournal:
@@ -469,13 +558,18 @@ class BatchScheduler:
     def max_fit(self, leader: CheckRequest) -> int:
         """The largest gang size whose stacked footprint fits the byte
         budget — priced BEFORE dispatch, not discovered by the
-        allocator failing mid-gang."""
+        allocator failing mid-gang. With a fleet placer the gang's
+        lanes shard over the live hosts, so the per-host budget prices
+        the WIDEST HOST'S share (``gang_footprint(..., hosts=W)``) —
+        fleet-wide capacity, not one device's."""
         n = self.batch_max
         budget = self.daemon._budget()
         if budget and leader.dims is not None:
             from jepsen_tpu.checker import plan as plan_mod
+            hosts = self.daemon._fleet_width()
             while n > 1:
-                gfp = plan_mod.gang_footprint(leader.dims, n)
+                gfp = plan_mod.gang_footprint(leader.dims, n,
+                                              hosts=hosts)
                 if gfp is None or gfp <= budget:
                     break
                 n -= 1
@@ -515,6 +609,96 @@ class BatchScheduler:
         return gang
 
 
+class FleetPlacer:
+    """Places admitted work onto an elastic host set instead of the
+    local device — the fleet-backed serving tentpole (doc/serve.md
+    "Fleet-backed serving").
+
+    A coalesced gang's vmapped lanes shard over the live hosts per
+    segment round (:func:`jepsen_tpu.checker.tpu.
+    check_packed_gang_fleet`); a host SIGKILLed mid-gang triggers a
+    re-mesh onto the survivors, with the orphaned lanes' frontier
+    carries merging back at the leader-held barrier — zero lost
+    verdicts. Worker directories live at ``<root>/fleet-host-N``,
+    which :func:`jepsen_tpu.obs.fleet.discover_hosts` already treats
+    as host dirs, so ``stitch_request`` assembles cross-host request
+    waterfalls with no extra wiring.
+
+    One gang runs at a time (``_lock``): hosts hold a single
+    outstanding shard each, and serializing gangs keeps the host set's
+    wire protocol trivially ordered. ``on_round`` is the chaos seam
+    (forwarded to the fleet ladder's merge barrier)."""
+
+    def __init__(self, config: ServeConfig):
+        self.config = config
+        self.hosts: list = []
+        self.on_round = None
+        self._lock = threading.Lock()
+        self.stats = {"gangs": 0, "rounds": 0, "remeshes": 0,
+                      "host-losses": 0, "dcn-retries": 0}
+
+    def start(self) -> None:
+        from jepsen_tpu import fleet as fleet_mod
+        n = max(2, int(self.config.fleet_hosts))
+        for i in range(n):
+            if self.config.fleet_backend == "local":
+                h = fleet_mod.LocalHost(f"host-{i}")
+            else:
+                h = fleet_mod.ProcHost(
+                    f"host-{i}",
+                    os.path.join(self.config.root, f"fleet-host-{i}"))
+            h.start(None, None)
+            self.hosts.append(h)
+        log.info("fleet placer up: %d %s host(s)", n,
+                 self.config.fleet_backend)
+
+    def stop(self) -> None:
+        for h in self.hosts:
+            try:
+                h.stop()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+
+    def live(self) -> int:
+        return sum(1 for h in self.hosts if h.alive())
+
+    def width(self) -> int:
+        """Live host count, floored at 1 — the fleet-capacity factor
+        for admission pricing and the Retry-After EWMA."""
+        return max(1, self.live())
+
+    def run_gang(self, pks: list, kernel: Any,
+                 deadlines: list) -> list:
+        """Dispatch one (sub-)gang over the fleet; remesh/loss/retry
+        counters accumulate in :attr:`stats` and the ladder's trail
+        becomes ``serve.fleet.*`` trace events on the ambient (gang
+        leader's) trace."""
+        from jepsen_tpu.checker import tpu as tpu_mod
+        trail: list = []
+        with self._lock:
+            self.stats["gangs"] += 1
+            before = self.stats["remeshes"]
+            # only hosts alive NOW: a host lost in an earlier gang must
+            # not be re-counted as this gang's loss (an empty set means
+            # the ladder answers fleet-lost and the daemon's serial
+            # escalation path takes over)
+            hosts = [h for h in self.hosts if h.alive()]
+            try:
+                out = tpu_mod.check_packed_gang_fleet(
+                    pks, kernel, hosts, deadlines=deadlines,
+                    on_round=self.on_round,
+                    segment_deadline_s=self.config.fleet_deadline_s,
+                    stats=self.stats, trail=trail)
+            finally:
+                remeshed = self.stats["remeshes"] - before
+        if remeshed:
+            _FLEET_REMESH.inc(remeshed)
+        _FLEET_LIVE.set(self.live())
+        for ev in trail:
+            obs_trace.event(f"serve.fleet.{ev.pop('event')}", **ev)
+        return out
+
+
 class CheckDaemon:
     """The queue, the workers, the journal, and the admission logic —
     everything behind the HTTP handler. Start with :meth:`start`
@@ -549,7 +733,9 @@ class CheckDaemon:
         self._service_ewma: Optional[float] = None
         self.stats = {"admitted": 0, "rejected": 0, "completed": 0,
                       "timeouts": 0, "replayed": 0, "batches": 0,
-                      "max-batch": 0, "bisections": 0, "poisoned": 0}
+                      "max-batch": 0, "bisections": 0, "poisoned": 0,
+                      "rate-limited": 0}
+        self._rate: Dict[str, TokenBucket] = {}
         self.replay_stats: Dict[str, Any] = {}
         self.breaker = CircuitBreaker(self.config.breaker_fails,
                                       self.config.breaker_cooldown_s)
@@ -563,6 +749,14 @@ class CheckDaemon:
         if self.config.engine_max_buckets > 0:
             self.engine.set_max_warm_buckets(
                 self.config.engine_max_buckets)
+        if self.config.engine_bytes_budget > 0:
+            self.engine.set_max_warm_bytes(
+                self.config.engine_bytes_budget)
+        # JTPU_SERVE_FLEET kill switch: below 2 hosts (or =0 in the
+        # env) no placer object exists — gangs run on the local device
+        # exactly as before
+        self.placer = (FleetPlacer(self.config)
+                       if self.config.fleet_enabled else None)
         self._progress_last = 0.0
 
     # -- model / planning helpers -------------------------------------------
@@ -601,14 +795,30 @@ class CheckDaemon:
         from jepsen_tpu.checker import plan as plan_mod
         return self.config.bytes_budget or plan_mod.plan_bytes_limit()
 
+    def _fleet_width(self) -> int:
+        """Live fleet host count (1 with no placer) — the capacity
+        factor for admission pricing and the Retry-After EWMA."""
+        return self.placer.width() if self.placer is not None else 1
+
+    def _capacity_budget(self) -> Optional[int]:
+        """Admission byte budget across the WHOLE fleet: committed
+        footprints are summed against every live host's capacity, not
+        one device's (a gang's lanes shard over the mesh)."""
+        b = self._budget()
+        return b * self._fleet_width() if b else b
+
     def _retry_after(self) -> float:
         """Backpressure hint: expected seconds until a queue slot frees
-        (service-time EWMA x depth, clamped to [1, 60])."""
+        (service-time EWMA x depth over the live service width, clamped
+        to [1, 60]). The EWMA tracks HOST-seconds per request
+        (:meth:`_finish`), so dividing by ``workers x fleet width``
+        makes the hint shrink when the fleet grows and stretch after a
+        host loss — capacity-aware, not config-aware."""
         with self._lock:
             depth = self._depth + len(self._inflight)
             ewma = self._service_ewma
         est = (ewma or 1.0) * max(depth, 1) / max(
-            self.config.workers, 1)
+            self.config.workers * self._fleet_width(), 1)
         return float(min(max(est, 1.0), 60.0))
 
     # -- admission ----------------------------------------------------------
@@ -672,6 +882,30 @@ class CheckDaemon:
         # a previous incarnation and are owed a verdict.
         probe = False
         if not replayed:
+            # per-tenant token bucket FIRST: a throttled tenant must
+            # not consume the half-open probe slot nor touch breaker
+            # state. Replays bypass — a previous incarnation already
+            # admitted them and owes a verdict.
+            if self.config.rate_limit > 0:
+                with self._lock:
+                    tb = self._rate.get(tenant)
+                    if tb is None:
+                        burst = self.config.rate_burst or max(
+                            1, int(round(self.config.rate_limit)))
+                        tb = self._rate[tenant] = TokenBucket(
+                            self.config.rate_limit, burst)
+                    wait = tb.take()
+                if wait > 0.0:
+                    self.stats["rate-limited"] += 1
+                    _RATE_LIMITED.inc(tenant=tenant)
+                    # Retry-After: the token refill wait, floored by
+                    # the fleet-capacity-aware service estimate — a
+                    # saturated (or host-diminished) fleet stretches
+                    # the hint beyond the nominal refill
+                    return reject(429, "rate-limited",
+                                  retry=max(wait, self._retry_after()
+                                            if self._depth else wait),
+                                  tenant=tenant)
             ok, retry, probe = self.breaker.allow(bucket)
             if not ok:
                 return reject(503, "breaker-open", retry=retry,
@@ -687,7 +921,7 @@ class CheckDaemon:
                 return reject(429, "tenant-quota",
                               retry=self._retry_after(), tenant=tenant,
                               depth=tdepth)
-            budget = self._budget()
+            budget = self._capacity_budget()
             if budget and footprint and \
                     committed + footprint > budget:
                 return reject(429, "footprint",
@@ -1035,10 +1269,17 @@ class CheckDaemon:
 
         def run_gang(span):
             # span is a list of gang indices: bisect_poison hands back
-            # subsets of the members we gave it
+            # subsets of the members we gave it. With a fleet placer
+            # the gang's lanes shard over the live hosts (host losses
+            # and DCN blips are absorbed INSIDE the fleet ladder, so
+            # bisection still only ever sees deterministic failures);
+            # without one, the local vmapped call as before.
+            sub_pks = [pks[i] for i in span]
+            sub_dl = [deadlines[i] for i in span]
+            if self.placer is not None:
+                return self.placer.run_gang(sub_pks, kernel, sub_dl)
             return tpu_mod.check_packed_gang(
-                [pks[i] for i in span], kernel,
-                deadlines=[deadlines[i] for i in span])
+                sub_pks, kernel, deadlines=sub_dl)
 
         with obs_trace.context(leader.trace, leader.trace_parent):
             with obs_trace.span("serve.gang", size=len(gang),
@@ -1163,10 +1404,15 @@ class CheckDaemon:
                 self._footprint_committed = max(
                     0, self._footprint_committed - req.footprint)
             # Retry-After estimation: the EWMA tracks per-REQUEST
-            # service time, so a gang's wall-clock is amortized over
+            # HOST-seconds, so a gang's wall-clock is amortized over
             # its realized batch size — one 8-wide batch taking 2 s is
-            # 0.25 s/request, not 2 s/request
-            per = secs / max(1, batch_size)
+            # 0.25 s/request, not 2 s/request — and scaled by the live
+            # fleet width (W hosts ran concurrently for those seconds).
+            # _retry_after divides the width back out, so the hint
+            # shrinks when the fleet grows and stretches after a host
+            # loss; width is 1 with no placer, leaving the single-host
+            # math untouched.
+            per = secs * self._fleet_width() / max(1, batch_size)
             self._service_ewma = (per if self._service_ewma is None
                                   else 0.3 * per
                                   + 0.7 * self._service_ewma)
@@ -1184,10 +1430,14 @@ class CheckDaemon:
             gang = (self.batcher.gather(req)
                     if self.batcher is not None else [req])
             try:
-                if len(gang) == 1:
-                    self._run_one(req)
-                else:
+                # with a fleet placer, even a gang of one dispatches
+                # through the gang path so it runs on the fleet; the
+                # CPU object-search path (no bucket) stays serial
+                if len(gang) > 1 or (self.placer is not None
+                                     and req.bucket is not None):
                     self._run_gang(gang)
+                else:
+                    self._run_one(req)
             except Exception:  # noqa: BLE001 — a worker must never die
                 log.exception("worker crashed on %s",
                               [r.id for r in gang])
@@ -1196,6 +1446,14 @@ class CheckDaemon:
                         self._finish(r, {"valid": "unknown",
                                          "error": "serve worker crashed"},
                                      0.0)
+            if self.config.engine_headroom_min > 0:
+                # live-pressure byte eviction: shed stalest warm claims
+                # while the device headroom gauge reads under the floor
+                try:
+                    self.engine.evict_below_headroom(
+                        self.config.engine_headroom_min)
+                except Exception:  # noqa: BLE001 — advisory
+                    pass
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -1210,6 +1468,8 @@ class CheckDaemon:
                                             obs_trace.TRACE_NAME)
             obs_trace.tracer().attach(self._trace_path)
             obs_trace.sync_event()
+        if self.placer is not None:
+            self.placer.start()
         pending, stats = RequestJournal.replay(self.journal.path)
         self.replay_stats = dict(stats, requeued=len(pending))
         for doc in pending:
@@ -1261,6 +1521,8 @@ class CheckDaemon:
             self._work.notify_all()
         for t in self._threads:
             t.join(timeout=2.0)
+        if self.placer is not None:
+            self.placer.stop()
         self.journal.close()
         tr = obs_trace.tracer()
         if getattr(self, "_trace_path", None) and \
@@ -1314,7 +1576,7 @@ class CheckDaemon:
             depth = self._depth
             inflight = len(self._inflight)
             committed = self._footprint_committed
-        return {
+        doc = {
             "ok": True,
             "state": "draining" if self.draining else "serving",
             "uptime-s": round(time.time() - self._started, 3),
@@ -1324,7 +1586,7 @@ class CheckDaemon:
                                   if oldest is not None else None),
             "tenants": tenants, "tenant-max": self.config.tenant_max,
             "committed-bytes": committed,
-            "budget-bytes": self._budget(),
+            "budget-bytes": self._capacity_budget(),
             "stats": dict(self.stats),
             "replay": dict(self.replay_stats),
             "breakers": self.breaker.snapshot(),
@@ -1335,10 +1597,18 @@ class CheckDaemon:
                     "/".join(str(x) for x in b)
                     for b in self.engine.warm_buckets()],
                 "max-warm-buckets": self.engine.max_warm_buckets or 0,
+                "warm-bytes": self.engine.warm_bytes(),
+                "max-warm-bytes": self.engine.max_warm_bytes or 0,
                 "evictions": self.engine.evictions,
                 "persistent-cache": self.config.compile_cache,
             },
         }
+        if self.placer is not None:
+            doc["fleet"] = dict(self.placer.stats,
+                                hosts=len(self.placer.hosts),
+                                live=self.placer.live(),
+                                backend=self.config.fleet_backend)
+        return doc
 
     def _publish(self, force: bool = False,
                  state: Optional[str] = None) -> None:
@@ -1374,6 +1644,16 @@ class CheckDaemon:
                     "warm-buckets": len(self.engine.warm_buckets()),
                 },
             }
+            # fleet / throttle bits only when the feature is on: a
+            # placer-less daemon's progress.json stays byte-identical
+            if self.placer is not None:
+                doc["serve"]["fleet-hosts"] = len(self.placer.hosts)
+                doc["serve"]["fleet-live"] = self.placer.live()
+                doc["serve"]["remeshes"] = \
+                    self.placer.stats["remeshes"]
+            if self.config.rate_limit > 0:
+                doc["serve"]["rate-limited"] = \
+                    self.stats["rate-limited"]
         path = os.path.join(self.config.root, PROGRESS_NAME)
         tmp = f"{path}.tmp.{os.getpid()}"
         try:

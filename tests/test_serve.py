@@ -1515,3 +1515,247 @@ class TestTracerAttachRace:
                 assert stats["torn"] == 0 and stats["corrupt"] == 0
                 total += stats["spans"]
         assert total > 0                    # the races did overlap
+
+
+# ---------------------------------------------------------------------------
+# Per-tenant rate limiting (the fleet-capacity-aware 429 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestRateLimit:
+    def test_token_bucket_refills_lazily(self):
+        tb = serve_ns.TokenBucket(10.0, 2)
+        assert tb.take() == 0.0
+        assert tb.take() == 0.0            # burst of 2 admits two
+        wait = tb.take()
+        assert 0.0 < wait <= 0.1           # then ~1/rate until a token
+        time.sleep(wait + 0.02)
+        assert tb.take() == 0.0            # refilled
+
+    def test_burst_exceeded_gets_429_with_retry_after(self, tmp_path):
+        d = _daemon(tmp_path, queue_max=32, rate_limit=0.5, rate_burst=2)
+        try:
+            for _ in range(2):
+                code, _, _ = d.submit({"model": "cas-register",
+                                       "history": _ops(),
+                                       "tenant": "bursty"})
+                assert code == 202
+            code, body, hdrs = d.submit({"model": "cas-register",
+                                         "history": _ops(),
+                                         "tenant": "bursty"})
+            assert code == 429
+            assert body["error"] == "rate-limited"
+            assert body["retry-after-s"] > 0
+            assert "Retry-After" in hdrs
+            assert d.stats["rate-limited"] == 1
+            # an independent tenant still has its own full bucket
+            code, _, _ = d.submit({"model": "cas-register",
+                                   "history": _ops(), "tenant": "calm"})
+            assert code == 202
+        finally:
+            d.stop()
+
+    def test_replay_bypasses_rate_limit(self, tmp_path):
+        """WAL replay re-admits accepted requests regardless of the
+        limiter: the 202 was already promised in a prior life."""
+        d = _daemon(tmp_path, queue_max=32, rate_limit=0.5, rate_burst=1)
+        try:
+            for i in range(3):
+                code, _, _ = d.submit({"model": "cas-register",
+                                       "history": _ops(2 + i),
+                                       "tenant": "replayed"},
+                                      replayed=True)
+                assert code == 202
+            assert d.stats["rate-limited"] == 0
+        finally:
+            d.stop()
+
+    def test_no_limit_by_default(self, tmp_path):
+        d = _daemon(tmp_path, queue_max=32)
+        try:
+            assert d.config.rate_limit == 0.0
+            for _ in range(8):
+                code, _, _ = d.submit({"model": "cas-register",
+                                       "history": _ops(),
+                                       "tenant": "free"})
+                assert code == 202
+        finally:
+            d.stop()
+
+
+# ---------------------------------------------------------------------------
+# Fleet-width-aware Retry-After (satellite: EWMA x live host count)
+# ---------------------------------------------------------------------------
+
+
+class TestRetryAfterFleetWidth:
+    def _stub_placer(self, width):
+        import types
+        return types.SimpleNamespace(width=lambda: width,
+                                     live=lambda: width,
+                                     hosts=[None] * width,
+                                     stats={"remeshes": 0},
+                                     stop=lambda: None)
+
+    def test_ewma_tracks_host_seconds(self, tmp_path):
+        """An 8 s gang of 8 on a 4-host fleet burned 32 host-seconds:
+        4 host-seconds/request, NOT 1 — so the hint survives a shrink
+        to one host without underestimating."""
+        d = _daemon(tmp_path, queue_max=16)
+        d.placer = self._stub_placer(4)
+        try:
+            code, body, _ = d.submit({"model": "cas-register",
+                                      "history": _ops(3)})
+            assert code == 202
+            req = d._dequeue()
+            d._finish(req, {"valid": True}, 8.0, batch_size=8)
+            assert d._service_ewma == pytest.approx(4.0)
+        finally:
+            d.placer = None
+            d.stop()
+
+    def test_retry_after_divides_by_live_width(self, tmp_path):
+        """The same backlog reads 4x shorter on a 4-host fleet — and
+        stretches right back when the fleet shrinks (host loss)."""
+        d = _daemon(tmp_path, queue_max=16)
+        try:
+            for v in (1, 5):
+                code, _, _ = d.submit({"model": "cas-register",
+                                       "history": _ops(3, value=v)})
+                assert code == 202
+            d._service_ewma = 20.0
+            single = d._retry_after()
+            d.placer = self._stub_placer(4)
+            quad = d._retry_after()
+            assert quad == pytest.approx(single / 4)
+            d.placer = self._stub_placer(1)     # fleet lost 3 hosts
+            assert d._retry_after() == pytest.approx(single)
+        finally:
+            d.placer = None
+            d.stop()
+
+
+# ---------------------------------------------------------------------------
+# Breaker DCN-neutrality (satellite: fleet-retried classes don't trip)
+# ---------------------------------------------------------------------------
+
+
+class TestBreakerDcnNeutral:
+    BUCKET = ("cas-register", 16, 0, 32)
+
+    def test_dcn_class_failures_do_not_trip(self):
+        from jepsen_tpu.resilience import DCN, TRANSIENT
+        br = serve_ns.CircuitBreaker(2, 0.05)
+        for cls in (DCN, TRANSIENT, DCN, DCN):
+            br.record(self.BUCKET, cls, probe=False)
+        ok, _, _ = br.allow(self.BUCKET)
+        assert ok, "fleet-retried DCN failures must not open the breaker"
+        assert br.open_count() == 0
+
+    def test_dcn_neither_trips_nor_resets(self):
+        """Neutral means neutral: a DCN blip between two real OOMs
+        neither counts toward the threshold nor wipes the first OOM's
+        strike."""
+        from jepsen_tpu.resilience import DCN, OOM
+        br = serve_ns.CircuitBreaker(2, 0.05)
+        br.record(self.BUCKET, OOM, probe=False)
+        br.record(self.BUCKET, DCN, probe=False)    # neutral
+        rec = list(br.snapshot().values())[0]
+        assert rec["fails"] == 1                    # not reset to 0
+        br.record(self.BUCKET, OOM, probe=False)
+        ok, _, _ = br.allow(self.BUCKET)
+        assert not ok                               # 2 real strikes trip
+
+    def test_dcn_probe_frees_the_slot(self):
+        """A half-open probe that ends in a DCN blip must release the
+        probe slot (else the breaker wedges half-open forever) without
+        closing or re-opening."""
+        import random as _random
+        from jepsen_tpu.resilience import DCN, OOM
+        br = serve_ns.CircuitBreaker(1, 0.05, rng=_random.Random(7))
+        br.record(self.BUCKET, OOM, probe=False)
+        time.sleep(0.08)
+        ok, _, probe = br.allow(self.BUCKET)
+        assert ok and probe
+        br.record(self.BUCKET, DCN, probe=True)     # inconclusive probe
+        ok, _, probe = br.allow(self.BUCKET)
+        assert ok and probe, "slot freed: the NEXT probe may run"
+
+
+# ---------------------------------------------------------------------------
+# Byte-based warm eviction (the headroom-driven satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestByteEviction:
+    def _warm_two(self, eng):
+        p1, kernel = _packed(_ops(2))
+        p2, _ = _packed(_ops(40))
+        b1 = Engine.bucket_key(p1, kernel)
+        b2 = Engine.bucket_key(p2, kernel)
+        assert b1 != b2
+        eng.warm(p1, kernel, rungs=1)
+        eng.warm(p2, kernel, rungs=1)
+        return b1, b2
+
+    def test_warm_records_carry_bytes(self):
+        eng = Engine("bytes-rec")
+        self._warm_two(eng)
+        assert eng.warm_bytes() > 0        # plan-priced, not guessed
+
+    def test_bytes_budget_trims_stalest_first(self):
+        eng = Engine("bytes-budget")
+        b1, b2 = self._warm_two(eng)
+        total = eng.warm_bytes()
+        eng.set_max_warm_bytes(total - 1)  # over budget by one byte
+        assert eng.warm_buckets() == [b2]  # stalest (b1) evicted
+        assert eng.evictions == 1
+
+    def test_bytes_budget_keeps_newest_bucket(self):
+        """Even an absurd 1-byte budget never evicts the LAST warm
+        bucket — the serving path must keep its working set."""
+        eng = Engine("bytes-floor")
+        _, b2 = self._warm_two(eng)
+        eng.set_max_warm_bytes(1)
+        assert eng.warm_buckets() == [b2]
+
+    def test_env_budget_wired(self, monkeypatch):
+        monkeypatch.setenv("JTPU_ENGINE_BYTES_BUDGET", "12345")
+        assert Engine("env-bytes").max_warm_bytes == 12345
+
+    def test_evict_below_headroom_driven_by_gauge(self):
+        """Memory pressure (headroom below the floor) evicts stalest
+        buckets one at a time until the gauge recovers — count-blind,
+        byte-driven."""
+        eng = Engine("headroom")
+        b1, b2 = self._warm_two(eng)
+        ratios = iter([0.01, 0.05])        # starved, then recovered
+        n = eng.evict_below_headroom(0.02, poll=lambda: next(ratios))
+        assert n == 1
+        assert eng.warm_buckets() == [b2]
+
+    def test_evict_below_headroom_stops_at_last_bucket(self):
+        eng = Engine("headroom-floor")
+        self._warm_two(eng)
+        n = eng.evict_below_headroom(0.5, poll=lambda: 0.0)
+        assert n == 1                      # evicted down to one...
+        assert len(eng.warm_buckets()) == 1   # ...then stopped
+
+    def test_evict_below_headroom_no_pressure_is_noop(self):
+        eng = Engine("headroom-ok")
+        self._warm_two(eng)
+        assert eng.evict_below_headroom(0.02, poll=lambda: 0.9) == 0
+        assert len(eng.warm_buckets()) == 2
+
+    def test_healthz_reports_byte_state(self, tmp_path):
+        d = _daemon(tmp_path, engine_bytes_budget=1 << 20)
+        try:
+            assert d.engine.max_warm_bytes == 1 << 20
+            health = d.healthz()
+            assert health["engine"]["max-warm-bytes"] == 1 << 20
+            # the daemon shares the process-wide engine, so other
+            # tests' warm buckets may already be claimed here
+            assert health["engine"]["warm-bytes"] == \
+                d.engine.warm_bytes()
+        finally:
+            d.stop()

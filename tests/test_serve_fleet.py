@@ -1,0 +1,483 @@
+"""Fleet-backed serving tests (doc/serve.md "Fleet-backed serving").
+
+The zero-lost-verdict contract: a FleetPlacer shards coalesced gangs
+over an elastic host set; a host killed mid-gang re-meshes onto the
+survivors with the orphaned lanes' carries merged back; below minimum
+capacity every lane fails over to the serial escalation path — and in
+all cases every accepted request answers a verdict identical to the
+offline analyze path, with zero breaker trips and zero poison
+misclassification. JTPU_SERVE_FLEET=0 restores the single-host daemon
+byte-identically (the kill-switch identity leg).
+
+These tests drive the LocalHost backend (in-process CPU-simulated
+mesh); the real 2-process ProcHost path is exercised by
+tools/chaos_matrix.py serve-fleet-host-kill and tools/serve_gate.py.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from jepsen_tpu import fleet as fleet_ns
+from jepsen_tpu import serve as serve_ns
+from jepsen_tpu.checker import tpu as T
+from jepsen_tpu.history import History
+from jepsen_tpu.models import CASRegister
+from jepsen_tpu.ops.encode import pack_with_init
+
+from tests.test_serve import (_VERDICT_KEYS, _conc_ops, _daemon,
+                              _offline, _ops, _wait_done)
+
+pytestmark = pytest.mark.serve
+
+
+def _fleet_daemon(tmp_path, hosts=2, **cfg):
+    cfg.setdefault("fleet_hosts", hosts)
+    cfg.setdefault("fleet_backend", "local")
+    cfg.setdefault("batch_wait_ms", 150.0)
+    cfg.setdefault("workers", 1)
+    return _daemon(tmp_path, **cfg)
+
+
+def _submit_burst(d, histories, tenants=("t0", "t1", "t2")):
+    rids = []
+    for i, ops in enumerate(histories):
+        code, body, _ = d.submit({"model": "cas-register",
+                                  "history": ops,
+                                  "tenant": tenants[i % len(tenants)]})
+        assert code == 202, body
+        rids.append(body["id"])
+    return rids
+
+
+class TestFleetPlacement:
+    def test_gang_over_fleet_matches_offline(self, tmp_path):
+        """A multi-tenant same-bucket burst dispatches as ONE gang
+        sharded over the fleet hosts, and every verdict equals the
+        offline analyze path's."""
+        histories = [_ops(3), _ops(3, value=50), _ops(3, value=90)]
+        d = _fleet_daemon(tmp_path)
+        assert d.placer is not None
+        d.start()
+        try:
+            assert len(d.placer.hosts) == 2
+            assert d.placer.live() == 2
+            rids = _submit_burst(d, histories)
+            docs = [_wait_done(d, rid) for rid in rids]
+            for ops, doc in zip(histories, docs):
+                offline = _offline(ops)
+                for key in _VERDICT_KEYS:
+                    assert doc["result"].get(key) == offline.get(key), \
+                        (key, doc["result"])
+            assert d.placer.stats["gangs"] >= 1
+            assert d.placer.stats["rounds"] >= 1
+            hz = d.healthz()
+            assert hz["fleet"]["hosts"] == 2
+            assert hz["fleet"]["live"] == 2
+            assert hz["fleet"]["backend"] == "local"
+        finally:
+            d.stop()
+
+    def test_single_request_routes_through_fleet(self, tmp_path):
+        """Even a gang of one is placed on the fleet (the placer, not
+        the gang size, selects the dispatch path)."""
+        d = _fleet_daemon(tmp_path)
+        d.start()
+        try:
+            code, body, _ = d.submit({"model": "cas-register",
+                                      "history": _ops(3)})
+            assert code == 202
+            doc = _wait_done(d, body["id"])
+            assert doc["result"]["valid"] is True
+            assert d.placer.stats["gangs"] == 1
+        finally:
+            d.stop()
+
+
+class TestHostLossFailover:
+    def test_host_kill_mid_gang_zero_lost_verdicts(self, tmp_path,
+                                                   monkeypatch):
+        """The tentpole contract: a host killed mid-gang triggers a
+        re-mesh; the orphaned lanes' frontier carries merge back and
+        finish on the surviving host — every verdict delivered,
+        offline-identical, ZERO breaker trips, ZERO poison."""
+        monkeypatch.setenv("JTPU_SEGMENT_ITERS", "1")
+        histories = [_conc_ops(24, 11), _conc_ops(24, 12, value_base=60),
+                     _conc_ops(24, 13, value_base=120)]
+        d = _fleet_daemon(tmp_path)
+        killed = []
+
+        def kill_second_host(round_idx, hosts):
+            if not killed:
+                hosts[-1].kill()
+                killed.append(round_idx)
+
+        d.placer.on_round = kill_second_host
+        d.start()
+        try:
+            # segment_iters=1 gives the ladder several merge barriers
+            # (rounds), so the kill lands mid-gang, not post-gang
+            rids = _submit_burst(d, histories)
+            docs = [_wait_done(d, rid) for rid in rids]
+            for ops, doc in zip(histories, docs):
+                offline = _offline(ops)
+                for key in _VERDICT_KEYS:
+                    assert doc["result"].get(key) == offline.get(key), \
+                        (key, doc["result"])
+            assert killed, "chaos seam never fired"
+            assert d.placer.stats["host-losses"] >= 1
+            assert d.placer.stats["remeshes"] >= 1
+            assert d.placer.live() == 1
+            assert d.stats["poisoned"] == 0
+            snap = d.breaker.snapshot()
+            assert all(r["fails"] == 0 for r in snap.values()), snap
+            assert all(r["state"] == "closed"
+                       for r in snap.values()), snap
+        finally:
+            d.stop()
+
+    def test_all_hosts_lost_fails_over_to_serial(self, tmp_path,
+                                                 monkeypatch):
+        """Below minimum capacity (every host gone) the lanes answer
+        fleet-lost and the daemon's serial escalation path still
+        delivers offline-identical verdicts — zero lost verdicts even
+        with zero hosts."""
+        monkeypatch.setenv("JTPU_SEGMENT_ITERS", "1")
+        histories = [_conc_ops(24, 21), _conc_ops(24, 22, value_base=60)]
+        d = _fleet_daemon(tmp_path)
+
+        def kill_everything(round_idx, hosts):
+            for h in hosts:
+                h.kill()
+
+        d.placer.on_round = kill_everything
+        d.start()
+        try:
+            rids = _submit_burst(d, histories)
+            docs = [_wait_done(d, rid) for rid in rids]
+            for ops, doc in zip(histories, docs):
+                offline = _offline(ops)
+                for key in _VERDICT_KEYS:
+                    assert doc["result"].get(key) == offline.get(key), \
+                        (key, doc["result"])
+            assert d.placer.live() == 0
+            assert d.stats["poisoned"] == 0
+            snap = d.breaker.snapshot()
+            assert all(r["fails"] == 0 for r in snap.values()), snap
+        finally:
+            d.stop()
+
+    def test_fleet_ladder_direct_host_loss(self):
+        """check_packed_gang_fleet unit leg: kill one LocalHost from
+        the chaos seam mid-collect; verdicts match the local gang
+        path's and the stats record the loss + remesh."""
+        histories = [_conc_ops(24, 31), _conc_ops(24, 32, value_base=60)]
+        pks, kernel = [], None
+        for ops in histories:
+            pk = pack_with_init(History.of(ops), CASRegister())
+            pks.append(pk[0])
+            kernel = pk[1]
+        h0 = fleet_ns.LocalHost("h0")
+        h1 = fleet_ns.LocalHost("h1")
+
+        def chaos(ctx):
+            raise fleet_ns.HostLostError("host h1 is gone (chaos)")
+
+        h1.chaos = chaos
+        h0.start(None, None)
+        h1.start(None, None)
+        stats: dict = {}
+        trail: list = []
+        out = T.check_packed_gang_fleet(pks, kernel, [h0, h1],
+                                        stats=stats, trail=trail)
+        serial = T.check_packed_gang(pks, kernel)
+        for got, want in zip(out, serial):
+            for key in _VERDICT_KEYS:
+                assert got.get(key) == want.get(key), (key, got, want)
+            assert got.get("fleet") is True
+        assert stats.get("host-losses", 0) >= 1
+        assert stats.get("remeshes", 0) >= 1
+        assert any(ev["event"] == "host-lost" for ev in trail)
+
+    def test_dcn_retry_succeeds_without_breaker_impact(self, tmp_path):
+        """A transient interconnect blip (first collect raises a
+        connection error) is retried in place by the fleet ladder:
+        the verdict lands, the breaker stays closed at zero fails,
+        and the retry is counted — not a host loss, not a poison."""
+        d = _fleet_daemon(tmp_path)
+        d.start()
+        try:
+            blipped = []
+
+            def blip_once(ctx):
+                if not blipped:
+                    blipped.append(ctx)
+                    raise RuntimeError(
+                        "connection reset by peer (injected DCN blip)")
+
+            d.placer.hosts[0].chaos = blip_once
+            code, body, _ = d.submit({"model": "cas-register",
+                                      "history": _ops(3)})
+            assert code == 202
+            doc = _wait_done(d, body["id"])
+            offline = _offline(_ops(3))
+            for key in _VERDICT_KEYS:
+                assert doc["result"].get(key) == offline.get(key)
+            assert blipped, "chaos seam never fired"
+            assert d.placer.stats["dcn-retries"] >= 1
+            assert d.placer.stats["host-losses"] == 0
+            assert d.stats["poisoned"] == 0
+            snap = d.breaker.snapshot()
+            assert all(r["fails"] == 0 for r in snap.values()), snap
+        finally:
+            d.stop()
+
+    def test_poison_still_raises_through_fleet(self, tmp_path):
+        """A deterministic failure (OOM-class) on a fleet host is NOT
+        absorbed as a host loss: it raises to bisect_poison exactly as
+        the local gang path does, so fault isolation composes with
+        fleet placement."""
+        histories = [_conc_ops(24, 41), _conc_ops(24, 42, value_base=60)]
+        pks, kernel = [], None
+        for ops in histories:
+            pk = pack_with_init(History.of(ops), CASRegister())
+            pks.append(pk[0])
+            kernel = pk[1]
+        h0 = fleet_ns.LocalHost("h0")
+
+        def oom(ctx):
+            raise RuntimeError("RESOURCE_EXHAUSTED: injected")
+
+        h0.chaos = oom
+        h0.start(None, None)
+        with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+            T.check_packed_gang_fleet(pks, kernel, [h0])
+
+
+class TestFleetDrain:
+    def test_drain_with_fleet_gang_in_flight(self, tmp_path,
+                                             monkeypatch):
+        """POST /drain with a fleet-dispatched gang in flight — and a
+        worker host dying during the drain window — still finishes the
+        gang (zero lost verdicts), leaves the queued remainder
+        journaled, and completes cleanly."""
+        monkeypatch.setenv("JTPU_SEGMENT_ITERS", "1")
+        histories = [_conc_ops(24, 51), _conc_ops(24, 52, value_base=60)]
+        d = _fleet_daemon(tmp_path, queue_max=8)
+        gate = threading.Event()
+        killed = []
+
+        def slow_then_kill(round_idx, hosts):
+            gate.set()
+            if not killed:
+                killed.append(round_idx)
+                hosts[-1].kill()
+            time.sleep(0.05)   # stretch the gang across the drain call
+
+        d.placer.on_round = slow_then_kill
+        d.start()
+        try:
+            rids = _submit_burst(d, histories)
+            assert gate.wait(20.0), "gang never dispatched"
+            # queue one more while the gang holds the only worker:
+            # drain must leave it journaled, not run it
+            code, body, _ = d.submit({"model": "cas-register",
+                                      "history": _ops(2),
+                                      "tenant": "late"})
+            assert code == 202
+            late_rid = body["id"]
+            out = d.drain(timeout_s=60.0)
+            assert out["drained"] is True
+            assert out["inflight-remaining"] == 0
+            # the in-flight gang finished with offline verdicts
+            for ops, rid in zip(histories, rids):
+                doc = d.status(rid)
+                assert doc["state"] == "done", doc
+                offline = _offline(ops)
+                for key in _VERDICT_KEYS:
+                    assert doc["result"].get(key) == offline.get(key)
+            # the late request stayed queued — journaled for replay
+            assert d.status(late_rid)["state"] == "queued"
+            pending, _ = serve_ns.RequestJournal.replay(d.journal.path)
+            assert [p["id"] for p in pending] == [late_rid]
+        finally:
+            d.stop()
+
+
+class TestFleetKillSwitch:
+    def test_env_zero_overrides_explicit_hosts(self, tmp_path,
+                                               monkeypatch):
+        monkeypatch.setenv("JTPU_SERVE_FLEET", "0")
+        d = _fleet_daemon(tmp_path, hosts=2)
+        assert d.config.fleet_enabled is False
+        assert d.placer is None
+        d.stop()
+
+    def test_no_fleet_is_byte_identical_single_host(self, tmp_path):
+        """The kill-switch identity test: with no --fleet the daemon
+        constructs NO placer, routes through the identical single-host
+        paths, publishes no fleet keys anywhere, and serves the same
+        verdicts."""
+        import json
+        import os
+        d = _daemon(tmp_path, workers=1)
+        assert d.config.fleet_enabled is False
+        assert d.placer is None
+        assert d._fleet_width() == 1
+        # capacity budget degenerates to the single-host budget
+        assert d._capacity_budget() == d._budget()
+        d.start()
+        try:
+            code, body, _ = d.submit({"model": "cas-register",
+                                      "history": _ops(3)})
+            assert code == 202
+            doc = _wait_done(d, body["id"])
+            offline = _offline(_ops(3))
+            for key in _VERDICT_KEYS:
+                assert doc["result"].get(key) == offline.get(key)
+            hz = d.healthz()
+            assert "fleet" not in hz
+            d._publish(force=True)
+            with open(os.path.join(d.config.root,
+                                   serve_ns.PROGRESS_NAME)) as f:
+                prog = json.load(f)
+            assert "fleet-hosts" not in prog["serve"]
+            assert "fleet-live" not in prog["serve"]
+            assert "remeshes" not in prog["serve"]
+            assert "rate-limited" not in prog["serve"]
+            # no fleet host dirs were created either
+            assert not [p for p in os.listdir(d.config.root)
+                        if p.startswith("fleet-host-")]
+        finally:
+            d.stop()
+
+
+class TestFleetGangWire:
+    def test_gang_request_roundtrip(self, tmp_path):
+        """save_gang_request/load_gang_request preserve stacked cols,
+        batched carry (shapes AND dtypes), kernel name and meta."""
+        pks, kernel = [], None
+        for ops in (_ops(3), _ops(3, value=9)):
+            pk = pack_with_init(History.of(ops), CASRegister())
+            pks.append(pk[0])
+            kernel = pk[1]
+        breq = max(T._bucket(p.n_required) for p in pks)
+        crw = max(T._crash_width(p.n - p.n_required) for p in pks)
+        cols = [T._split_packed(p, breq, crw, kernel) for p in pks]
+        arrays = [np.stack([np.asarray(c[name]) for c in cols])
+                  for name in T._COLS]
+        cr_pad = int(cols[0]["cf"].shape[0])
+        carry = tuple(
+            np.stack(lanes) for lanes in zip(*(
+                T._carry0_host(32, 32, cr_pad, c["ini"], int(c["nr"]))
+                for c in cols)))
+        path = str(tmp_path / "greq_1.npz")
+        fleet_ns.save_gang_request(path, arrays, carry, kernel.name,
+                                   seg_iters=64, capacity=32,
+                                   window=32, expand=4, round=0,
+                                   trace="ab" * 16)
+        cols2, carry2, kname, meta = fleet_ns.load_gang_request(path)
+        assert kname == kernel.name
+        assert meta["seg_iters"] == 64 and meta["round"] == 0
+        assert meta["trace"] == "ab" * 16
+        for a, b in zip(arrays, cols2):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(carry, carry2):
+            assert np.asarray(a).shape == np.asarray(b).shape
+            assert np.asarray(a).dtype == np.asarray(b).dtype
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_load_carry_keeps_batched_lanes(self, tmp_path):
+        """load_carry must NOT collapse a gang's (G,)-shaped flag and
+        level lanes to scalars (np.bool_ on a 2-lane array would even
+        raise) — only dtypes are pinned."""
+        pks = []
+        for ops in (_ops(3), _ops(3, value=9)):
+            pk = pack_with_init(History.of(ops), CASRegister())
+            pks.append(pk[0])
+            kernel = pk[1]
+        breq = max(T._bucket(p.n_required) for p in pks)
+        crw = max(T._crash_width(p.n - p.n_required) for p in pks)
+        cols = [T._split_packed(p, breq, crw, kernel) for p in pks]
+        cr_pad = int(cols[0]["cf"].shape[0])
+        carry = tuple(
+            np.stack(lanes) for lanes in zip(*(
+                T._carry0_host(32, 32, cr_pad, c["ini"], int(c["nr"]))
+                for c in cols)))
+        path = str(tmp_path / "gresp_1.npz")
+        fleet_ns.save_carry(path, carry, gang=2)
+        got, meta = fleet_ns.load_carry(path)
+        assert meta["gang"] == 2
+        for a, b in zip(carry, got):
+            assert np.asarray(a).shape == np.asarray(b).shape, \
+                "batched lane collapsed to scalar"
+        assert np.asarray(got[5]).dtype == np.bool_
+        assert np.asarray(got[8]).dtype == np.int32
+
+    def test_localhost_gang_segment_matches_batch_jit(self):
+        """LocalHost.submit_gang/collect_gang runs exactly the vmapped
+        batch segment the local gang path runs."""
+        pks, kernel = [], None
+        for ops in (_ops(3), _ops(3, value=9)):
+            pk = pack_with_init(History.of(ops), CASRegister())
+            pks.append(pk[0])
+            kernel = pk[1]
+        breq = max(T._bucket(p.n_required) for p in pks)
+        crw = max(T._crash_width(p.n - p.n_required) for p in pks)
+        cols = [T._split_packed(p, breq, crw, kernel) for p in pks]
+        arrays = [np.stack([np.asarray(c[name]) for c in cols])
+                  for name in T._COLS]
+        cr_pad = int(cols[0]["cf"].shape[0])
+        carry = tuple(
+            np.stack(lanes) for lanes in zip(*(
+                T._carry0_host(32, 32, cr_pad, c["ini"], int(c["nr"]))
+                for c in cols)))
+        h = fleet_ns.LocalHost("h0")
+        h.start(None, None)
+        h.submit_gang(arrays, carry, kernel, 64, (32, 32, 4), 0)
+        out, secs = h.collect_gang(30.0)
+        fn = T._jit_batch_segment(T._kernel_key(kernel), 32, 32, 4,
+                                  T._unroll_factor())
+        want = tuple(np.asarray(x)
+                     for x in fn(*arrays, np.int32(64), carry))
+        for a, b in zip(want, out):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestFleetObservability:
+    def test_watch_line_renders_fleet_and_rate_bits(self, tmp_path):
+        """The watch/live serve line grows `fleet N host(s)` and
+        `rate-limited M` bits when (and ONLY when — see the
+        kill-switch identity test) the features are on."""
+        from jepsen_tpu.obs import observatory
+        d = _fleet_daemon(tmp_path, rate_limit=100.0)
+        d.start()
+        try:
+            d.placer.stats["remeshes"] = 3
+            d.stats["rate-limited"] = 2
+            d._publish(force=True)
+            p = observatory.read_progress(d.config.root)
+            assert p["serve"]["fleet-hosts"] == 2
+            assert p["serve"]["fleet-live"] == 2
+            line = observatory.format_status(p)
+            assert line.startswith("# serve: ")
+            assert "fleet 2/2 host(s)" in line
+            assert "remesh 3" in line
+            assert "rate-limited 2" in line
+        finally:
+            d.stop()
+
+    def test_healthz_fleet_section(self, tmp_path):
+        d = _fleet_daemon(tmp_path)
+        d.start()
+        try:
+            hz = d.healthz()
+            assert hz["fleet"]["backend"] == "local"
+            assert hz["fleet"]["hosts"] == 2
+            for key in ("gangs", "rounds", "remeshes", "host-losses",
+                        "dcn-retries"):
+                assert hz["fleet"][key] == 0
+        finally:
+            d.stop()

@@ -1397,6 +1397,143 @@ def scenario_serve_batch_poison(seed):
         daemon.stop()
 
 
+def scenario_serve_fleet_host_kill(seed):
+    """A multi-tenant same-bucket burst against a REAL fleet-backed
+    daemon (HTTP, gang scheduler on, 2 real ``ProcHost`` worker
+    processes) with one worker SIGKILLed mid-gang. The placer must
+    detect the loss, re-mesh the gang's lanes onto the survivor at the
+    next merge barrier, and finish: every request answers 200 with a
+    verdict identical to the offline analyze path — ZERO lost verdicts,
+    ZERO poison misclassification, and the breaker counts ZERO failures
+    (the loss is the fleet's to absorb, not the tenants' buckets')
+    (doc/serve.md, "Fleet-backed serving")."""
+    import tempfile
+    import urllib.error
+    import urllib.request
+
+    from jepsen_tpu import serve as serve_ns
+    from jepsen_tpu import web
+    from jepsen_tpu.checker import check_safe
+    from jepsen_tpu.checker.wgl import linearizable
+    from jepsen_tpu.history import History
+
+    root = tempfile.mkdtemp(prefix="jepsen-chaos-servefleet-")
+    all_ops = [[o.to_dict() for o in
+                simulate_register_history(40, n_procs=3, n_vals=3,
+                                          seed=seed + i)]
+               for i in range(3)]
+    offline = [check_safe(linearizable(CASRegister(), backend="tpu"),
+                          {"name": "chaos-fleet-offline"},
+                          History.of(o)) for o in all_ops]
+
+    # small segments force several merge barriers per gang, so the
+    # SIGKILL at round 2 lands MID-gang, not after it
+    os.environ["JTPU_SEGMENT_ITERS"] = "2"
+    cfg = serve_ns.ServeConfig(root=os.path.join(root, "serve"),
+                               backend="tpu", workers=1,
+                               batch_max=8, batch_wait_ms=1000.0,
+                               fleet_hosts=2, fleet_backend="proc")
+    daemon = serve_ns.CheckDaemon(cfg)
+    if daemon.placer is None:
+        return False, "fleet placer unexpectedly disabled"
+    killed = []
+
+    def chaos(round_idx, hosts):
+        if round_idx >= 2 and not killed and hosts[1].alive():
+            os.kill(hosts[1].pid, signal.SIGKILL)
+            killed.append(hosts[1].pid)
+
+    daemon.placer.on_round = chaos
+    daemon.start()
+    server = web.serve(host="127.0.0.1", port=0, root=root,
+                       handler_cls=serve_ns.make_handler(daemon,
+                                                         root=root))
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    port = server.server_port
+
+    def post(doc):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/check",
+            data=json.dumps(doc).encode(), method="POST")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.load(r)
+
+    def get(rid):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/check/{rid}",
+                    timeout=10) as r:
+                return r.status, json.load(r)
+        except urllib.error.HTTPError as e:
+            return e.code, json.load(e)
+
+    details = []
+    try:
+        rids = [post({"tenant": "abc"[i], "model": "cas-register",
+                      "history": o})["id"]
+                for i, o in enumerate(all_ops)]
+        deadline = time.time() + 120
+        docs = {}
+        while time.time() < deadline and len(docs) < 3:
+            for rid in rids:
+                if rid in docs:
+                    continue
+                code, doc = get(rid)
+                if doc.get("state") == "done":
+                    docs[rid] = (code, doc)
+            time.sleep(0.05)
+        if len(docs) != 3:
+            return False, f"only {len(docs)}/3 requests finished"
+        if not killed:
+            return False, "gang finished before the kill round"
+        details.append(f"SIGKILLed worker pid {killed[0]} mid-gang")
+
+        sizes = set()
+        for i, rid in enumerate(rids):
+            code, doc = docs[rid]
+            res = doc["result"]
+            g = (res.get("serve") or {}).get("gang") or {}
+            sizes.add(g.get("size", 0))
+            if g.get("poison"):
+                return False, (f"tenant {doc['tenant']} misclassified "
+                               f"as poison: {res}")
+            if code != 200:
+                return False, (f"tenant {doc['tenant']} answered "
+                               f"{code}, want 200")
+            if res.get("valid") != offline[i].get("valid"):
+                return False, (f"tenant {doc['tenant']}: served "
+                               f"{res.get('valid')!r} != offline "
+                               f"{offline[i].get('valid')!r}")
+        if max(sizes) < 2:
+            return False, (f"no gang formed (sizes {sizes}) — burst "
+                           f"ran serially")
+        details.append(f"gang of {max(sizes)} over 2 proc hosts: all "
+                       f"verdicts == offline")
+
+        st = daemon.placer.stats
+        if st.get("host-losses", 0) < 1 or st.get("remeshes", 0) < 1:
+            return False, (f"no remesh recorded after the kill "
+                           f"(placer stats {st})")
+        details.append(f"re-meshed to survivor ({st['remeshes']} "
+                       f"remesh(es))")
+        if daemon.placer.live() != 1:
+            return False, (f"fleet live={daemon.placer.live()}, want 1")
+        if daemon.stats["poisoned"] != 0:
+            return False, (f"poisoned={daemon.stats['poisoned']}, "
+                           f"want 0")
+        snap = daemon.breaker.snapshot()
+        fails = [r["fails"] for r in snap.values() if r["fails"]]
+        if fails:
+            return False, (f"breaker counted {fails} failures, want "
+                           f"none (snapshot {snap})")
+        details.append("breaker counted 0 failures; 0 poisoned")
+        return True, "; ".join(details)
+    finally:
+        os.environ.pop("JTPU_SEGMENT_ITERS", None)
+        server.shutdown()
+        daemon.stop()
+
+
 SCENARIOS = (
     ("oom", scenario_oom),
     ("wedge", scenario_wedge),
@@ -1414,6 +1551,7 @@ SCENARIOS = (
     ("serve-kill", scenario_serve_kill),
     ("trace-request-kill", scenario_trace_request_kill),
     ("serve-batch-poison", scenario_serve_batch_poison),
+    ("serve-fleet-host-kill", scenario_serve_fleet_host_kill),
 )
 
 

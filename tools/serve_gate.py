@@ -26,7 +26,13 @@ served request. This gate IS that request:
   segment -> verdict), and at least one /metrics histogram bucket
   carries an OpenMetrics exemplar pointing at a trace id;
 * ``POST /drain`` must finish in-flight work and release the daemon
-  (exit-0 contract).
+  (exit-0 contract);
+* a SECOND daemon stands up fleet-backed (``--fleet 2``, two real
+  ``ProcHost`` worker processes): a saturating multi-tenant same-bucket
+  burst must shard over both workers, ``/healthz`` must report
+  ``fleet.live == 2``, every verdict must equal the offline path's, and
+  drain must release it — proving fleet-backed serving survives CI
+  (doc/serve.md, "Fleet-backed serving").
 
 Usage: python tools/serve_gate.py [--budget SECONDS] [--time-limit S]
 Exit code 0 iff the served verdict matches offline within the budget.
@@ -223,6 +229,68 @@ def main() -> int:
     finally:
         server.shutdown()
         daemon.stop()
+
+    # 4. the fleet leg: a second daemon with 2 REAL ProcHost workers;
+    # a saturating multi-tenant burst (more requests than hosts) must
+    # land every verdict, and healthz must show both hosts live
+    from jepsen_tpu.checker import check_safe
+    from jepsen_tpu.checker.wgl import linearizable
+    from jepsen_tpu.history import History
+    from jepsen_tpu.models import CASRegister
+    offline_valid = check_safe(
+        linearizable(CASRegister(), backend="tpu"),
+        {"name": "serve-gate-fleet-offline"},
+        History.of(history)).get("valid")
+    fcfg = serve_ns.ServeConfig(root=os.path.join(root, "serve-fleet"),
+                                backend="tpu", batch_wait_ms=250.0,
+                                fleet_hosts=2, fleet_backend="proc")
+    fdaemon, fserver = serve_ns.run_daemon(
+        fcfg, host="127.0.0.1", port=0, store_root=root)
+    fport = fserver.server_port
+    try:
+        if fdaemon.placer is None:
+            problems.append("fleet daemon built no placer")
+        fburst = []
+        for i in range(6):
+            code, body, _ = _post(fport, "/check",
+                                  {"tenant": f"fleet-{i % 3}",
+                                   "model": "cas-register",
+                                   "history": history})
+            if code == 202:
+                fburst.append(body["id"])
+            else:
+                problems.append(f"fleet POST {i} answered {code}: "
+                                f"{body}")
+        deadline = time.time() + args.budget
+        pending = list(fburst)
+        while time.time() < deadline and pending:
+            pending = [r for r in pending
+                       if _get(fport, f"/check/{r}")[1].get("state")
+                       != "done"]
+            time.sleep(0.05)
+        if pending:
+            problems.append(f"{len(pending)} fleet request(s) never "
+                            f"finished")
+        for r in fburst:
+            if r in pending:
+                continue
+            _, doc = _get(fport, f"/check/{r}")
+            got = doc.get("result", {}).get("valid")
+            if got != offline_valid:
+                problems.append(f"fleet verdict {got!r} != offline "
+                                f"{offline_valid!r}")
+        _, fhealth = _get(fport, "/healthz")
+        fl = fhealth.get("fleet", {})
+        if fl.get("live") != 2 or fl.get("hosts") != 2:
+            problems.append(f"healthz fleet {fl}, want 2/2 proc hosts")
+        if not fl.get("gangs"):
+            problems.append(f"fleet dispatched no gang: {fl}")
+        code, drained, _ = _post(fport, "/drain", None)
+        if code != 200 or not drained.get("drained"):
+            problems.append(f"fleet drain answered {code}: {drained}")
+    finally:
+        fserver.shutdown()
+        fdaemon.stop()
 
     wall = time.time() - t0
     if wall > args.budget:
