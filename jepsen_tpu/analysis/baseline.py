@@ -23,6 +23,19 @@ from jepsen_tpu.analysis import Finding, repo_root
 BASELINE_NAME = "lint.baseline"
 _SEP = " — "  # " — "
 
+#: The justification placeholder ``--write-baseline`` emits for new
+#: entries. It marks an acceptance nobody has reviewed yet: ``lint
+#: --strict`` refuses to treat such an entry as a real acceptance
+#: (see :func:`stubbed`).
+STUB = "TODO: justify this acceptance"
+
+
+def stubbed(baseline: Dict[str, str]) -> List[str]:
+    """Keys whose justification is missing or still the TODO stub —
+    acceptances that were never actually reviewed."""
+    return sorted(k for k, just in baseline.items()
+                  if not just or just.startswith("TODO"))
+
 
 def default_path(root: Optional[str] = None) -> str:
     return os.path.join(root or repo_root(), BASELINE_NAME)
@@ -71,7 +84,7 @@ def render(findings: Iterable[Finding],
         "",
     ]
     for f in sorted(set(x.key() for x in findings)):
-        just = justifications.get(f, "TODO: justify this acceptance")
+        just = justifications.get(f) or STUB
         lines.append(f"{f}{_SEP}{just}")
     return "\n".join(lines) + "\n"
 

@@ -1079,6 +1079,21 @@ def _summarize_carry(carry) -> tuple:
             (carry[10], carry[11], carry[12]))
 
 
+def _reopen_carry(carry: tuple, n_required: int) -> tuple:
+    """Clear a carry's ``done`` flag so a finished search continues over
+    an EXTENDED history (the streaming online check, doc/serve.md
+    "Streaming API"). ``done`` was latched by the device test
+    ``fk >= n_required`` against the OLD required count; with more
+    required ops appended past every packed row the same frontier
+    configurations are exactly valid for the longer prefix — stable-
+    prefix extension appends rows strictly after every existing return
+    index, so masks, cmask, states and the pool all transfer unchanged.
+    The level/best counters keep counting (that continuity is what the
+    crash-resume chaos assertion reads)."""
+    done = np.bool_(n_required == 0)
+    return carry[:5] + (done,) + carry[6:]
+
+
 def _fleet_hosts() -> int:
     """The JTPU_FLEET opt-in: N >= 2 routes single-history searches
     through the elastic fleet scheduler (jepsen_tpu.fleet) over an

@@ -365,6 +365,164 @@ def serve_cmd() -> dict:
     return {"serve": {"parser": build_parser, "run": run}}
 
 
+def stream_cmd() -> dict:
+    """The 'stream' subcommand: a reference client for the daemon's
+    chunked streaming intake (doc/serve.md "Streaming API"). Reads a
+    saved run (or a raw history file), opens a stream session, POSTs
+    the ops as CRC-tagged sequenced chunks — honoring 429 backpressure
+    (Retry-After) and resynchronizing on 409 gap responses via the
+    ``need`` cursor — seals it, then polls until the online checker
+    delivers the verdict. Exit codes follow the test contract."""
+
+    def build_parser():
+        p = Parser(prog="stream",
+                   description="Stream a history into a check daemon's "
+                               "/stream intake and await the verdict.")
+        p.add_argument("--url", default="http://127.0.0.1:8080",
+                       help="daemon base URL")
+        p.add_argument("--store", default=None,
+                       help="store directory whose history.jsonl to "
+                            "stream (default: latest under ./store)")
+        p.add_argument("--history", default=None, metavar="FILE",
+                       help="raw history file (JSON array or JSONL of "
+                            "op maps) instead of --store")
+        p.add_argument("--model", default="cas-register",
+                       choices=list(MODEL_CHOICES))
+        p.add_argument("--tenant", default="default")
+        p.add_argument("--chunk", type=int, default=1000,
+                       help="ops per chunk")
+        p.add_argument("--auth-token", default=None, metavar="TOKEN",
+                       help="Authorization: Bearer TOKEN")
+        p.add_argument("--poll", type=float, default=0.5,
+                       help="verdict poll interval (seconds)")
+        p.add_argument("--timeout", type=float, default=600.0,
+                       help="overall client budget (seconds)")
+        return p
+
+    def run_(opts) -> int:
+        import json as _json
+        import time as _time
+        import urllib.error
+        import urllib.request
+
+        from jepsen_tpu import stream as stream_ns
+
+        base = opts["url"].rstrip("/")
+
+        def call(method, path, doc=None):
+            req = urllib.request.Request(
+                base + path, method=method,
+                data=None if doc is None
+                else _json.dumps(doc).encode(),
+                headers={"Content-Type": "application/json"})
+            if opts.get("auth_token"):
+                req.add_header("Authorization",
+                               f"Bearer {opts['auth_token']}")
+            try:
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    return r.status, _json.loads(r.read() or b"{}")
+            except urllib.error.HTTPError as e:
+                try:
+                    body = _json.loads(e.read() or b"{}")
+                except ValueError:
+                    body = {}
+                return e.code, body
+
+        # -- load the ops -------------------------------------------------
+        if opts.get("history"):
+            with open(opts["history"]) as f:
+                text = f.read().strip()
+            if text.startswith("["):
+                ops = _json.loads(text)
+            else:
+                ops = [_json.loads(ln) for ln in text.splitlines() if ln]
+        else:
+            from jepsen_tpu import repl, store
+            test = (store.load(opts["store"]) if opts.get("store")
+                    else repl.last_test())
+            if test is None:
+                print("no stored test found", file=sys.stderr)
+                return INVALID_ARGS
+            ops = [op.to_dict() if hasattr(op, "to_dict") else dict(op)
+                   for op in (test.get("history") or [])]
+        if not ops:
+            print("history is empty; nothing to stream", file=sys.stderr)
+            return INVALID_ARGS
+
+        deadline = _time.monotonic() + opts["timeout"]
+
+        def budget() -> float:
+            left = deadline - _time.monotonic()
+            if left <= 0:
+                raise TimeoutError("stream client budget exhausted")
+            return left
+
+        # -- open ---------------------------------------------------------
+        code, body = call("POST", "/stream",
+                          {"tenant": opts["tenant"],
+                           "model": opts["model"]})
+        while code == 429:
+            _time.sleep(min(float(body.get("retry-after-s") or 1.0),
+                            budget()))
+            code, body = call("POST", "/stream",
+                              {"tenant": opts["tenant"],
+                               "model": opts["model"]})
+        if code != 202:
+            print(f"open failed: HTTP {code} {body}", file=sys.stderr)
+            return CRASHED
+        sid = body["id"]
+        n_chunk = max(1, opts["chunk"])
+        chunks = [ops[i:i + n_chunk] for i in range(0, len(ops), n_chunk)]
+        print(f"# stream: {sid} -> {base} ({len(ops)} ops in "
+              f"{len(chunks)} chunk(s) of <= {n_chunk})")
+
+        # -- append: sequenced, CRC'd, gap/backpressure aware -------------
+        seq = 0
+        while seq < len(chunks):
+            payload = {"seq": seq, "ops": chunks[seq],
+                       "crc": stream_ns.chunk_crc(chunks[seq])}
+            code, body = call("POST", f"/stream/{sid}/ops", payload)
+            if code == 202:
+                seq += 1
+            elif code == 429:
+                _time.sleep(min(float(body.get("retry-after-s") or 1.0),
+                                budget()))
+            elif code == 409 and body.get("error") == "gap":
+                # resynchronize on the server's cursor (idempotent
+                # chunks make the re-send safe)
+                seq = int(body["need"])
+            elif code == 409 and body.get("error") == "stream-failed":
+                # the online checker refuted a stable prefix mid-stream
+                # (fail-fast); the verdict is already decided
+                print(f"# stream: {sid} failed fast at chunk {seq}; "
+                      f"awaiting verdict")
+                seq = len(chunks)
+                break
+            else:
+                print(f"chunk {seq} failed: HTTP {code} {body}",
+                      file=sys.stderr)
+                return CRASHED
+            budget()
+
+        # -- close + await verdict ----------------------------------------
+        code, body = call("POST", f"/stream/{sid}/close",
+                          {"chunks": len(chunks)})
+        if code not in (200, 202) and body.get("error") != "stream-failed":
+            print(f"close failed: HTTP {code} {body}", file=sys.stderr)
+            return CRASHED
+        while True:
+            code, body = call("GET", f"/stream/{sid}")
+            if code == 200 and body.get("state") == "done" \
+                    and body.get("result") is not None:
+                break
+            _time.sleep(min(opts["poll"], budget()))
+        result = body["result"]
+        print(_json.dumps(result, indent=2, default=repr))
+        return OK if result.get("valid") is True else TEST_FAILED
+
+    return {"stream": {"parser": build_parser, "run": run_}}
+
+
 def suite_run_cmd() -> dict:
     """The 'run' subcommand: run any registered suite by name — possible
     here because all suites live in one package (the reference spreads
@@ -546,6 +704,16 @@ def analyze_cmd() -> dict:
         sline = _search_analytics_line(out)
         if sline:
             print(sline)
+        # executor leakage: threads with_op_timeout abandoned (still
+        # alive as daemons) in THIS process — nonzero in long soak
+        # sessions that run + analyze in one interpreter, and the
+        # motivation for the bounded-executor driver mode
+        # (test["driver-threads"])
+        from jepsen_tpu import core as core_ns
+        leaked = core_ns.abandoned_threads()
+        if leaked:
+            print(f"# leaked-threads: {leaked} hung client-op thread(s) "
+                  f"abandoned by op-timeout and still resident")
         print(_json.dumps(out, indent=2, default=repr))
         return OK if out.get("valid") is True else TEST_FAILED
 
@@ -1188,6 +1356,20 @@ def lint_cmd() -> dict:
         gate = [f for f in new
                 if f.severity == "error"
                 or (opts.get("strict") and f.severity == "warning")]
+        if opts.get("strict") and accepted:
+            # an acceptance whose justification is still the
+            # --write-baseline TODO stub was never reviewed; strict
+            # mode refuses to let it suppress a finding
+            stub_keys = set(bl.stubbed(accepted_keys))
+            unjustified = sorted({f.key() for f in accepted}
+                                 & stub_keys)
+            if unjustified:
+                for key in unjustified:
+                    print(f"# lint: --strict: baseline entry {key!r} "
+                          f"still carries the stub justification "
+                          f"({bl.STUB!r}); replace it with a real "
+                          f"reason in {bpath}", file=sys.stderr)
+                gate = gate or unjustified
         return TEST_FAILED if gate else OK
 
     return {"lint": {"parser": build_parser, "run": run_}}
@@ -1401,11 +1583,13 @@ def main(subcommands: Dict[str, dict],
 
 def default_commands() -> dict:
     """The stock subcommand set: runner + analyzer + recovery + linter
-    + plan verifier + trace tooling + live watch + server + verdict
-    explainer (what ``python -m jepsen_tpu`` dispatches)."""
+    + plan verifier + trace tooling + live watch + server + streaming
+    client + verdict explainer (what ``python -m jepsen_tpu``
+    dispatches)."""
     return merge_commands(suite_run_cmd(), analyze_cmd(), recover_cmd(),
                           lint_cmd(), plan_cmd(), trace_cmd(),
-                          watch_cmd(), serve_cmd(), explain_cmd())
+                          watch_cmd(), serve_cmd(), stream_cmd(),
+                          explain_cmd())
 
 
 if __name__ == "__main__":  # default main

@@ -53,6 +53,30 @@ _OP_CRASHES = obs_metrics.counter(
 _NEMESIS_WEDGED = obs_metrics.counter(
     "jtpu_nemesis_wedged_total",
     "nemesis threads abandoned at the run's join deadline")
+_ABANDONED_THREADS = obs_metrics.gauge(
+    "jtpu_abandoned_threads",
+    "hung client-op threads abandoned by with_op_timeout and still "
+    "leaked in the process")
+_abandoned_lock = threading.Lock()
+_abandoned_n = 0
+
+
+def _note_abandoned_thread() -> int:
+    """Count a with_op_timeout leak. The daemonized thread is never
+    joined, so the count only grows — which is the point: long soak
+    runs read it (``# leaked-threads:`` in analyze) to see executor
+    leakage that per-op counters hide."""
+    global _abandoned_n
+    with _abandoned_lock:
+        _abandoned_n += 1
+        _ABANDONED_THREADS.set(_abandoned_n)
+        return _abandoned_n
+
+
+def abandoned_threads() -> int:
+    """Hung op threads abandoned (not joined) so far in this process."""
+    with _abandoned_lock:
+        return _abandoned_n
 
 
 class OpTimeout(Exception):
@@ -78,6 +102,7 @@ def with_op_timeout(seconds: float, f, *args):
     out = timeout(seconds * 1000.0, _OP_TIMED_OUT, f, *args)
     if out is _OP_TIMED_OUT:
         _OP_TIMEOUTS.inc()
+        _note_abandoned_thread()
         raise OpTimeout(f"operation exceeded the {seconds}s op-timeout; "
                         f"treating it as indeterminate")
     return out
@@ -212,6 +237,94 @@ class Worker:
         return test["client"].open(test, self.node())
 
 
+class _BoundedWorker(Worker):
+    """A logical process as a schedulable state machine instead of a
+    dedicated OS thread — the bounded-executor driver mode
+    (``test["driver-threads"]``) that lets one host sustain thousands of
+    logical processes feeding a stream (doc/serve.md "Streaming API").
+    Same invariants as :class:`Worker`: pinned node, ok/fail continue,
+    info/throw reincarnates as ``p + concurrency`` on a fresh client."""
+
+    def __init__(self, test: dict, thread_id: int):
+        super().__init__(test, barrier=None, thread_id=thread_id)
+        self.client = None
+        self.done = False
+
+    def open(self) -> None:
+        self.client = self.test["client"].open(self.test, self.node())
+
+    def step(self) -> bool:
+        """Pull one op from the generator and drive it to completion.
+        False when the generator is exhausted for this process."""
+        op = gen.op_and_validate(self.test["generator"], self.test,
+                                 self.process)
+        if op is None:
+            return False
+        op = _fill_op(self.test, op, self.process)
+        conj_op(self.test, op)
+        self.client = self._invoke_and_complete(self.client, op)
+        return True
+
+    def close(self) -> None:
+        try:
+            if self.client is not None:
+                self.client.close(self.test)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def _run_bounded(test: dict, n: int, k: int) -> None:
+    """Drive ``n`` logical processes on ``k`` pool threads: round-robin
+    scheduling through a work queue, so every process makes progress and
+    no process's ops reorder (a logical process is only ever on one pool
+    thread at a time — the queue hands it out and takes it back). The
+    first worker error stops scheduling, closes every client, and
+    re-raises — matching the threaded mode's crash propagation."""
+    import queue as queue_mod
+    workers = [_BoundedWorker(test, i) for i in range(n)]
+    for w in workers:
+        w.open()
+    work: queue_mod.Queue = queue_mod.Queue()
+    for w in workers:
+        work.put(w)
+    stop = threading.Event()
+    errors: List[BaseException] = []
+    err_lock = threading.Lock()
+
+    def pool_loop() -> None:
+        with gen.threads_bound(gen.all_threads(test)):
+            while not stop.is_set():
+                try:
+                    w = work.get_nowait()
+                except queue_mod.Empty:
+                    return
+                try:
+                    alive = w.step()
+                except Exception as e:  # noqa: BLE001
+                    with err_lock:
+                        errors.append(e)
+                    stop.set()
+                    log.error("Bounded worker %s crashed: %s", w.thread,
+                              traceback.format_exc())
+                    return
+                if alive:
+                    work.put(w)
+                else:
+                    w.done = True
+
+    threads = [threading.Thread(target=pool_loop, daemon=True,
+                                name=f"jepsen-driver-{i}")
+               for i in range(k)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for w in workers:
+        w.close()
+    if errors:
+        raise errors[0]
+
+
 def _probe_heal(test: dict, nemesis, op: Op) -> None:
     """Post-fault convergence probe: after a heal-class nemesis op
     completes, run the nemesis's ``heal_probe`` (if configured) and
@@ -307,18 +420,22 @@ def _run_case(test: dict) -> History:
         with obs.span("core.workload",
                       concurrency=test["concurrency"]):
             n = test["concurrency"]
-            barrier = threading.Barrier(n)
-            workers = [Worker(test, barrier, i) for i in range(n)]
-            threads = [threading.Thread(target=w.run, daemon=True,
-                                        name=f"jepsen-worker-{i}")
-                       for i, w in enumerate(workers)]
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join()
-            for w in workers:
-                if w.error is not None:
-                    raise w.error
+            k = int(test.get("driver-threads") or 0)
+            if 0 < k < n:
+                _run_bounded(test, n, k)
+            else:
+                barrier = threading.Barrier(n)
+                workers = [Worker(test, barrier, i) for i in range(n)]
+                threads = [threading.Thread(target=w.run, daemon=True,
+                                            name=f"jepsen-worker-{i}")
+                           for i, w in enumerate(workers)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                for w in workers:
+                    if w.error is not None:
+                        raise w.error
     finally:
         # This block is the run's safety net: it executes whether the
         # main phase finished cleanly or a worker raised above, so

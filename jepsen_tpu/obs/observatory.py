@@ -350,6 +350,16 @@ def format_status(p: Optional[Dict[str, Any]]) -> str:
             bits.append(fbit)
         if s.get("rate-limited") is not None:
             bits.append(f"rate-limited {s['rate-limited']}")
+        if s.get("streams") is not None:
+            # streaming intake (doc/serve.md "Streaming API"): live
+            # sessions, intake vs online-checker progress, and the
+            # backpressure signal (buffered ops not yet searched)
+            sbit = (f"streams {s['streams']} "
+                    f"({s.get('stream-checked', 0)}/"
+                    f"{s.get('stream-ops', 0)} ops checked)")
+            if s.get("stream-lag"):
+                sbit += f" | stream-lag {s['stream-lag']}"
+            bits.append(sbit)
         if s.get("warm-buckets") is not None:
             bits.append(f"warm {s['warm-buckets']} bucket(s)")
         if p.get("state") and p["state"] != "serving":

@@ -252,6 +252,207 @@ def pack_with_init(history: Sequence[Op], model,
     return packed, kernel
 
 
+class StreamPacker:
+    """Append-mode packer for streaming ingestion (doc/serve.md
+    "Streaming API"): feed raw ops one chunk at a time and read back, at
+    any barrier, the packed encoding of the current *stable prefix* —
+    the longest event prefix in which every invoked op also completed.
+
+    The stable prefix is what makes an online check sound: no op spans
+    its boundary, so every required op of a longer stable prefix sorts
+    strictly after every required op of a shorter one (old returns <
+    watermark <= new invocations), and the packed columns of the longer
+    prefix literally extend the shorter — the device search carry
+    transfers across extension (checker.tpu._reopen_carry). The walk is
+    pack_history's, one event at a time: fail pairs dropped, crashed
+    reads (and kernel.drop_crashed ops) dropped, values interned at
+    completion events, processes densely remapped in sorted-row order —
+    so :meth:`close` yields arrays identical to a one-shot
+    ``pack_history`` over the same op sequence.
+
+    A crashed ('info') op pins the watermark forever: it stays pending
+    in real time, so no later prefix is complete. Everything after the
+    first crash is checked at close, where crashed ops become the
+    crashed section exactly like the offline walk.
+    """
+
+    def __init__(self, kernel: KernelSpec,
+                 init_state: Optional[int] = None,
+                 intern: Optional[_Interner] = None):
+        self.kernel = kernel
+        self.intern = intern or _Interner()
+        self.init_state = (kernel.init_state if init_state is None
+                           else init_state)
+        if kernel.encode_op is not None:
+            self._encode = (lambda fc, f, iv, ov:
+                            kernel.encode_op(fc, f, iv, ov,
+                                             self.intern.id))
+        else:
+            self._encode = (lambda fc, f, iv, ov:
+                            _op_values(fc, f, iv, ov, self.intern))
+        self._ev = 0
+        self._pending: Dict[Any, Tuple[int, Op]] = {}
+        self._rows: list = []       # completed rows, (ret, inv)-sorted
+        self._crashed: list = []    # info rows, info-event order
+        self._procs: Dict[Any, int] = {}
+        self._proc_col: List[int] = []
+        self._watermark = 0         # stable-prefix event count
+        self._watermark_rows = 0    # len(_rows) at the watermark
+        self._forever_open = 0      # crashed ops pin the watermark
+        self._closed = False
+        self._final: Optional[PackedHistory] = None
+
+    # -- intake -------------------------------------------------------------
+
+    @property
+    def n_events(self) -> int:
+        return self._ev
+
+    @property
+    def watermark(self) -> int:
+        """Event count of the stable prefix (monotone non-decreasing)."""
+        return self._watermark
+
+    @property
+    def stable_required(self) -> int:
+        """Required-op count of the stable prefix — what the online
+        search's traced ``n_required`` scalar advances to."""
+        return self._watermark_rows
+
+    @property
+    def online_ok(self) -> bool:
+        """Whether the stable prefix may be checked online: a kernel
+        with a global remap (e.g. the queue's value-slot interval
+        coloring) re-colors on every extension, so its packing is only
+        final at close."""
+        return self.kernel.remap is None
+
+    def feed(self, op: Op) -> None:
+        """One event — the exact pack_history walk, incrementally."""
+        if self._closed:
+            raise ValueError("stream packer is closed")
+        kernel = self.kernel
+        ev = self._ev
+        self._ev += 1
+        if op.is_invoke:
+            self._pending[op.process] = (ev, op)
+        elif op.process in self._pending:
+            inv_ev, inv_op = self._pending.pop(op.process)
+            if op.is_fail:
+                pass  # known not to have happened
+            else:
+                fc = kernel.f_codes.get(inv_op.f)
+                if fc is None:
+                    raise ValueError(
+                        f"op f={inv_op.f!r} not supported by model "
+                        f"{kernel.name!r} (codes: "
+                        f"{sorted(kernel.f_codes)})")
+                if op.is_info:
+                    if fc == F_READ or (
+                            kernel.drop_crashed is not None
+                            and kernel.drop_crashed(fc, inv_op.value)):
+                        pass  # constrains nothing — dropped
+                    else:
+                        v1, v2 = self._encode(fc, inv_op.f,
+                                              inv_op.value, None)
+                        self._crashed.append(
+                            (inv_ev, int(RET_INF), fc, v1, v2,
+                             inv_op.process, inv_op, op))
+                        self._forever_open += 1
+                else:  # ok — completions arrive in return-index order
+                    v1, v2 = self._encode(fc, inv_op.f, inv_op.value,
+                                          op.value)
+                    self._rows.append((inv_ev, ev, fc, v1, v2,
+                                       inv_op.process, inv_op, op))
+                    prc = inv_op.process
+                    if prc not in self._procs:
+                        self._procs[prc] = len(self._procs)
+                    self._proc_col.append(self._procs[prc])
+        # the boundary after this event is stable iff no op spans it:
+        # nothing pending, and no crashed op (pending forever) seen
+        if not self._pending and not self._forever_open:
+            self._watermark = self._ev
+            self._watermark_rows = len(self._rows)
+
+    def feed_ops(self, ops: Sequence[Any]) -> None:
+        for o in ops:
+            self.feed(o if isinstance(o, Op) else Op.from_dict(o))
+
+    # -- read side ----------------------------------------------------------
+
+    def stable_packed(self) -> PackedHistory:
+        """The packed stable prefix: required ops only (zero crashed by
+        construction), array-identical to ``pack_history`` over the
+        watermark's event prefix. Raises ValueError for remap kernels —
+        their packing is only final at close (see :attr:`online_ok`)."""
+        if not self.online_ok:
+            raise ValueError(
+                f"kernel {self.kernel.name!r} remaps value slots "
+                f"globally; the stable prefix cannot be packed online")
+        k = self._watermark_rows
+        rows = self._rows[:k]
+
+        def col(i):
+            return (np.asarray([r[i] for r in rows], np.int32)
+                    if rows else np.zeros(0, np.int32))
+
+        p = PackedHistory(
+            f=col(2), v1=col(3), v2=col(4), inv=col(0), ret=col(1),
+            process=(np.asarray(self._proc_col[:k], np.int32)
+                     if rows else np.zeros(0, np.int32)),
+            n_required=k, init_state=self.init_state,
+            value_table=self.intern.values,
+            ops=[(r[6], r[7]) for r in rows])
+        if self.kernel.validate is not None:
+            self.kernel.validate(p)  # ValueError -> online unsupported
+        return p
+
+    def close(self) -> PackedHistory:
+        """Seal the stream. Dangling invocations become crashed ops,
+        crashed rows merge in (ret, inv) order, and the kernel
+        remap/validate hooks run — the result is identical to a
+        one-shot ``pack_history`` over the full op sequence."""
+        if self._final is not None:
+            return self._final
+        self._closed = True
+        kernel = self.kernel
+        for inv_ev, inv_op in self._pending.values():
+            fc = kernel.f_codes.get(inv_op.f)
+            if fc is None or fc == F_READ or (
+                    kernel.drop_crashed is not None
+                    and kernel.drop_crashed(fc, inv_op.value)):
+                continue
+            v1, v2 = self._encode(fc, inv_op.f, inv_op.value, None)
+            self._crashed.append((inv_ev, int(RET_INF), fc, v1, v2,
+                                  inv_op.process, inv_op, None))
+        self._crashed.sort(key=lambda r: (r[1], r[0]))
+        rows = self._rows + self._crashed
+        proc_col = list(self._proc_col)
+        for r in self._crashed:
+            prc = r[5]
+            if prc not in self._procs:
+                self._procs[prc] = len(self._procs)
+            proc_col.append(self._procs[prc])
+
+        def col(i):
+            return (np.asarray([r[i] for r in rows], np.int32)
+                    if rows else np.zeros(0, np.int32))
+
+        packed = PackedHistory(
+            f=col(2), v1=col(3), v2=col(4), inv=col(0), ret=col(1),
+            process=(np.asarray(proc_col, np.int32) if rows
+                     else np.zeros(0, np.int32)),
+            n_required=len(self._rows), init_state=self.init_state,
+            value_table=self.intern.values,
+            ops=[(r[6], r[7]) for r in rows])
+        if kernel.remap is not None:
+            kernel.remap(packed)
+        if kernel.validate is not None:
+            kernel.validate(packed)
+        self._final = packed
+        return packed
+
+
 def pack_keyed_histories(keyed: Dict[Any, Sequence[Op]],
                          kernel: KernelSpec) -> Tuple[list, dict]:
     """Pack a {key: history} map (the independent-key axis, reference

@@ -361,6 +361,12 @@ class Checkpoint:
     expand_eff: Optional[int]
     crash_width: int
     segment: int                     # segments completed so far
+    #: Streaming partial-verdict metadata (doc/serve.md "Streaming
+    #: API"): the event-index watermark of the stable prefix this carry
+    #: has searched, and that prefix's required-op count. -1 on offline
+    #: checkpoints — pre-streaming .npz files keep loading unchanged.
+    watermark: int = -1
+    n_required: int = -1
 
     @property
     def capacity_eff(self) -> int:
@@ -378,11 +384,19 @@ class Checkpoint:
             expand_eff=np.int64(-1 if self.expand_eff is None
                                 else self.expand_eff),
             crash_width=np.int64(self.crash_width),
-            segment=np.int64(self.segment))
+            segment=np.int64(self.segment),
+            watermark=np.int64(self.watermark),
+            n_required=np.int64(self.n_required))
         names = CARRY_FIELDS + (CARRY_STATS_FIELD,)
         arrays = {f"carry_{n}": np.asarray(v)
                   for n, v in zip(names, self.carry)}
-        np.savez(path, **meta, **arrays)
+        # tmp+replace: a crash mid-save must leave the PREVIOUS
+        # checkpoint readable — the streaming daemon saves one per
+        # segment and a torn .npz would demote a crash-resume to a
+        # level-0 restart (doc/resilience.md)
+        tmp = f"{path}.tmp.{os.getpid()}.npz"
+        np.savez(tmp, **meta, **arrays)
+        os.replace(tmp, path)
 
     @classmethod
     def load(cls, path: str) -> "Checkpoint":
@@ -403,7 +417,11 @@ class Checkpoint:
             return cls(carry=carry, rung=rung, window=int(z["window"]),
                        expand_eff=None if exp < 0 else exp,
                        crash_width=int(z["crash_width"]),
-                       segment=int(z["segment"]))
+                       segment=int(z["segment"]),
+                       watermark=(int(z["watermark"])
+                                  if "watermark" in z.files else -1),
+                       n_required=(int(z["n_required"])
+                                   if "n_required" in z.files else -1))
 
 
 def _shrink_carry(carry: tuple, new_cap: int) -> tuple:
@@ -436,6 +454,23 @@ def _fit_carry_stats(carry: tuple, stats: bool, lmax: int) -> tuple:
     if not stats and len(carry) > 13:
         return carry[:13]
     return carry
+
+
+def _grow_carry_stats(carry: tuple, lmax: int) -> tuple:
+    """Re-pad an existing stats lane to a LARGER level budget: streaming
+    extension grows the packed prefix between segments, and the level
+    budget (and so the lane's row count) grows with it. Rows already
+    counted ride through unchanged — the per-level counter record is
+    exactly what the crash-resume chaos assertion reads."""
+    if len(carry) <= 13:
+        return carry
+    slog = np.asarray(carry[13])
+    rows = lmax + 1
+    if slog.shape[0] >= rows:
+        return carry
+    grown = np.zeros((rows, slog.shape[1]), np.int32)
+    grown[:slog.shape[0]] = slog
+    return carry[:13] + (grown,)
 
 
 # ---------------------------------------------------------------------------

@@ -303,6 +303,39 @@ class ServeConfig:
     engine_headroom_min: float = field(
         default_factory=lambda: _env_float(
             "JTPU_ENGINE_HEADROOM_MIN", 0.0))
+    # -- streaming ingestion (doc/serve.md "Streaming API") -----------------
+    #: Kill switch for the /stream routes and the online checker
+    #: (JTPU_SERVE_STREAM). Off leaves the daemon byte-identical to the
+    #: non-streaming build: no routes, no streams/ dir, no WAL record
+    #: kinds, no progress/healthz keys (see :attr:`stream_on`).
+    stream_enabled: bool = field(
+        default_factory=lambda: os.environ.get(
+            "JTPU_SERVE_STREAM", "1").strip().lower()
+        not in ("0", "false", "no", "off"))
+    #: Bounded reorder window: how far ahead of the next contiguous
+    #: sequence number a chunk may arrive and still be buffered; past
+    #: it the append is a 409 with a ``need=<seq>`` hint.
+    stream_reorder: int = field(
+        default_factory=lambda: _env_int("JTPU_SERVE_STREAM_REORDER", 64))
+    #: Backpressure: max ops buffered ahead of the checked stable
+    #: prefix before appends answer 429 + Retry-After.
+    stream_buffer_ops: int = field(
+        default_factory=lambda: _env_int(
+            "JTPU_SERVE_STREAM_BUFFER", 250000))
+    #: Max concurrently open stream sessions (each owns a runner
+    #: thread); opens past it answer 429 + Retry-After.
+    stream_max: int = field(
+        default_factory=lambda: _env_int("JTPU_SERVE_STREAM_MAX", 8))
+
+    @property
+    def stream_on(self) -> bool:
+        """Whether the streaming routes exist. Read at call time so
+        JTPU_SERVE_STREAM=0 wins even against an explicitly configured
+        ``stream_enabled`` — the same kill-switch discipline as
+        :attr:`fleet_enabled`."""
+        if os.environ.get("JTPU_SERVE_STREAM", "").strip() == "0":
+            return False
+        return bool(self.stream_enabled)
 
     @property
     def fleet_enabled(self) -> bool:
@@ -757,6 +790,13 @@ class CheckDaemon:
         # exactly as before
         self.placer = (FleetPlacer(self.config)
                        if self.config.fleet_enabled else None)
+        # JTPU_SERVE_STREAM kill switch: None means the /stream routes
+        # 404, jepsen_tpu.stream is never imported, no streams/ dir or
+        # WAL record kinds or progress/healthz keys exist — the PR-9/16
+        # byte-identity discipline (tests/test_stream.py asserts it)
+        self._streams: Optional[Dict[str, Any]] = (
+            {} if self.config.stream_on else None)
+        self._stream_seq = 0
         self._progress_last = 0.0
 
     # -- model / planning helpers -------------------------------------------
@@ -1484,6 +1524,8 @@ class CheckDaemon:
                 self.journal.append({"event": "dropped",
                                      "id": doc.get("id"),
                                      "reason": body.get("error")})
+        if self._streams is not None:
+            self._stream_replay()
         for i in range(max(1, self.config.workers)):
             t = threading.Thread(target=self._worker_loop, daemon=True,
                                  name=f"jtpu-serve-worker-{i}")
@@ -1507,6 +1549,16 @@ class CheckDaemon:
                 if not self._inflight:
                     break
             time.sleep(0.05)
+        # sealed streams owe a verdict before the drain completes; open
+        # streams stay journaled for the next incarnation to resume
+        if self._streams is not None:
+            while time.monotonic() < deadline:
+                with self._lock:
+                    finishing = [s for s in self._streams.values()
+                                 if s.state == "closed"]
+                if not finishing:
+                    break
+                time.sleep(0.05)
         with self._lock:
             inflight = len(self._inflight)
         self._publish(force=True, state="drained")
@@ -1521,6 +1573,16 @@ class CheckDaemon:
             self._work.notify_all()
         for t in self._threads:
             t.join(timeout=2.0)
+        if self._streams is not None:
+            with self._lock:
+                sessions = list(self._streams.values())
+            for s in sessions:
+                if s.runner is not None:
+                    s.runner.stop()
+            for s in sessions:
+                if s.runner is not None:
+                    s.runner.join(timeout=2.0)
+                s.stop_wal()
         if self.placer is not None:
             self.placer.stop()
         self.journal.close()
@@ -1531,6 +1593,177 @@ class CheckDaemon:
             # close a sink a newer daemon (or a run) attached since
             tr.detach()
         self._publish(force=True, state="stopped")
+
+    # -- streaming ingestion (doc/serve.md "Streaming API") -----------------
+    # Everything here is behind the JTPU_SERVE_STREAM kill switch: when
+    # self._streams is None the handler never reaches these methods and
+    # jepsen_tpu.stream is never imported.
+
+    def _make_runner(self, session) -> Any:
+        from jepsen_tpu import stream as stream_mod
+        model = self._models().get(session.model)
+        runner = stream_mod.StreamRunner(
+            session, model() if model is not None else None,
+            backend=self.config.backend,
+            on_done=self._on_stream_done)
+        session.runner = runner
+        return runner
+
+    def stream_open(self, doc: Dict[str, Any]
+                    ) -> Tuple[int, Dict[str, Any], Dict[str, str]]:
+        """POST /stream: open a session. Mirrors submit's admission
+        shape — draining 503, unknown model 400, session quota 429 with
+        a fleet-aware Retry-After — and mints the trace id the whole
+        stream (chunks, segments, verdict) will carry."""
+        from jepsen_tpu import stream as stream_mod
+        if self.draining:
+            return 503, {"error": "draining"}, {"Retry-After": "30"}
+        tenant = str(doc.get("tenant") or "default")
+        model_name = str(doc.get("model") or "cas-register")
+        if model_name not in self._models():
+            return 400, {"error": "bad-request",
+                         "detail": f"unknown model {model_name!r}"}, {}
+        with self._lock:
+            live = sum(1 for s in self._streams.values()
+                       if s.state != "done")
+        if live >= self.config.stream_max:
+            retry = self._retry_after()
+            return 429, {"error": "stream-quota", "open": live,
+                         "retry-after-s": round(retry, 3)}, \
+                {"Retry-After": str(max(1, int(round(retry))))}
+        with self._lock:
+            self._stream_seq += 1
+            sid = f"s{self._stream_seq:06d}-{os.getpid()}"
+        trace_id, trace_parent = None, None
+        if obs_trace.enabled():
+            tp = obs_trace.parse_traceparent(doc.get("traceparent"))
+            if tp is not None:
+                trace_id, trace_parent = tp
+            else:
+                trace_id = obs_trace.new_trace_id()
+        session = stream_mod.StreamSession(
+            sid, tenant, model_name, self.config.root,
+            reorder_max=self.config.stream_reorder,
+            trace=trace_id, trace_parent=trace_parent)
+        runner = self._make_runner(session)
+        with self._lock:
+            self._streams[sid] = session
+        runner.start()
+        self._publish()
+        body = {"id": sid, "state": "open", "tenant": tenant,
+                "model": model_name}
+        hdrs: Dict[str, str] = {}
+        if trace_id:
+            body["trace"] = trace_id
+            hdrs["traceparent"] = obs_trace.format_traceparent(trace_id)
+        return 202, body, hdrs
+
+    def _stream_session(self, sid: str):
+        with self._lock:
+            return self._streams.get(sid)
+
+    def stream_append(self, sid: str, doc: Dict[str, Any]
+                      ) -> Tuple[int, Dict[str, Any], Dict[str, str]]:
+        """POST /stream/<sid>/ops: one idempotent chunk. Backpressure
+        composes with the PR-9/16 admission economy: intake outrunning
+        the online search (buffered ops past the quota) or the
+        session's predicted footprint overrunning the device byte
+        budget both answer 429 + fleet-aware Retry-After."""
+        session = self._stream_session(sid)
+        if session is None:
+            return 404, {"error": "no such stream", "id": sid}, {}
+        if self.draining and session.state == "open":
+            return 503, {"error": "draining"}, {"Retry-After": "30"}
+        lag = session.lag()
+        if session.state == "open" and lag > self.config.stream_buffer_ops:
+            retry = self._retry_after()
+            return 429, {"error": "backpressure", "id": sid,
+                         "lag-ops": lag,
+                         "buffer-ops": self.config.stream_buffer_ops,
+                         "retry-after-s": round(retry, 3)}, \
+                {"Retry-After": str(max(1, int(round(retry))))}
+        budget = self._capacity_budget()
+        if budget and session.footprint:
+            with self._lock:
+                committed = self._footprint_committed
+            if committed + session.footprint > budget:
+                retry = self._retry_after()
+                return 429, {"error": "footprint", "id": sid,
+                             "predicted-bytes": session.footprint,
+                             "committed-bytes": committed,
+                             "budget-bytes": budget,
+                             "retry-after-s": round(retry, 3)}, \
+                    {"Retry-After": str(max(1, int(round(retry))))}
+        code, body = session.append(doc.get("seq"), doc.get("ops"),
+                                    doc.get("crc"))
+        if code == 202 and not body.get("duplicate"):
+            self._publish()
+        return code, body, {}
+
+    def stream_close(self, sid: str, doc: Dict[str, Any]
+                     ) -> Tuple[int, Dict[str, Any], Dict[str, str]]:
+        session = self._stream_session(sid)
+        if session is None:
+            return 404, {"error": "no such stream", "id": sid}, {}
+        code, body = session.close(doc.get("chunks"))
+        self._publish()
+        return code, body, {}
+
+    def stream_status(self, sid: str) -> Optional[Dict[str, Any]]:
+        session = self._stream_session(sid)
+        return session.status() if session is not None else None
+
+    def _on_stream_done(self, session) -> None:
+        self._publish()
+
+    def _stream_replay(self) -> None:
+        """Rebuild sessions from their WALs after a restart: open and
+        sealed-but-unverdicted streams get a fresh runner (which picks
+        up the partial-verdict checkpoint — the crash-resume headline);
+        done streams are registered read-only so GET /stream/<sid>
+        keeps answering."""
+        from jepsen_tpu import stream as stream_mod
+        base = os.path.join(self.config.root, "streams")
+        if not os.path.isdir(base):
+            return
+        replayed = resumed = 0
+        for name in sorted(os.listdir(base)):
+            sdir = os.path.join(base, name)
+            try:
+                session = stream_mod.StreamSession.replay(
+                    sdir, self.config.root,
+                    reorder_max=self.config.stream_reorder)
+            except Exception:  # noqa: BLE001 — one bad dir must not
+                log.exception("stream replay failed for %s", sdir)
+                continue
+            if session is None:
+                continue
+            replayed += 1
+            with self._lock:
+                self._streams[session.id] = session
+            if session.state != "done":
+                runner = self._make_runner(session)
+                runner.start()
+                resumed += 1
+        if replayed:
+            self.replay_stats["streams"] = replayed
+            self.replay_stats["streams-resumed"] = resumed
+            log.info("replayed %d stream session(s), %d resumed",
+                     replayed, resumed)
+
+    def _stream_summary(self) -> Dict[str, Any]:
+        with self._lock:
+            sessions = list(self._streams.values())
+        by_state = {"open": 0, "closed": 0, "done": 0, "failed": 0}
+        ops = checked = lag = 0
+        for s in sessions:
+            by_state[s.state] = by_state.get(s.state, 0) + 1
+            with s.lock:
+                ops += len(s.ops)
+                checked += s.checked_events
+                lag += max(0, len(s.ops) - s.checked_events)
+        return {"sessions": len(sessions), "ops": ops,
+                "checked": checked, "lag": lag, **by_state}
 
     # -- introspection ------------------------------------------------------
 
@@ -1608,6 +1841,8 @@ class CheckDaemon:
                                 hosts=len(self.placer.hosts),
                                 live=self.placer.live(),
                                 backend=self.config.fleet_backend)
+        if self._streams:
+            doc["streams"] = self._stream_summary()
         return doc
 
     def _publish(self, force: bool = False,
@@ -1654,6 +1889,18 @@ class CheckDaemon:
             if self.config.rate_limit > 0:
                 doc["serve"]["rate-limited"] = \
                     self.stats["rate-limited"]
+            # stream bits only when sessions exist: an unused (or
+            # switched-off) streaming feature leaves progress.json
+            # byte-identical
+            if self._streams:
+                sessions = list(self._streams.values())
+                ops = sum(len(s.ops) for s in sessions)
+                checked = sum(s.checked_events for s in sessions)
+                doc["serve"]["streams"] = sum(
+                    1 for s in sessions if s.state != "done")
+                doc["serve"]["stream-ops"] = ops
+                doc["serve"]["stream-checked"] = checked
+                doc["serve"]["stream-lag"] = max(0, ops - checked)
         path = os.path.join(self.config.root, PROGRESS_NAME)
         tmp = f"{path}.tmp.{os.getpid()}"
         try:
@@ -1700,7 +1947,10 @@ def make_handler(daemon: CheckDaemon, root: str = "store"):
         from urllib.parse import urlparse
         path = urlparse(self.path).path
         try:
-            if path in ("/check", "/drain") and not _authorized(self):
+            if (path in ("/check", "/drain")
+                    or (path.startswith("/stream")
+                        and self.daemon._streams is not None)) \
+                    and not _authorized(self):
                 return _json(self, 401, {"error": "unauthorized"},
                              {"WWW-Authenticate": "Bearer"})
             if path == "/check":
@@ -1721,6 +1971,34 @@ def make_handler(daemon: CheckDaemon, root: str = "store"):
                 return _json(self, code, body, hdrs)
             if path == "/drain":
                 return _json(self, 200, self.daemon.drain())
+            # streaming ingestion (doc/serve.md "Streaming API"); with
+            # JTPU_SERVE_STREAM=0 these fall through to the 404 below —
+            # route-for-route identical to the pre-streaming daemon
+            if path.startswith("/stream") and \
+                    self.daemon._streams is not None:
+                try:
+                    n = int(self.headers.get("Content-Length") or 0)
+                    doc = json.loads(self.rfile.read(n) or b"{}")
+                    if not isinstance(doc, dict):
+                        raise ValueError("body must be a JSON object")
+                except (ValueError, TypeError) as e:
+                    return _json(self, 400, {"error": "bad-request",
+                                             "detail": str(e)})
+                if path == "/stream":
+                    tp = self.headers.get("traceparent")
+                    if tp and not doc.get("traceparent"):
+                        doc["traceparent"] = tp
+                    code, body, hdrs = self.daemon.stream_open(doc)
+                    return _json(self, code, body, hdrs)
+                parts = path.strip("/").split("/")
+                if len(parts) == 3 and parts[2] == "ops":
+                    code, body, hdrs = self.daemon.stream_append(
+                        parts[1], doc)
+                    return _json(self, code, body, hdrs)
+                if len(parts) == 3 and parts[2] == "close":
+                    code, body, hdrs = self.daemon.stream_close(
+                        parts[1], doc)
+                    return _json(self, code, body, hdrs)
             return _json(self, 404, {"error": "not-found"})
         except BrokenPipeError:
             pass
@@ -1746,6 +2024,16 @@ def make_handler(daemon: CheckDaemon, root: str = "store"):
             hdrs = ({"traceparent": obs_trace.format_traceparent(
                         doc["trace"])} if doc.get("trace") else None)
             return _json(self, code, doc, hdrs)
+        if path.startswith("/stream/") and \
+                self.daemon._streams is not None:
+            sid = path[len("/stream/"):].strip("/")
+            doc = self.daemon.stream_status(sid)
+            if doc is None:
+                return _json(self, 404, {"error": "no such stream",
+                                         "id": sid})
+            hdrs = ({"traceparent": obs_trace.format_traceparent(
+                        doc["trace"])} if doc.get("trace") else None)
+            return _json(self, 200, doc, hdrs)
         if path.startswith("/trace/request/"):
             # must intercept BEFORE web.Handler's /trace/<run> route,
             # which would misparse the request id as a run directory

@@ -353,6 +353,43 @@ class TestBaseline:
             assert just and "TODO" not in just, \
                 f"baseline entry {key!r} lacks a real justification"
 
+    def test_stubbed_reports_todo_and_empty_justifications(self):
+        base = {"A f.py#x": "real reason",
+                "B g.py#y": bl.STUB,
+                "C h.py#z": ""}
+        assert bl.stubbed(base) == ["B g.py#y", "C h.py#z"]
+
+    def test_strict_rejects_stub_justifications(self, tmp_path):
+        """--write-baseline stubs must be filled in before --strict
+        treats the entry as a real acceptance."""
+        import contextlib
+        target = os.path.join(FIX, "bad_lockset.py")
+        p = tmp_path / "b.baseline"
+        rc, _ = _run_cli(["lint", "--baseline", str(p),
+                          "--write-baseline", target])
+        assert rc == cli.OK
+        assert bl.STUB in p.read_text()
+        # non-strict: the stubbed acceptance still suppresses
+        rc, _ = _run_cli(["lint", "--baseline", str(p), target])
+        assert rc == cli.OK
+        # strict: refused, with a clear per-entry message
+        err = io.StringIO()
+        with contextlib.redirect_stderr(err):
+            rc, _ = _run_cli(["lint", "--strict",
+                              "--baseline", str(p), target])
+        assert rc == cli.TEST_FAILED
+        assert "stub justification" in err.getvalue()
+        assert str(p) in err.getvalue()
+        # a real justification clears the gate
+        p.write_text(p.read_text().replace(
+            bl.STUB, "reviewed: fixture intentionally racy"))
+        err = io.StringIO()
+        with contextlib.redirect_stderr(err):
+            rc, _ = _run_cli(["lint", "--strict",
+                              "--baseline", str(p), target])
+        assert rc == cli.OK, err.getvalue()
+        assert "stub justification" not in err.getvalue()
+
 
 def _run_cli(argv):
     buf = io.StringIO()

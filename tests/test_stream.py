@@ -1,0 +1,871 @@
+"""Streaming ingestion + crash-safe online checking (doc/serve.md
+"Streaming API", doc/resilience.md "Partial-verdict checkpoints").
+
+Covers the chunked intake contract (sequencing, CRC, duplicate
+absorption, bounded reorder, gap 409s), at-least-once delivery
+converging on byte-identical history artifacts (including
+replay-after-SIGKILL of a half-streamed session), online-vs-offline
+verdict identity, fail-fast on an invalid stable prefix, checkpoint
+resume at level > 0, the bounded-executor driver mode that feeds
+streams, the abandoned-thread leak gauge, and the JTPU_SERVE_STREAM
+kill-switch identity contract.
+"""
+
+import json
+import os
+import random
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from jepsen_tpu import core
+from jepsen_tpu import resilience as R
+from jepsen_tpu import serve as serve_ns
+from jepsen_tpu import stream as stream_ns
+from jepsen_tpu.checker import UNKNOWN, check_safe
+from jepsen_tpu.checker.wgl import linearizable
+from jepsen_tpu.history import History, Op
+from jepsen_tpu.models import CASRegister
+from jepsen_tpu.ops.encode import StreamPacker, pack_with_init
+from jepsen_tpu.stream import StreamRunner, StreamSession
+
+pytestmark = pytest.mark.serve
+
+#: keys on which a streamed verdict must be indistinguishable from the
+#: offline checker's.
+_VERDICT_KEYS = ("valid", "levels", "max-linearized-prefix",
+                 "final-states", "frontier-op")
+
+
+def _conc_ops(n, seed, value_base=0, corrupt_at=None):
+    """A concurrent register history (4 procs, interleaved invokes);
+    ``corrupt_at`` flips that read's value so the history is invalid."""
+    rng = random.Random(seed)
+    ops, t, pend, val = [], 0, {}, value_base
+    reads = 0
+    for _ in range(n):
+        p = rng.choice((0, 1, 2, 3))
+        if p in pend:
+            inv = pend.pop(p)
+            v = inv["value"]
+            if inv["f"] == "read":
+                # a read that completes before ANY write was invoked
+                # must observe the initial (nil) state, or the history
+                # is invalid from op 0
+                v = val if val != value_base else None
+                reads += 1
+                if corrupt_at is not None and reads == corrupt_at:
+                    v = val + 10_000   # never written: unlinearizable
+            ops.append({"process": p, "type": "ok", "f": inv["f"],
+                        "value": v, "time": t})
+        else:
+            f = rng.choice(("write", "read"))
+            v = val + 1 if f == "write" else None
+            if f == "write":
+                val += 1
+            inv = {"process": p, "type": "invoke", "f": f, "value": v,
+                   "time": t}
+            ops.append(inv)
+            pend[p] = inv
+        t += 1
+    for p, inv in sorted(pend.items()):
+        if inv["f"] == "read":
+            v = val if val != value_base else None
+        else:
+            v = inv["value"]
+        ops.append({"process": p, "type": "ok", "f": inv["f"],
+                    "value": v, "time": t})
+        t += 1
+    return ops
+
+
+def _offline(ops):
+    return check_safe(linearizable(CASRegister(), backend="tpu"),
+                      {"name": "stream-offline"},
+                      History.of([Op.from_dict(d) for d in ops]))
+
+
+def _chunks(ops, size):
+    return [ops[i:i + size] for i in range(0, len(ops), size)]
+
+
+def _session(tmp_path, sid="s1", **kw):
+    return StreamSession(sid, "t", "cas-register", str(tmp_path), **kw)
+
+
+def _runner(session, **kw):
+    kw.setdefault("backend", "tpu")
+    kw.setdefault("segment_iters", 64)
+    r = StreamRunner(session, CASRegister(), **kw)
+    session.runner = r
+    r.start()
+    return r
+
+
+def _wait_done(session, runner=None, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        with session.lock:
+            if session.state == "done" and session.result is not None:
+                if runner is not None:
+                    runner.join(timeout=10)
+                return session.result
+        time.sleep(0.02)
+    raise AssertionError(f"stream never finished: {session.status()}")
+
+
+def _stop(runner):
+    runner.stop()
+    runner.join(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# StreamPacker: append-mode packing is byte-identical to pack_history
+# ---------------------------------------------------------------------------
+
+
+_PACK_COLS = ("f", "v1", "v2", "inv", "ret", "process")
+
+
+class TestStreamPacker:
+    def test_close_matches_offline_pack(self):
+        ops = _conc_ops(120, 11)
+        packer = _fresh_packer()
+        packer.feed_ops(ops)
+        online = packer.close()
+        offline, _ = _packed_offline(ops)
+        for name in _PACK_COLS:
+            assert np.array_equal(getattr(online, name),
+                                  getattr(offline, name)), name
+        assert online.n_required == offline.n_required
+        assert online.init_state == offline.init_state
+
+    def test_stable_prefix_extends_monotonically(self):
+        """Packed columns of a longer stable prefix exactly extend the
+        shorter one — the invariant that lets the carry survive
+        barriers."""
+        ops = _conc_ops(80, 12)
+        packer = _fresh_packer()
+        prev = None
+        for op in ops:
+            packer.feed_ops([op])
+            p = packer.stable_packed()
+            if prev is not None:
+                assert p.n >= prev.n
+                if prev.n:
+                    for name in _PACK_COLS:
+                        a = np.asarray(getattr(p, name))[:prev.n]
+                        b = np.asarray(getattr(prev, name))[:prev.n]
+                        assert np.array_equal(a, b), name
+            prev = p
+        final = packer.close()
+        offline, _ = _packed_offline(ops)
+        assert final.n == offline.n
+
+    def test_watermark_pinned_by_open_invoke(self):
+        packer = _fresh_packer()
+        packer.feed_ops([{"type": "invoke", "f": "write",
+                         "value": 1, "process": 0, "time": 0}])
+        assert packer.watermark == 0
+        packer.feed_ops([{"type": "ok", "f": "write",
+                         "value": 1, "process": 0, "time": 1}])
+        assert packer.watermark == 2
+
+
+def _fresh_packer():
+    from jepsen_tpu.models.core import kernel_spec_for
+    from jepsen_tpu.ops.encode import _Interner
+    model = CASRegister()
+    kernel = kernel_spec_for(model)
+    intern = _Interner()
+    init = (kernel.pack_init(model, intern.id)
+            if kernel.pack_init is not None else kernel.init_state)
+    return StreamPacker(kernel, init_state=init, intern=intern)
+
+
+def _packed_offline(ops):
+    return pack_with_init(
+        History.of([Op.from_dict(d) for d in ops]), CASRegister())
+
+
+# ---------------------------------------------------------------------------
+# Intake: at-least-once delivery converges on identical artifacts
+# ---------------------------------------------------------------------------
+
+
+class TestIntakeAtLeastOnce:
+    def test_repost_of_acked_chunk_absorbed_without_rejournal(
+            self, tmp_path):
+        s = _session(tmp_path)
+        chunk = _conc_ops(8, 1)
+        code, body = s.append(0, chunk, stream_ns.chunk_crc(chunk))
+        assert code == 202 and body["need"] == 1
+        wal_before = open(
+            os.path.join(s.dir, stream_ns.WAL_NAME), "rb").read()
+        code, body = s.append(0, chunk, stream_ns.chunk_crc(chunk))
+        assert code == 202 and body["duplicate"] is True
+        assert len(s.ops) == len(chunk)
+        wal_after = open(
+            os.path.join(s.dir, stream_ns.WAL_NAME), "rb").read()
+        assert wal_after == wal_before   # dup never re-journaled
+        s.stop_wal()
+
+    def test_out_of_order_buffers_then_drains_in_sequence(self, tmp_path):
+        s = _session(tmp_path)
+        ops = _conc_ops(24, 2)
+        c = _chunks(ops, 8)
+        code, body = s.append(1, c[1])
+        assert code == 202 and body["buffered"] is True
+        assert s.ops == []               # nothing admitted yet
+        code, body = s.append(2, c[2])
+        assert code == 202 and body["buffered"] is True
+        code, body = s.append(0, c[0])
+        assert code == 202 and body["need"] == 3
+        assert s.ops == c[0] + c[1] + c[2]   # drained in sequence order
+        s.stop_wal()
+
+    def test_gap_beyond_reorder_window_409_with_need(self, tmp_path):
+        s = _session(tmp_path, reorder_max=2)
+        code, body = s.append(5, [])
+        assert code == 409 and body["error"] == "gap"
+        assert body["need"] == 0
+        s.stop_wal()
+
+    def test_crc_mismatch_400(self, tmp_path):
+        s = _session(tmp_path)
+        code, body = s.append(0, _conc_ops(4, 3), "deadbeef")
+        assert code == 400 and body["error"] == "crc-mismatch"
+        s.stop_wal()
+
+    def test_close_refuses_holes(self, tmp_path):
+        s = _session(tmp_path)
+        c = _chunks(_conc_ops(24, 4), 8)
+        s.append(0, c[0])
+        s.append(2, c[2])                # 1 is missing, buffered
+        code, body = s.close(3)
+        assert code == 409 and body["error"] == "gap"
+        assert body["need"] == 1
+        s.append(1, c[1])
+        code, body = s.close(3)
+        assert code == 200 and body["state"] == "closed"
+        s.stop_wal()
+
+    def test_duplicate_after_close_still_202(self, tmp_path):
+        s = _session(tmp_path)
+        chunk = _conc_ops(8, 5)
+        s.append(0, chunk)
+        s.close(1)
+        code, body = s.append(0, chunk)
+        assert code == 202 and body["duplicate"] is True
+        assert body["state"] == "closed"
+        s.stop_wal()
+
+    def test_replay_after_kill_yields_byte_identical_history(
+            self, tmp_path):
+        """SIGKILL between chunks: the WAL replay reconstructs the
+        session — including its reorder buffer — and the sealed
+        history.json is byte-for-byte what an unkilled stream writes."""
+        ops = _conc_ops(60, 6)
+        c = _chunks(ops, 10)
+        # reference: a clean uninterrupted stream
+        ref = _session(tmp_path / "clean", sid="ref")
+        for i, ch in enumerate(c):
+            ref.append(i, ch)
+        ref.close(len(c))
+        ref.stop_wal()
+        ref_bytes = open(
+            os.path.join(ref.dir, stream_ns.HISTORY_NAME), "rb").read()
+        # the killed stream: half delivered, one chunk buffered out of
+        # order, then the process "dies" (WAL handle simply abandoned)
+        s = _session(tmp_path / "killed", sid="ref")
+        s.append(0, c[0])
+        s.append(1, c[1])
+        s.append(3, c[3])                # buffered: 2 still missing
+        s.stop_wal()                     # SIGKILL
+        s2 = StreamSession.replay(s.dir, str(tmp_path / "killed"))
+        assert s2 is not None and s2.state == "open"
+        assert s2.ops == c[0] + c[1]
+        assert 3 in s2.reorder           # buffer survived the crash
+        # the client's at-least-once retry re-sends everything unacked
+        code, body = s2.append(1, c[1])
+        assert code == 202 and body["duplicate"] is True
+        for i in range(2, len(c)):       # 3 dups against the buffer
+            code, _ = s2.append(i, c[i])
+            assert code == 202
+        code, body = s2.close(len(c))
+        assert code == 200
+        s2.stop_wal()
+        killed_bytes = open(
+            os.path.join(s2.dir, stream_ns.HISTORY_NAME), "rb").read()
+        assert killed_bytes == ref_bytes
+
+    def test_replay_of_sealed_session_rewrites_identical_history(
+            self, tmp_path):
+        ops = _conc_ops(40, 7)
+        s = _session(tmp_path)
+        c = _chunks(ops, 10)
+        for i, ch in enumerate(c):
+            s.append(i, ch)
+        s.close(len(c))
+        s.stop_wal()
+        hpath = os.path.join(s.dir, stream_ns.HISTORY_NAME)
+        ref = open(hpath, "rb").read()
+        os.unlink(hpath)                 # crashed before rename landed
+        s2 = StreamSession.replay(s.dir, str(tmp_path))
+        assert s2.state == "closed"
+        s2.stop_wal()
+        assert open(hpath, "rb").read() == ref
+
+
+# ---------------------------------------------------------------------------
+# Online checking: verdict identity, fail-fast, crash resume
+# ---------------------------------------------------------------------------
+
+
+class TestOnlineVerdict:
+    def test_streamed_verdict_matches_offline_with_dup_and_reorder(
+            self, tmp_path):
+        ops = _conc_ops(240, 21)
+        c = _chunks(ops, 24)
+        s = _session(tmp_path)
+        r = _runner(s)
+        try:
+            for i, ch in enumerate(c):
+                if i == 3:               # out-of-order pair
+                    s.append(4, c[4])
+                    s.append(3, c[3])
+                    s.append(4, c[4])    # and a duplicate
+                    continue
+                if i == 4:
+                    continue
+                s.append(i, ch, stream_ns.chunk_crc(ch))
+            code, _ = s.close(len(c))
+            assert code == 200
+            result = _wait_done(s, r)
+        finally:
+            _stop(r)
+        offline = _offline(ops)
+        for key in _VERDICT_KEYS:
+            assert result.get(key) == offline.get(key), key
+        st = result["stream"]
+        assert st["ops"] == len(ops)
+        assert st["dup-chunks"] >= 1 and st["reordered"] >= 1
+        assert st["failed-fast"] is False
+        assert st["watermark"] == len(ops)
+
+    def test_failfast_refutes_invalid_prefix_while_stream_open(
+            self, tmp_path):
+        """An invalid stable prefix renders the verdict BEFORE close:
+        the session jumps open -> done and later appends answer 409
+        stream-failed."""
+        ops = _conc_ops(200, 22, corrupt_at=3)
+        offline = _offline(ops)
+        assert offline["valid"] is False
+        c = _chunks(ops, 20)
+        s = _session(tmp_path)
+        r = _runner(s, segment_iters=16)
+        try:
+            # hold back the last chunk: the refutation must come from
+            # the invalid stable prefix alone, with the stream open
+            sent = 0
+            while sent < len(c) - 1:
+                code, body = s.append(sent, c[sent])
+                if code == 409 and body["error"] == "stream-failed":
+                    break
+                assert code == 202
+                sent += 1
+            result = _wait_done(s, r)
+        finally:
+            _stop(r)
+        assert result["valid"] is False
+        assert result["stream"]["failed-fast"] is True
+        # refuted strictly mid-stream: the tail never arrived
+        assert result["stream"]["watermark"] < len(ops)
+        code, body = s.append(len(c) - 1, c[-1])
+        assert code == 409 and body["error"] == "stream-failed"
+
+    def test_trivial_empty_stream_is_valid(self, tmp_path):
+        s = _session(tmp_path)
+        r = _runner(s)
+        try:
+            s.close(0)
+            result = _wait_done(s, r)
+        finally:
+            _stop(r)
+        assert result["valid"] is True
+
+
+class TestCrashResume:
+    def test_resume_from_checkpoint_continues_above_level_zero(
+            self, tmp_path):
+        """The crash-safety headline: kill the daemon mid-stream, replay
+        the WAL, and the search resumes from the partial-verdict
+        checkpoint — never level 0 — with the final verdict identical
+        to offline."""
+        ops = _conc_ops(320, 23)
+        c = _chunks(ops, 16)
+        s = _session(tmp_path)
+        r = _runner(s, segment_iters=1)  # checkpoint every level
+        cp_path = os.path.join(s.dir, stream_ns.CHECKPOINT_NAME)
+        try:
+            for i, ch in enumerate(c):
+                s.append(i, ch)
+            deadline = time.monotonic() + 60
+            level = 0
+            while time.monotonic() < deadline:
+                if os.path.exists(cp_path):
+                    try:
+                        level = R.Checkpoint.load(cp_path).level
+                    except Exception:  # noqa: BLE001 — mid-save race
+                        level = 0
+                    if level > 0:
+                        break
+                time.sleep(0.02)
+            assert level > 0, "no mid-stream checkpoint ever landed"
+        finally:
+            _stop(r)                     # SIGKILL stand-in
+        s.stop_wal()
+        # next daemon incarnation: WAL replay + checkpoint resume
+        s2 = StreamSession.replay(s.dir, str(tmp_path))
+        assert s2 is not None and s2.state == "open"
+        assert s2.ops == ops
+        r2 = _runner(s2, segment_iters=64)
+        try:
+            code, _ = s2.close(len(c))
+            assert code == 200
+            result = _wait_done(s2, r2)
+        finally:
+            _stop(r2)
+        assert result["stream"].get("resume-level", 0) > 0
+        offline = _offline(ops)
+        for key in _VERDICT_KEYS:
+            assert result.get(key) == offline.get(key), key
+
+    def test_corrupt_checkpoint_starts_fresh_not_crashed(self, tmp_path):
+        ops = _conc_ops(80, 24)
+        s = _session(tmp_path)
+        with open(os.path.join(s.dir, stream_ns.CHECKPOINT_NAME),
+                  "wb") as f:
+            f.write(b"not an npz")
+        r = _runner(s)
+        try:
+            c = _chunks(ops, 20)
+            for i, ch in enumerate(c):
+                s.append(i, ch)
+            s.close(len(c))
+            result = _wait_done(s, r)
+        finally:
+            _stop(r)
+        assert result["valid"] == _offline(ops)["valid"]
+        assert "resume-level" not in result["stream"]
+
+
+# ---------------------------------------------------------------------------
+# Daemon integration: admission, replay-on-restart, progress keys
+# ---------------------------------------------------------------------------
+
+
+def _daemon(tmp_path, start=True, **cfg):
+    cfg.setdefault("root", str(tmp_path / "serve"))
+    cfg.setdefault("backend", "tpu")
+    d = serve_ns.CheckDaemon(serve_ns.ServeConfig(**cfg))
+    if start:
+        d.start()
+    return d
+
+
+class TestDaemonStreaming:
+    def test_open_feed_close_verdict_and_observability(self, tmp_path):
+        ops = _conc_ops(160, 31)
+        c = _chunks(ops, 20)
+        d = _daemon(tmp_path)
+        try:
+            code, body, _ = d.stream_open({"tenant": "t1",
+                                           "model": "cas-register"})
+            assert code == 202 and body["state"] == "open"
+            sid = body["id"]
+            for i, ch in enumerate(c):
+                code, body, _ = d.stream_append(
+                    sid, {"seq": i, "ops": ch,
+                          "crc": stream_ns.chunk_crc(ch)})
+                assert code == 202
+            code, body, _ = d.stream_close(sid, {"chunks": len(c)})
+            assert code == 200
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                doc = d.stream_status(sid)
+                if doc["state"] == "done" and doc.get("result"):
+                    break
+                time.sleep(0.05)
+            assert doc["state"] == "done"
+            offline = _offline(ops)
+            for key in _VERDICT_KEYS:
+                assert doc["result"].get(key) == offline.get(key), key
+            hz = d.healthz()
+            assert hz["streams"]["sessions"] >= 1
+            d._publish(force=True)
+            with open(os.path.join(d.config.root,
+                                   serve_ns.PROGRESS_NAME)) as f:
+                prog = json.load(f)["serve"]
+            assert "streams" in prog and "stream-ops" in prog
+        finally:
+            d.stop()
+
+    def test_unknown_model_400_and_unknown_stream_404(self, tmp_path):
+        d = _daemon(tmp_path, start=False)
+        try:
+            code, body, _ = d.stream_open({"model": "no-such-model"})
+            assert code == 400
+            code, body, _ = d.stream_append("nope", {"seq": 0, "ops": []})
+            assert code == 404
+        finally:
+            d.stop()
+
+    def test_stream_quota_429_with_retry_after(self, tmp_path):
+        d = _daemon(tmp_path, start=False, stream_max=1)
+        try:
+            code, body, _ = d.stream_open({"model": "cas-register"})
+            assert code == 202
+            code, body, hdrs = d.stream_open({"model": "cas-register"})
+            assert code == 429 and body["error"] == "stream-quota"
+            assert int(hdrs["Retry-After"]) >= 1
+        finally:
+            d.stop()
+
+    def test_backpressure_429_when_intake_outruns_checker(self, tmp_path):
+        d = _daemon(tmp_path, start=False, stream_buffer_ops=10)
+        try:
+            code, body, _ = d.stream_open({"model": "cas-register"})
+            sid = body["id"]
+            # no runner progress: lag == accepted ops
+            sess = d._stream_session(sid)
+            sess.runner and _stop(sess.runner)
+            ops = _conc_ops(40, 32)
+            code, body, hdrs = d.stream_append(
+                sid, {"seq": 0, "ops": ops})
+            assert code == 202
+            code, body, hdrs = d.stream_append(
+                sid, {"seq": 1, "ops": ops})
+            assert code == 429 and body["error"] == "backpressure"
+            assert int(hdrs["Retry-After"]) >= 1
+        finally:
+            d.stop()
+
+    def test_daemon_restart_replays_open_stream_and_finishes(
+            self, tmp_path):
+        ops = _conc_ops(200, 33)
+        c = _chunks(ops, 20)
+        d1 = _daemon(tmp_path)
+        try:
+            code, body, _ = d1.stream_open({"model": "cas-register"})
+            sid = body["id"]
+            for i in range(5):           # half the stream, then "kill"
+                code, _, _ = d1.stream_append(sid, {"seq": i,
+                                                    "ops": c[i]})
+                assert code == 202
+        finally:
+            d1.stop()
+        d2 = _daemon(tmp_path)
+        try:
+            doc = d2.stream_status(sid)
+            assert doc is not None and doc["state"] == "open"
+            assert doc["ops"] == 100     # replayed intake survived
+            for i in range(5, len(c)):
+                code, body, _ = d2.stream_append(sid, {"seq": i,
+                                                       "ops": c[i]})
+                assert code == 202
+            code, _, _ = d2.stream_close(sid, {"chunks": len(c)})
+            assert code == 200
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                doc = d2.stream_status(sid)
+                if doc["state"] == "done" and doc.get("result"):
+                    break
+                time.sleep(0.05)
+            offline = _offline(ops)
+            for key in _VERDICT_KEYS:
+                assert doc["result"].get(key) == offline.get(key), key
+        finally:
+            d2.stop()
+
+
+# ---------------------------------------------------------------------------
+# HTTP routes
+# ---------------------------------------------------------------------------
+
+
+def _post(port, path, doc):
+    import urllib.error
+    import urllib.request
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(doc).encode() if doc is not None else b"",
+        method="POST")
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.load(r), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.load(e), dict(e.headers)
+
+
+class TestStreamHTTP:
+    def test_stream_routes_end_to_end(self, tmp_path):
+        import urllib.request
+        ops = _conc_ops(120, 41)
+        c = _chunks(ops, 30)
+        cfg = serve_ns.ServeConfig(root=str(tmp_path / "serve"),
+                                   backend="tpu")
+        daemon, server = serve_ns.run_daemon(
+            cfg, host="127.0.0.1", port=0,
+            store_root=str(tmp_path / "store"))
+        port = server.server_port
+        try:
+            code, body, _ = _post(port, "/stream",
+                                  {"model": "cas-register"})
+            assert code == 202
+            sid = body["id"]
+            for i, ch in enumerate(c):
+                code, body, _ = _post(
+                    port, f"/stream/{sid}/ops",
+                    {"seq": i, "ops": ch,
+                     "crc": stream_ns.chunk_crc(ch)})
+                assert code == 202
+            # a gap past the reorder window resyncs the client
+            code, body, _ = _post(port, f"/stream/{sid}/ops",
+                                  {"seq": 500, "ops": []})
+            assert code == 409 and body["need"] == len(c)
+            code, body, _ = _post(port, f"/stream/{sid}/close",
+                                  {"chunks": len(c)})
+            assert code == 200
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/stream/{sid}") as r:
+                    doc = json.load(r)
+                if doc["state"] == "done" and doc.get("result"):
+                    break
+                time.sleep(0.05)
+            assert doc["result"]["valid"] == _offline(ops)["valid"]
+        finally:
+            server.shutdown()
+            daemon.stop()
+
+
+# ---------------------------------------------------------------------------
+# Kill switch: JTPU_SERVE_STREAM=0 leaves the daemon byte-identical
+# ---------------------------------------------------------------------------
+
+
+class TestStreamKillSwitch:
+    def test_off_daemon_has_no_streams_anywhere(self, tmp_path,
+                                                monkeypatch):
+        monkeypatch.setenv("JTPU_SERVE_STREAM", "0")
+        d = _daemon(tmp_path)
+        try:
+            assert d.config.stream_on is False
+            assert d._streams is None
+            hz = d.healthz()
+            assert "streams" not in hz
+            d._publish(force=True)
+            with open(os.path.join(d.config.root,
+                                   serve_ns.PROGRESS_NAME)) as f:
+                prog = json.load(f)["serve"]
+            for key in ("streams", "stream-ops", "stream-checked",
+                        "stream-lag"):
+                assert key not in prog
+            assert not os.path.isdir(
+                os.path.join(d.config.root, "streams"))
+        finally:
+            d.stop()
+
+    def test_off_http_routes_404(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("JTPU_SERVE_STREAM", "0")
+        cfg = serve_ns.ServeConfig(root=str(tmp_path / "serve"))
+        daemon, server = serve_ns.run_daemon(
+            cfg, host="127.0.0.1", port=0,
+            store_root=str(tmp_path / "store"))
+        try:
+            code, _, _ = _post(server.server_port, "/stream",
+                               {"model": "cas-register"})
+            assert code == 404
+        finally:
+            server.shutdown()
+            daemon.stop()
+
+    def test_off_never_imports_stream_module(self, tmp_path):
+        """The lazy-import discipline, checked in a clean interpreter:
+        with the kill switch thrown, constructing + starting + stopping
+        the daemon never imports jepsen_tpu.stream, so none of its
+        metric names register."""
+        code = subprocess.run(
+            [sys.executable, "-c", (
+                "import sys\n"
+                "from jepsen_tpu import serve\n"
+                "d = serve.CheckDaemon(serve.ServeConfig(root=%r))\n"
+                "d.start(); d.stop()\n"
+                "assert 'jepsen_tpu.stream' not in sys.modules\n"
+            ) % str(tmp_path / "serve")],
+            env={**os.environ, "JTPU_SERVE_STREAM": "0",
+                 "JAX_PLATFORMS": "cpu"},
+            capture_output=True, text=True, timeout=180)
+        assert code.returncode == 0, code.stdout + code.stderr
+
+
+# ---------------------------------------------------------------------------
+# Bounded-executor driver mode (test["driver-threads"])
+# ---------------------------------------------------------------------------
+
+
+class _EchoClient:
+    def __init__(self):
+        self.threads = set()
+        self.lock = threading.Lock()
+
+    def open(self, test, node):
+        return self
+
+    def invoke(self, test, op):
+        with self.lock:
+            self.threads.add(threading.current_thread().name)
+        return op.replace(type="ok")
+
+    def close(self, test):
+        pass
+
+
+class _ScriptGen:
+    """Hands each worker process a fixed number of ops; nothing for the
+    nemesis."""
+
+    def __init__(self, per_process):
+        self.left = dict(per_process)
+        self.lock = threading.Lock()
+
+    def op(self, test, process):
+        from jepsen_tpu.history import NEMESIS
+        if process == NEMESIS:
+            return None
+        with self.lock:
+            thread = process % test["concurrency"]
+            if self.left.get(thread, 0) <= 0:
+                return None
+            self.left[thread] -= 1
+        return Op(type="invoke", f="w", value=None, process=process)
+
+
+class TestBoundedDriver:
+    def test_k_pool_threads_drive_n_processes(self):
+        n, k, per = 12, 3, 5
+        client = _EchoClient()
+        test = {"name": "bounded", "client": client,
+                "generator": _ScriptGen({i: per for i in range(n)}),
+                "concurrency": n, "driver-threads": k, "nodes": ["a"]}
+        h = core._run_case(test)
+        ops = [o for o in h if isinstance(o.process, int)]
+        invs = [o for o in ops if o.type == "invoke"]
+        assert len(invs) == n * per
+        assert len({o.process for o in invs}) == n
+        # every invoke ran on a pool thread, and only k of them existed
+        assert client.threads
+        assert all(t.startswith("jepsen-driver-") for t in client.threads)
+        assert len(client.threads) <= k
+        # per-process histories stay strictly invoke/ok alternating
+        for p in {o.process for o in invs}:
+            seq = [o.type for o in ops if o.process == p]
+            assert seq == ["invoke", "ok"] * (len(seq) // 2)
+
+    def test_info_reincarnates_process_in_bounded_mode(self):
+        n = 4
+
+        class CrashOnce(_EchoClient):
+            def __init__(self):
+                super().__init__()
+                self.crashed = False
+
+            def invoke(self, test, op):
+                with self.lock:
+                    if not self.crashed and op.process == 1:
+                        self.crashed = True
+                        raise RuntimeError("connection torn")
+                return op.replace(type="ok")
+
+        test = {"name": "bounded-crash", "client": CrashOnce(),
+                "generator": _ScriptGen({i: 3 for i in range(n)}),
+                "concurrency": n, "driver-threads": 2, "nodes": ["a"]}
+        h = core._run_case(test)
+        procs = {o.process for o in h if isinstance(o.process, int)}
+        assert 1 + n in procs            # reincarnated as p + concurrency
+        infos = [o for o in h if o.type == "info"
+                 and isinstance(o.process, int)]
+        assert len(infos) == 1 and infos[0].process == 1
+
+    def test_worker_error_propagates_and_stops_pool(self):
+        """A generator error (outside the info/reincarnation contract)
+        stops the pool and re-raises — the threaded mode's crash
+        propagation."""
+        class BadGen(_ScriptGen):
+            def op(self, test, process):
+                out = super().op(test, process)
+                if out is not None and process == 2:
+                    raise RuntimeError("generator blew up")
+                return out
+
+        test = {"name": "bounded-bad", "client": _EchoClient(),
+                "generator": BadGen({i: 2 for i in range(4)}),
+                "concurrency": 4, "driver-threads": 2, "nodes": ["a"]}
+        with pytest.raises(RuntimeError, match="generator blew up"):
+            core._run_case(test)
+
+    def test_full_thread_mode_untouched_without_flag(self):
+        n = 3
+        client = _EchoClient()
+        test = {"name": "threaded", "client": client,
+                "generator": _ScriptGen({i: 2 for i in range(n)}),
+                "concurrency": n, "nodes": ["a"]}
+        h = core._run_case(test)
+        invs = [o for o in h if o.type == "invoke"
+                and isinstance(o.process, int)]
+        assert len(invs) == n * 2
+        assert all(t.startswith("jepsen-worker-") for t in client.threads)
+
+
+# ---------------------------------------------------------------------------
+# Abandoned-thread leak gauge (with_op_timeout)
+# ---------------------------------------------------------------------------
+
+
+class TestAbandonedThreads:
+    def test_timeout_counts_the_leaked_thread(self):
+        release = threading.Event()
+        before = core.abandoned_threads()
+        with pytest.raises(core.OpTimeout):
+            core.with_op_timeout(0.05, release.wait)
+        assert core.abandoned_threads() == before + 1
+        release.set()                    # let the leak drain
+
+    def test_analyze_prints_leaked_threads_line(self, tmp_path):
+        import contextlib
+        import io
+        from jepsen_tpu import cli
+        release = threading.Event()
+        with pytest.raises(core.OpTimeout):
+            core.with_op_timeout(0.05, release.wait)
+        d = tmp_path / "run"
+        d.mkdir()
+        h = History.of([
+            Op(type="invoke", f="write", value=1, process=0, time=0),
+            Op(type="ok", f="write", value=1, process=0, time=1),
+        ]).index()
+        (d / "history.jsonl").write_text(h.to_jsonl() + "\n")
+        (d / "test.json").write_text('{"name": "t"}')
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = cli.run(cli.default_commands(),
+                         ["analyze", "--store", str(d)])
+        release.set()
+        assert rc == cli.OK
+        assert "# leaked-threads:" in buf.getvalue()

@@ -72,6 +72,16 @@ qualify a new accelerator image before trusting it with long runs):
                    answer 200 with offline-identical verdicts, the
                    poison answers 500 (oom), and its bucket's breaker
                    counts exactly one failure
+  stream-kill      SIGKILL the daemon MID-STREAM after the online
+                   checker saved a partial-verdict checkpoint: the
+                   restarted daemon replays the per-session WAL,
+                   resumes the search from the checkpointed level
+                   (never level 0), and the sealed stream's verdict is
+                   identical to the offline analyze path
+  stream-dup       a duplicate / out-of-order chunk storm (every chunk
+                   twice, pairs swapped, re-post after close): the
+                   sealed history.json is byte-identical to a clean
+                   in-order session's and the verdict matches offline
 
 Usage: python tools/chaos_matrix.py [--seed N] [--only NAME ...]
 Exit code 0 iff every selected scenario passes — nonzero on any
@@ -1534,6 +1544,275 @@ def scenario_serve_fleet_host_kill(seed):
         daemon.stop()
 
 
+_STREAM_VERDICT_KEYS = ("valid", "levels", "max-linearized-prefix",
+                        "final-states", "frontier-op")
+
+
+def scenario_stream_kill(seed):
+    """SIGKILL the check daemon MID-STREAM, after the online checker
+    has journaled chunks and saved a partial-verdict checkpoint. A
+    restarted daemon must replay the per-session WAL, resume the search
+    from the checkpointed level (NEVER level 0), and — once the stream
+    is sealed — render a verdict identical to the offline analyze path
+    over the same ops (doc/serve.md "Streaming API",
+    doc/resilience.md)."""
+    import tempfile
+    import urllib.request
+    import zipfile
+
+    from jepsen_tpu import serve as serve_ns
+    from jepsen_tpu import resilience as R
+    from jepsen_tpu import stream as stream_mod
+    from jepsen_tpu.checker import check_safe
+    from jepsen_tpu.checker.wgl import linearizable
+    from jepsen_tpu.history import History
+
+    root = tempfile.mkdtemp(prefix="jepsen-chaos-streamkill-")
+    serve_dir = os.path.join(root, "serve")
+    port_file = os.path.join(root, "port.json")
+    h = simulate_register_history(600, n_procs=5, n_vals=4, seed=seed)
+    ops = [o.to_dict() for o in h]
+    offline = check_safe(linearizable(CASRegister(), backend="tpu"),
+                         {"name": "chaos-stream-offline"},
+                         History.of(ops))
+    chunks = [ops[i:i + 50] for i in range(0, len(ops), 50)]
+
+    child_src = (
+        "import json, sys\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "from jepsen_tpu import serve as S\n"
+        f"cfg = S.ServeConfig(root={serve_dir!r}, backend='tpu', "
+        "workers=1)\n"
+        f"d, srv = S.run_daemon(cfg, host='127.0.0.1', port=0, "
+        f"store_root={root!r})\n"
+        f"json.dump({{'port': srv.server_port}}, "
+        f"open({port_file!r}, 'w'))\n"
+        "d.drained.wait()\n")
+    # one search iteration per device call -> a checkpoint barrier
+    # lands every segment, so the kill window is wide open
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               JTPU_SEGMENT_ITERS="1")
+    proc = subprocess.Popen([sys.executable, "-c", child_src], env=env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+
+    def post(port, path, doc):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=json.dumps(doc).encode(), method="POST")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.load(r)
+
+    cp_level = 0
+    try:
+        deadline = time.time() + 60
+        port = None
+        while time.time() < deadline:
+            if os.path.exists(port_file):
+                try:
+                    with open(port_file) as f:
+                        port = json.load(f)["port"]
+                    break
+                except (OSError, ValueError):
+                    pass
+            if proc.poll() is not None:
+                return False, f"daemon exited rc={proc.returncode} at boot"
+            time.sleep(0.1)
+        if port is None:
+            return False, "daemon never published its port"
+        sid = post(port, "/stream", {"tenant": "chaos",
+                                     "model": "cas-register"})["id"]
+        for seq, chunk in enumerate(chunks):
+            post(port, f"/stream/{sid}/ops",
+                 {"seq": seq, "ops": chunk,
+                  "crc": stream_mod.chunk_crc(chunk)})
+        # the stream stays OPEN (no close): the online search is mid-
+        # flight over the stable prefix when the SIGKILL lands. Wait
+        # for a checkpoint with level > 0 so the resume has something
+        # real to prove.
+        cp_path = os.path.join(serve_dir, "streams", sid,
+                               stream_mod.CHECKPOINT_NAME)
+        while time.time() < deadline and cp_level <= 0:
+            try:
+                cp_level = R.Checkpoint.load(cp_path).level
+            except (OSError, ValueError, KeyError,
+                    zipfile.BadZipFile):
+                pass
+            time.sleep(0.02)
+        if cp_level <= 0:
+            return False, "no partial-verdict checkpoint before kill"
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    # restart (in-process incarnation on the same journal + WALs)
+    d2 = serve_ns.CheckDaemon(
+        serve_ns.ServeConfig(root=serve_dir, backend="tpu", workers=1))
+    d2.start()
+    details = [f"SIGKILL with checkpoint at level {cp_level}"]
+    try:
+        if d2.replay_stats.get("streams-resumed") != 1:
+            return False, (f"replay resumed "
+                           f"{d2.replay_stats.get('streams-resumed')}"
+                           f" stream(s), want 1 "
+                           f"(stats {d2.replay_stats})")
+        st = d2.stream_status(sid)
+        if st is None or st["ops"] != len(ops):
+            return False, (f"WAL replay rebuilt "
+                           f"{st and st['ops']}/{len(ops)} ops")
+        details.append(f"WAL replay rebuilt all {len(ops)} ops")
+        code, body, _ = d2.stream_close(sid, {"chunks": len(chunks)})
+        if code != 200:
+            return False, f"close after restart answered {code}: {body}"
+        deadline = time.time() + 120
+        st = {}
+        while time.time() < deadline:
+            st = d2.stream_status(sid) or {}
+            if st.get("state") == "done" and "result" in st:
+                break
+            time.sleep(0.05)
+        if st.get("state") != "done" or "result" not in st:
+            return False, f"stream never finished after restart: {st}"
+    finally:
+        d2.drain(timeout_s=10)
+        d2.stop()
+    result = st["result"]
+    resume_level = (result.get("stream") or {}).get("resume-level", 0)
+    if resume_level <= 0:
+        return False, (f"restart searched from level "
+                       f"{resume_level} — checkpoint not resumed "
+                       f"(stream {result.get('stream')})")
+    details.append(f"resumed search at level {resume_level}, not 0")
+    diff = [k for k in _STREAM_VERDICT_KEYS
+            if result.get(k) != offline.get(k)]
+    if diff:
+        return False, (f"streamed verdict differs from offline on "
+                       f"{diff}: {[result.get(k) for k in diff]} != "
+                       f"{[offline.get(k) for k in diff]}")
+    details.append(f"verdict {result['valid']} bit-identical to "
+                   f"offline on {len(_STREAM_VERDICT_KEYS)} keys")
+    return True, "; ".join(details)
+
+
+def scenario_stream_dup(seed):
+    """A duplicate / out-of-order chunk storm against the streaming
+    intake: every chunk is sent twice, even-indexed chunks arrive
+    before their predecessors, and an acked chunk is re-posted after
+    close. The at-least-once contract says none of it may show — the
+    sealed session's history.json must be BYTE-identical to a clean
+    in-order session's, and the verdict identical to the offline
+    analyze path (doc/serve.md "Streaming API")."""
+    import tempfile
+
+    from jepsen_tpu import serve as serve_ns
+    from jepsen_tpu import stream as stream_mod
+    from jepsen_tpu.checker import check_safe
+    from jepsen_tpu.checker.wgl import linearizable
+    from jepsen_tpu.history import History
+
+    root = tempfile.mkdtemp(prefix="jepsen-chaos-streamdup-")
+    h = simulate_register_history(240, n_procs=4, n_vals=4, seed=seed)
+    ops = [o.to_dict() for o in h]
+    offline = check_safe(linearizable(CASRegister(), backend="tpu"),
+                         {"name": "chaos-streamdup-offline"},
+                         History.of(ops))
+    chunks = [ops[i:i + 20] for i in range(0, len(ops), 20)]
+
+    daemon = serve_ns.CheckDaemon(
+        serve_ns.ServeConfig(root=os.path.join(root, "serve"),
+                             backend="tpu", workers=1))
+    daemon.start()
+
+    def run_session(tenant, storm):
+        _, body, _ = daemon.stream_open({"tenant": tenant,
+                                         "model": "cas-register"})
+        sid = body["id"]
+        dup = reordered = 0
+        if storm:
+            # pairwise swap + double-send: seq 1 lands before seq 0,
+            # every chunk repeats, and chunk 0 is re-posted at the end
+            order = []
+            for i in range(0, len(chunks), 2):
+                pair = ([i + 1, i] if i + 1 < len(chunks) else [i])
+                order.extend(pair + pair)
+            order.append(0)
+        else:
+            order = list(range(len(chunks)))
+        for seq in order:
+            code, body, _ = daemon.stream_append(
+                sid, {"seq": seq, "ops": chunks[seq],
+                      "crc": stream_mod.chunk_crc(chunks[seq])})
+            if code != 202:
+                return None, (f"{tenant} chunk {seq} answered "
+                              f"{code}: {body}")
+            dup += bool(body.get("duplicate"))
+            reordered += bool(body.get("buffered"))
+        code, body, _ = daemon.stream_close(sid, {"chunks": len(chunks)})
+        if code != 200:
+            return None, f"{tenant} close answered {code}: {body}"
+        if storm:
+            # at-least-once survives sealing: a late duplicate of an
+            # acked chunk after close is absorbed, not an error
+            code, body, _ = daemon.stream_append(
+                sid, {"seq": 0, "ops": chunks[0],
+                      "crc": stream_mod.chunk_crc(chunks[0])})
+            if code != 202 or not body.get("duplicate"):
+                return None, (f"{tenant} dup-after-close answered "
+                              f"{code}: {body}")
+        deadline = time.time() + 120
+        st = {}
+        while time.time() < deadline:
+            st = daemon.stream_status(sid) or {}
+            if st.get("state") == "done" and "result" in st:
+                break
+            time.sleep(0.05)
+        if st.get("state") != "done" or "result" not in st:
+            return None, f"{tenant} stream never finished: {st}"
+        st["dup-sent"] = dup
+        st["reordered-sent"] = reordered
+        st["history-path"] = os.path.join(
+            daemon.config.root, "streams", sid, stream_mod.HISTORY_NAME)
+        return st, None
+
+    try:
+        clean, err = run_session("clean", storm=False)
+        if err:
+            return False, err
+        storm, err = run_session("storm", storm=True)
+        if err:
+            return False, err
+    finally:
+        daemon.drain(timeout_s=10)
+        daemon.stop()
+
+    details = []
+    if not storm["dup-sent"] or not storm["reordered-sent"]:
+        return False, (f"storm was not a storm: {storm['dup-sent']} "
+                       f"dup(s), {storm['reordered-sent']} reorder(s)")
+    details.append(f"storm absorbed {storm['dup-sent']} duplicate and "
+                   f"{storm['reordered-sent']} out-of-order chunk(s)")
+    with open(clean["history-path"], "rb") as f:
+        clean_bytes = f.read()
+    with open(storm["history-path"], "rb") as f:
+        storm_bytes = f.read()
+    if clean_bytes != storm_bytes:
+        return False, ("storm history.json differs from the clean "
+                       "session's — intake is not idempotent")
+    details.append(f"history.json byte-identical to the clean "
+                   f"session's ({len(storm_bytes)} bytes)")
+    for st in (clean, storm):
+        diff = [k for k in _STREAM_VERDICT_KEYS
+                if st["result"].get(k) != offline.get(k)]
+        if diff:
+            return False, (f"{st['tenant']} verdict differs from "
+                           f"offline on {diff}")
+    details.append(f"both verdicts ({offline['valid']}) identical to "
+                   f"offline")
+    return True, "; ".join(details)
+
+
 SCENARIOS = (
     ("oom", scenario_oom),
     ("wedge", scenario_wedge),
@@ -1552,6 +1831,8 @@ SCENARIOS = (
     ("trace-request-kill", scenario_trace_request_kill),
     ("serve-batch-poison", scenario_serve_batch_poison),
     ("serve-fleet-host-kill", scenario_serve_fleet_host_kill),
+    ("stream-kill", scenario_stream_kill),
+    ("stream-dup", scenario_stream_dup),
 )
 
 

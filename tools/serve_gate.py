@@ -25,6 +25,12 @@ served request. This gate IS that request:
   names under that ONE trace id (admission -> warm/compile -> device
   segment -> verdict), and at least one /metrics histogram bucket
   carries an OpenMetrics exemplar pointing at a trace id;
+* the streaming intake must survive CI at scale: a 10k-op stream built
+  on the real localkv history goes in as CRC'd sequenced chunks
+  (``POST /stream`` / ``/stream/<id>/ops`` / ``/close``,
+  doc/serve.md "Streaming API"), the online checker's verdict must be
+  ``valid: true`` AND identical to the offline verdict over the same
+  ops, and ``/healthz`` must report the session;
 * ``POST /drain`` must finish in-flight work and release the daemon
   (exit-0 contract);
 * a SECOND daemon stands up fleet-backed (``--fleet 2``, two real
@@ -221,6 +227,96 @@ def main() -> int:
         if ' # {trace_id="' not in metrics_text:
             problems.append("no OpenMetrics exemplar on any /metrics "
                             "histogram bucket")
+        # 3b. the streaming leg: a 10k-op stream built on the SAME real
+        # localkv history (extended with a sequential write/read tail on
+        # a fresh process, which keeps the combined single-register
+        # history valid) goes in as CRC'd sequenced chunks, and the
+        # online checker's verdict must equal the offline verdict over
+        # the same ops (doc/serve.md, "Streaming API")
+        from jepsen_tpu import stream as stream_ns
+        stream_ops = list(history)
+        t_next = 1 + max((op.get("time") or 0) for op in history)
+        i_next = len(history)
+        proc = 1 + max((op.get("process") or 0) for op in history
+                       if isinstance(op.get("process"), int))
+        value = 1_000_000
+        while len(stream_ops) < 10_000:
+            for f, val, typ in (("write", value, "invoke"),
+                                ("write", value, "ok"),
+                                ("read", None, "invoke"),
+                                ("read", value, "ok")):
+                stream_ops.append({"type": typ, "f": f, "value": val,
+                                   "process": proc, "time": t_next,
+                                   "index": i_next})
+                t_next += 1
+                i_next += 1
+            value += 1
+        chunks = [stream_ops[i:i + 500]
+                  for i in range(0, len(stream_ops), 500)]
+        code, body, _ = _post(port, "/stream",
+                              {"tenant": "gate-stream",
+                               "model": "cas-register"})
+        if code != 202:
+            problems.append(f"POST /stream answered {code}: {body}")
+        else:
+            sid = body["id"]
+            seq = 0
+            deadline = time.time() + args.budget
+            while seq < len(chunks) and time.time() < deadline:
+                code, body, _ = _post(
+                    port, f"/stream/{sid}/ops",
+                    {"seq": seq, "ops": chunks[seq],
+                     "crc": stream_ns.chunk_crc(chunks[seq])})
+                if code == 202:
+                    seq += 1
+                elif code == 429:
+                    time.sleep(float(body.get("retry-after-s", 0.2)))
+                elif code == 409 and "need" in body:
+                    seq = int(body["need"])
+                else:
+                    problems.append(f"stream chunk {seq} answered "
+                                    f"{code}: {body}")
+                    break
+            code, body, _ = _post(port, f"/stream/{sid}/close",
+                                  {"chunks": len(chunks)})
+            if code != 200:
+                problems.append(f"stream close answered {code}: {body}")
+            sdoc = {}
+            deadline = time.time() + args.budget
+            while time.time() < deadline:
+                _, sdoc = _get(port, f"/stream/{sid}")
+                if sdoc.get("state") == "done" and "result" in sdoc:
+                    break
+                time.sleep(0.1)
+            if sdoc.get("state") != "done" or "result" not in sdoc:
+                problems.append(f"stream never finished: state="
+                                f"{sdoc.get('state')!r}")
+            else:
+                from jepsen_tpu.checker import check_safe
+                from jepsen_tpu.checker.wgl import linearizable
+                from jepsen_tpu.history import History
+                from jepsen_tpu.models import CASRegister
+                offline_stream = check_safe(
+                    linearizable(CASRegister(), backend="tpu"),
+                    {"name": "serve-gate-stream-offline"},
+                    History.of(stream_ops))
+                got = sdoc["result"].get("valid")
+                if got is not True:
+                    problems.append(f"streamed verdict {got!r}, "
+                                    f"want True")
+                if got != offline_stream.get("valid"):
+                    problems.append(
+                        f"streamed verdict {got!r} != offline "
+                        f"{offline_stream.get('valid')!r} over the "
+                        f"same {len(stream_ops)} ops")
+                _, health = _get(port, "/healthz")
+                sm = health.get("streams") or {}
+                if not sm.get("sessions"):
+                    problems.append(
+                        f"healthz reports no stream session: {sm}")
+                print(f"# serve-gate: streamed {len(stream_ops)} ops "
+                      f"in {len(chunks)} chunk(s), verdict matches "
+                      f"offline")
         code, drained, _ = _post(port, "/drain", None)
         if code != 200 or not drained.get("drained"):
             problems.append(f"drain answered {code}: {drained}")
