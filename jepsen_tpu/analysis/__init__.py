@@ -24,9 +24,12 @@ that front end, done statically. Four passes:
    ``_jit_single``/``_jit_segment``/``_jit_batch`` caches, bit-width
    overflow in the packed op encoding.
 4. :mod:`~jepsen_tpu.analysis.lockset_lint` — a static race detector
-   for the threaded orchestrator: reads/writes of
+   for the threaded stack: the legacy dict-key engine flags access to
    ``_history_lock``-guarded state outside a ``with
-   test["_history_lock"]`` block.
+   test["_history_lock"]`` block; the generalized class engine
+   auto-discovers per-class locks and guarded attribute sets
+   (inference + ``# guarded-by:`` annotations) across the serving
+   scope and flags off-lock / wrong-lock access.
 5. :mod:`~jepsen_tpu.analysis.plan_lint` — ahead-of-time search-plan
    verification (engine: :mod:`jepsen_tpu.checker.plan`): proves the
    shape buckets the device search would compile actually trace, fit
@@ -34,6 +37,17 @@ that front end, done statically. Four passes:
    over a pinned model × dims fixture matrix, with zero XLA compiles.
    Doubles as the mandatory pre-search plan gate in
    :mod:`jepsen_tpu.checker.tpu` (kill switch ``JTPU_PLAN_GATE=0``).
+6. :mod:`~jepsen_tpu.analysis.deadlock_lint` — joint lock-acquisition
+   graph over the serving scope: lock-order cycles
+   (``LOCK-ORDER-CYCLE``) and locks held across blocking operations
+   (``LOCK-HELD-BLOCKING``: device calls, fsync, sleeps, socket
+   sends, joins, subprocess waits).
+7. :mod:`~jepsen_tpu.analysis.walcheck_lint` — crash-consistency
+   dominance dataflow on the serve/stream intake paths: every success
+   ack must be dominated by a WAL append (``WAL-ACK-BEFORE-JOURNAL``),
+   run-dir artifacts must go through tmp + ``os.replace``
+   (``ATOMIC-WRITE-DIRECT``), and tmp names in dir-scanned
+   directories must be dot-prefixed (``ATOMIC-TMP-SCANNED``).
 
 Findings carry file:line, a rule id, and a severity; a committed
 baseline file (:mod:`~jepsen_tpu.analysis.baseline`) suppresses
@@ -136,12 +150,22 @@ def worst_severity(findings: Iterable[Finding]) -> Optional[str]:
 DEFAULT_SCOPES = {
     "suite": ("jepsen_tpu/suites",),
     "jax": ("jepsen_tpu/checker", "jepsen_tpu/ops/encode.py",
-            "jepsen_tpu/obs", "jepsen_tpu/resilience.py"),
+            "jepsen_tpu/obs", "jepsen_tpu/resilience.py",
+            "jepsen_tpu/serve.py", "jepsen_tpu/stream.py"),
     "lockset": ("jepsen_tpu/core.py", "jepsen_tpu/journal.py",
-                "jepsen_tpu/nemesis", "jepsen_tpu/obs"),
+                "jepsen_tpu/nemesis", "jepsen_tpu/obs",
+                "jepsen_tpu/serve.py", "jepsen_tpu/stream.py",
+                "jepsen_tpu/fleet.py", "jepsen_tpu/checker/engine.py"),
+    # the deadlock pass is a JOINT analysis: the acquisition graph
+    # spans modules, so its scope is one file set, not per-file
+    "deadlock": ("jepsen_tpu/serve.py", "jepsen_tpu/stream.py",
+                 "jepsen_tpu/fleet.py", "jepsen_tpu/checker/engine.py",
+                 "jepsen_tpu/obs/observatory.py"),
+    "walcheck": ("jepsen_tpu/serve.py", "jepsen_tpu/stream.py"),
 }
 
-PASSES = ("suite", "history", "jax", "lockset", "plan")
+PASSES = ("suite", "history", "jax", "lockset", "deadlock", "walcheck",
+          "plan")
 
 
 def _expand(paths: Iterable[str], root: str) -> List[str]:
@@ -161,11 +185,14 @@ def lint_files(paths: Iterable[str], passes: Iterable[str] = PASSES,
                root: Optional[str] = None) -> List[Finding]:
     """Run the code passes over explicit files (.py) and history
     artifacts (.jsonl / .wal)."""
-    from jepsen_tpu.analysis import history_lint, jax_lint, lockset_lint
-    from jepsen_tpu.analysis import suite_lint
+    from jepsen_tpu.analysis import (
+        deadlock_lint, history_lint, jax_lint, lockset_lint, suite_lint,
+        walcheck_lint,
+    )
     root = root or repo_root()
     passes = tuple(passes)
     findings: List[Finding] = []
+    code_files: List[str] = []
     for p in paths:
         ap = p if os.path.isabs(p) else os.path.join(root, p)
         if not os.path.exists(ap):
@@ -181,25 +208,34 @@ def lint_files(paths: Iterable[str], passes: Iterable[str] = PASSES,
                 findings.extend(history_lint.lint_history_file(ap,
                                                                root=root))
             continue
+        code_files.append(ap)
         if "suite" in passes:
             findings.extend(suite_lint.lint_file(ap, root=root))
         if "jax" in passes:
             findings.extend(jax_lint.lint_file(ap, root=root))
         if "lockset" in passes:
             findings.extend(lockset_lint.lint_file(ap, root=root))
+    # joint passes see all named files at once: cross-module lock
+    # edges and journal closures don't exist per-file
+    if "deadlock" in passes and code_files:
+        findings.extend(deadlock_lint.lint_paths(code_files, root=root))
+    if "walcheck" in passes and code_files:
+        findings.extend(walcheck_lint.lint_paths(code_files, root=root))
     return findings
 
 
 def lint_repo(root: Optional[str] = None,
               passes: Iterable[str] = PASSES,
               histories: Iterable[str] = ()) -> List[Finding]:
-    """Run all four passes at their default scopes over the repo.
+    """Run every pass at its default scope over the repo.
 
     ``histories`` optionally adds saved history files (.jsonl/.wal) for
-    the history pass; the other three scan their DEFAULT_SCOPES.
+    the history pass; the code passes scan their DEFAULT_SCOPES.
     """
-    from jepsen_tpu.analysis import history_lint, jax_lint, lockset_lint
-    from jepsen_tpu.analysis import suite_lint
+    from jepsen_tpu.analysis import (
+        deadlock_lint, history_lint, jax_lint, lockset_lint, suite_lint,
+        walcheck_lint,
+    )
     root = root or repo_root()
     passes = tuple(passes)
     findings: List[Finding] = []
@@ -212,6 +248,12 @@ def lint_repo(root: Optional[str] = None,
     if "lockset" in passes:
         for f in _expand(DEFAULT_SCOPES["lockset"], root):
             findings.extend(lockset_lint.lint_file(f, root=root))
+    if "deadlock" in passes:
+        findings.extend(deadlock_lint.lint_paths(
+            _expand(DEFAULT_SCOPES["deadlock"], root), root=root))
+    if "walcheck" in passes:
+        findings.extend(walcheck_lint.lint_paths(
+            _expand(DEFAULT_SCOPES["walcheck"], root), root=root))
     if "history" in passes:
         for h in histories:
             ap = h if os.path.isabs(h) else os.path.join(root, h)

@@ -1,9 +1,10 @@
-"""Small AST helpers shared by the three code-analysis passes."""
+"""Small AST helpers shared by the code-analysis passes."""
 
 from __future__ import annotations
 
 import ast
-from typing import Dict, Optional, Tuple
+import re
+from typing import Dict, Optional, Set, Tuple
 
 from jepsen_tpu.analysis import ERROR, Finding, relpath
 
@@ -84,4 +85,109 @@ def keyword_arg(call: ast.Call, name: str) -> Optional[ast.AST]:
     for kw in call.keywords:
         if kw.arg == name:
             return kw.value
+    return None
+
+
+def read_source(path: str) -> Optional[str]:
+    """The file's source text, or None when unreadable (the caller has
+    already turned that into a LINT-SYNTAX finding via parse_file)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            return f.read()
+    except OSError:
+        return None
+
+
+def self_attr(node: ast.AST) -> Optional[str]:
+    """'attr' when node is exactly ``self.attr``, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+#: Constructor tails that create a mutual-exclusion object.
+LOCK_CTORS = ("Lock", "RLock")
+
+
+def class_locks(cls: ast.ClassDef) -> Tuple[Set[str], Dict[str, str]]:
+    """Discover a class's lock attributes and condition aliases.
+
+    Returns ``(locks, alias)`` where ``locks`` is the set of ``self``
+    attribute names bound to ``threading.Lock()`` / ``RLock()`` (or a
+    bare ``Condition()``, which owns its lock), and ``alias`` maps a
+    ``Condition(self.x)`` attribute to the lock attribute it wraps —
+    ``with self.cond:`` and ``with self.x:`` are the same acquisition.
+    """
+    locks: Set[str] = set()
+    alias: Dict[str, str] = {}
+    for node in ast.walk(cls):
+        if not (isinstance(node, ast.Assign) and
+                isinstance(node.value, ast.Call)):
+            continue
+        tail = dotted(node.value.func).rsplit(".", 1)[-1]
+        for t in node.targets:
+            a = self_attr(t)
+            if a is None:
+                continue
+            if tail in LOCK_CTORS:
+                locks.add(a)
+            elif tail == "Condition":
+                arg = node.value.args[0] if node.value.args else None
+                wrapped = self_attr(arg) if arg is not None else None
+                if wrapped:
+                    alias[a] = wrapped
+                else:
+                    locks.add(a)
+    return locks, alias
+
+
+def canon_lock(attr: str, alias: Dict[str, str]) -> str:
+    """Resolve condition-alias chains to the canonical lock attribute."""
+    seen: Set[str] = set()
+    while attr in alias and attr not in seen:
+        seen.add(attr)
+        attr = alias[attr]
+    return attr
+
+
+GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_]\w*|none)")
+
+
+def guarded_by_lines(src: str) -> Dict[int, str]:
+    """1-based line number -> lock name for every ``# guarded-by: x``
+    trailing annotation in the source (``none`` opts an attribute out
+    of lockset inference)."""
+    out: Dict[int, str] = {}
+    for i, line in enumerate(src.splitlines(), start=1):
+        m = GUARDED_BY_RE.search(line)
+        if m:
+            out[i] = m.group(1)
+    return out
+
+
+def parent_map(tree: ast.Module) -> Dict[int, ast.AST]:
+    """id(child) -> parent node, for upward pattern matching."""
+    out: Dict[int, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            out[id(child)] = node
+    return out
+
+
+def class_methods(cls: ast.ClassDef
+                  ) -> Dict[str, ast.FunctionDef]:
+    """name -> FunctionDef for the class's direct methods (nested
+    classes and their methods are not included)."""
+    return {m.name: m for m in cls.body
+            if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def self_call_name(call: ast.Call) -> Optional[str]:
+    """'m' when the call is exactly ``self.m(...)``, else None — the
+    intra-class call-graph edge used for inter-procedural locksets."""
+    if isinstance(call.func, ast.Attribute) and \
+            isinstance(call.func.value, ast.Name) and \
+            call.func.value.id == "self":
+        return call.func.attr
     return None

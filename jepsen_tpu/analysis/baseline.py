@@ -70,9 +70,9 @@ def split(findings: Iterable[Finding], baseline: Dict[str, str]
     return new, accepted
 
 
-def render(findings: Iterable[Finding],
-           justifications: Optional[Dict[str, str]] = None) -> str:
-    """Baseline text for a finding set, preserving any existing
+def render_keys(keys: Iterable[str],
+                justifications: Optional[Dict[str, str]] = None) -> str:
+    """Baseline text for a set of keys, preserving any existing
     justifications and stubbing the rest (a stub must be replaced by a
     real justification before committing — the gate treats the entry as
     accepted either way, the review process should not)."""
@@ -83,10 +83,15 @@ def render(findings: Iterable[Finding],
         "# Regenerate with: python -m jepsen_tpu lint --write-baseline",
         "",
     ]
-    for f in sorted(set(x.key() for x in findings)):
-        just = justifications.get(f) or STUB
-        lines.append(f"{f}{_SEP}{just}")
+    for k in sorted(set(keys)):
+        just = justifications.get(k) or STUB
+        lines.append(f"{k}{_SEP}{just}")
     return "\n".join(lines) + "\n"
+
+
+def render(findings: Iterable[Finding],
+           justifications: Optional[Dict[str, str]] = None) -> str:
+    return render_keys((x.key() for x in findings), justifications)
 
 
 def write(path: str, findings: Iterable[Finding],
@@ -94,3 +99,19 @@ def write(path: str, findings: Iterable[Finding],
     existing = load(path) if keep_existing else {}
     with open(path, "w", encoding="utf-8") as f:
         f.write(render(findings, existing))
+
+
+def prune(path: str, live_keys: Iterable[str]) -> List[str]:
+    """Rewrite the baseline dropping entries whose key no longer
+    matches any live finding (the accepted debt was fixed); surviving
+    entries keep their justifications verbatim. Returns the pruned
+    keys; a baseline with no stale entries is left untouched."""
+    existing = load(path)
+    live = set(live_keys)
+    stale = sorted(k for k in existing if k not in live)
+    if not stale:
+        return []
+    survivors = {k: j for k, j in existing.items() if k in live}
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(render_keys(survivors.keys(), survivors))
+    return stale

@@ -140,9 +140,12 @@ class Engine:
         self.hits = 0
 
     def __repr__(self):
-        return (f"<Engine {self.name!r} entries={len(self._fns)} "
-                f"builds={self.builds} hits={self.hits} "
-                f"warm-buckets={len(self._warm)}>")
+        with self._lock:
+            entries, warm = len(self._fns), len(self._warm)
+            builds, hits = self.builds, self.hits
+        return (f"<Engine {self.name!r} entries={entries} "
+                f"builds={builds} hits={hits} "
+                f"warm-buckets={warm}>")
 
     # -- executable cache ---------------------------------------------------
 

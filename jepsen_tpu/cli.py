@@ -1268,10 +1268,12 @@ def _trace_request(opts, d: str) -> int:
 
 
 def lint_cmd() -> dict:
-    """The 'lint' subcommand: the four-pass static analyzer
+    """The 'lint' subcommand: the seven-pass static analyzer
     (jepsen_tpu.analysis) — suite linter, history linter, JAX hazard
-    pass, lockset pass — gated against the committed baseline so CI
-    fails on NEW findings only. See doc/lint.md for the rule catalog."""
+    pass, lockset pass, plan verification, deadlock pass,
+    crash-consistency pass — gated against the committed baseline so
+    CI fails on NEW findings only. See doc/lint.md for the rule
+    catalog."""
 
     def build_parser():
         from jepsen_tpu import analysis
@@ -1302,6 +1304,11 @@ def lint_cmd() -> dict:
                             "baseline file (existing justifications "
                             "are preserved; new entries get a TODO "
                             "stub to fill in before committing)")
+        p.add_argument("--prune-stale", action="store_true",
+                       help="rewrite the baseline dropping entries "
+                            "that no longer match any finding (the "
+                            "accepted debt was fixed); surviving "
+                            "entries keep their justifications")
         p.add_argument("--strict", action="store_true",
                        help="exit nonzero on new warnings too, not "
                             "just errors")
@@ -1333,6 +1340,14 @@ def lint_cmd() -> dict:
             bl.write(bpath, findings)
             print(f"# lint: baseline written to {bpath} "
                   f"({len(findings)} finding(s))")
+            return OK
+        if opts.get("prune_stale"):
+            pruned = bl.prune(bpath, (f.key() for f in findings))
+            for key in pruned:
+                print(f"# lint: pruned stale baseline entry: {key}")
+            print(f"# lint: {len(pruned)} stale baseline entr"
+                  f"{'y' if len(pruned) == 1 else 'ies'} pruned from "
+                  f"{bpath}")
             return OK
         accepted_keys = {} if opts.get("no_baseline") else bl.load(bpath)
         new, accepted = bl.split(findings, accepted_keys)

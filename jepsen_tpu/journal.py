@@ -190,6 +190,7 @@ class Journal:
     def __init__(self, path: str, sync: Optional[str] = None,
                  batch_s: Optional[float] = None):
         self.path = path
+        # guarded-by: none — sync policy is immutable after init
         self.sync = sync if sync in SYNC_POLICIES else sync_policy()
         self.batch_s = batch_window_s() if batch_s is None else batch_s
         self.records = 0
@@ -202,8 +203,10 @@ class Journal:
         self._f = open(path, "ab", buffering=0)
 
     def __repr__(self):
-        state = f"failed: {self.failed}" if self.failed else \
-            ("closed" if self._f is None else "open")
+        with self._lock:
+            failed, closed = self.failed, self._f is None
+        state = f"failed: {failed}" if failed else \
+            ("closed" if closed else "open")
         return (f"<Journal {self.path!r} sync={self.sync} "
                 f"records={self.records} syncs={self.syncs} {state}>")
 

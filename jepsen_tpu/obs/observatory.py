@@ -201,13 +201,14 @@ class Observatory:
                           if self._rate else None)
             self._seq += 1
             snap = dict(p)
+            rate, exp_rate = self._rate, self._exp_rate
         _LEVEL.set(level)
         _FRONTIER_ROWS.set(frontier)
         _SEGMENTS_DONE.set(segments)
-        if self._rate is not None:
-            _LEVELS_PER_S.set(self._rate)
-        if self._exp_rate is not None:
-            _CONFIGS_PER_S.set(self._exp_rate)
+        if rate is not None:
+            _LEVELS_PER_S.set(rate)
+        if exp_rate is not None:
+            _CONFIGS_PER_S.set(exp_rate)
         if snap["eta-s"] is not None:
             _ETA.set(snap["eta-s"])
         self._write(snap)

@@ -863,6 +863,13 @@ class CheckDaemon:
 
     # -- admission ----------------------------------------------------------
 
+    def _bump(self, key: str, n: int = 1) -> None:
+        """Serialized ``stats`` increment: the counters are written by
+        every worker thread plus the admission path, and ``+=`` on a
+        dict entry is a read-modify-write that loses updates off-lock."""
+        with self._lock:
+            self.stats[key] += n
+
     def submit(self, doc: Dict[str, Any], replayed: bool = False
                ) -> Tuple[int, Dict[str, Any], Dict[str, str]]:
         """Admission-controlled enqueue. Returns ``(http_status, body,
@@ -871,7 +878,7 @@ class CheckDaemon:
                    **extra):
             if not replayed:
                 _REJECTED.inc(reason=reason)
-                self.stats["rejected"] += 1
+                self._bump("rejected")
             hdrs = {}
             if retry is not None:
                 hdrs["Retry-After"] = str(max(1, int(round(retry))))
@@ -935,8 +942,9 @@ class CheckDaemon:
                         tb = self._rate[tenant] = TokenBucket(
                             self.config.rate_limit, burst)
                     wait = tb.take()
+                    depth = self._depth
                 if wait > 0.0:
-                    self.stats["rate-limited"] += 1
+                    self._bump("rate-limited")
                     _RATE_LIMITED.inc(tenant=tenant)
                     # Retry-After: the token refill wait, floored by
                     # the fleet-capacity-aware service estimate — a
@@ -944,7 +952,7 @@ class CheckDaemon:
                     # the hint beyond the nominal refill
                     return reject(429, "rate-limited",
                                   retry=max(wait, self._retry_after()
-                                            if self._depth else wait),
+                                            if depth else wait),
                                   tenant=tenant)
             ok, retry, probe = self.breaker.allow(bucket)
             if not ok:
@@ -1019,13 +1027,14 @@ class CheckDaemon:
             q.append(req)
             self._by_id[req.id] = req
             self._depth += 1
+            depth = self._depth
             if footprint:
                 self._footprint_committed += footprint
             self._work.notify()
-        _QUEUE_DEPTH.set(self._depth)
+        _QUEUE_DEPTH.set(depth)
         if not replayed:
             _ADMITTED.inc(tenant=tenant)
-            self.stats["admitted"] += 1
+            self._bump("admitted")
         self._publish()
         body = {"id": req.id, "state": "queued", "tenant": tenant}
         if bucket is not None:
@@ -1216,7 +1225,7 @@ class CheckDaemon:
                       "deadline-s": req.deadline_s,
                       "error-class": WEDGE}
             _TIMEOUTS.inc()
-            self.stats["timeouts"] += 1
+            self._bump("timeouts")
         else:
             result = box.get("r") or {"valid": "unknown",
                                       "error": "worker died"}
@@ -1276,9 +1285,10 @@ class CheckDaemon:
             "event": "gang", "ids": [r.id for r in gang],
             "tenants": [r.tenant for r in gang],
             "bucket": list(gang[0].bucket or ()), "ts": time.time()})
-        self.stats["batches"] += 1
-        self.stats["max-batch"] = max(self.stats["max-batch"],
-                                      len(gang))
+        with self._lock:
+            self.stats["batches"] += 1
+            self.stats["max-batch"] = max(self.stats["max-batch"],
+                                          len(gang))
         model = self._models()[gang[0].model]()
         pks: list = []
         kernel = None
@@ -1330,7 +1340,7 @@ class CheckDaemon:
         poison_set = set(poison)
         if bisections:
             _BATCH_BISECTIONS.inc(bisections)
-            self.stats["bisections"] += bisections
+            self._bump("bisections", bisections)
         # Serial-equivalence: whatever the gang could not decide (an
         # exhausted ladder, a crashed-set overflow) re-runs the EXACT
         # serial path — device escalation plus the wgl CPU fallback —
@@ -1388,7 +1398,7 @@ class CheckDaemon:
             if timed_out:
                 result.setdefault("deadline-s", req.deadline_s)
                 _TIMEOUTS.inc()
-                self.stats["timeouts"] += 1
+                self._bump("timeouts")
             result["serve"] = {
                 "id": req.id, "tenant": req.tenant,
                 "seconds": round(secs, 6), "timed-out": timed_out,
@@ -1404,7 +1414,7 @@ class CheckDaemon:
                     req, queue_s[i], secs, extra_trace=leader.trace)
             if i in poison_set:
                 _BATCH_POISON.inc(tenant=req.tenant)
-                self.stats["poisoned"] += 1
+                self._bump("poisoned")
             self.breaker.record(req.bucket,
                                 result_failure_class(result), req.probe)
             self._finish(req, result, secs, batch_size=len(gang),
@@ -1416,7 +1426,10 @@ class CheckDaemon:
         # result file first (tmp+replace), then the done journal record:
         # a crash between them re-runs the request, never loses it
         path = os.path.join(self.config.root, f"{req.id}.json")
-        tmp = f"{path}.tmp.{os.getpid()}"
+        # dot-prefixed: run-dir scanners (stream replay, GC, listings)
+        # must never see a torn tmp file as an artifact
+        tmp = os.path.join(self.config.root,
+                           f".{req.id}.json.tmp.{os.getpid()}")
         try:
             with open(tmp, "w") as f:
                 json.dump(result, f, default=repr)
@@ -1456,10 +1469,11 @@ class CheckDaemon:
             self._service_ewma = (per if self._service_ewma is None
                                   else 0.3 * per
                                   + 0.7 * self._service_ewma)
+            self.stats["completed"] += 1
+            inflight = len(self._inflight)
             self._work.notify_all()
-        _INFLIGHT.set(len(self._inflight))
+        _INFLIGHT.set(inflight)
         _COMPLETED.inc(valid=str(result.get("valid")))
-        self.stats["completed"] += 1
         self._publish()
 
     def _worker_loop(self) -> None:
@@ -1512,11 +1526,13 @@ class CheckDaemon:
             self.placer.start()
         pending, stats = RequestJournal.replay(self.journal.path)
         self.replay_stats = dict(stats, requeued=len(pending))
+        replayed_n = 0
         for doc in pending:
             code, body, _ = self.submit(doc, replayed=True)
             if code == 202:
                 _REPLAYED.inc()
-                self.stats["replayed"] += 1
+                replayed_n += 1
+                self._bump("replayed")
             else:
                 # journaled but no longer admissible (e.g. the history
                 # decodes malformed after a corrupt WAL line): record a
@@ -1533,7 +1549,7 @@ class CheckDaemon:
             self._threads.append(t)
         self._publish(force=True)
         log.info("check daemon up: %d worker(s), %d replayed request(s)",
-                 len(self._threads), self.stats["replayed"])
+                 len(self._threads), replayed_n)
         return self
 
     def drain(self, timeout_s: float = 600.0) -> Dict[str, Any]:
@@ -1555,17 +1571,19 @@ class CheckDaemon:
             while time.monotonic() < deadline:
                 with self._lock:
                     finishing = [s for s in self._streams.values()
-                                 if s.state == "closed"]
+                                 if s is not None
+                                 and s.state == "closed"]
                 if not finishing:
                     break
                 time.sleep(0.05)
         with self._lock:
             inflight = len(self._inflight)
+            completed = self.stats["completed"]
         self._publish(force=True, state="drained")
         self.drained.set()
         return {"drained": True, "was-queued": queued,
                 "inflight-remaining": inflight,
-                "completed": self.stats["completed"]}
+                "completed": completed}
 
     def stop(self) -> None:
         self._stop.set()
@@ -1575,7 +1593,8 @@ class CheckDaemon:
             t.join(timeout=2.0)
         if self._streams is not None:
             with self._lock:
-                sessions = list(self._streams.values())
+                sessions = [s for s in self._streams.values()
+                            if s is not None]
             for s in sessions:
                 if s.runner is not None:
                     s.runner.stop()
@@ -1623,17 +1642,24 @@ class CheckDaemon:
         if model_name not in self._models():
             return 400, {"error": "bad-request",
                          "detail": f"unknown model {model_name!r}"}, {}
+        # quota check + slot reservation are ONE critical section: two
+        # concurrent opens racing past a split check would both admit
+        # at stream_max - 1 and overflow the quota. The reserved slot
+        # holds None until the (I/O-heavy) session construction lands;
+        # every _streams iteration tolerates the placeholder.
         with self._lock:
             live = sum(1 for s in self._streams.values()
-                       if s.state != "done")
-        if live >= self.config.stream_max:
+                       if s is None or s.state != "done")
+            over = live >= self.config.stream_max
+            if not over:
+                self._stream_seq += 1
+                sid = f"s{self._stream_seq:06d}-{os.getpid()}"
+                self._streams[sid] = None
+        if over:
             retry = self._retry_after()
             return 429, {"error": "stream-quota", "open": live,
                          "retry-after-s": round(retry, 3)}, \
                 {"Retry-After": str(max(1, int(round(retry))))}
-        with self._lock:
-            self._stream_seq += 1
-            sid = f"s{self._stream_seq:06d}-{os.getpid()}"
         trace_id, trace_parent = None, None
         if obs_trace.enabled():
             tp = obs_trace.parse_traceparent(doc.get("traceparent"))
@@ -1641,11 +1667,16 @@ class CheckDaemon:
                 trace_id, trace_parent = tp
             else:
                 trace_id = obs_trace.new_trace_id()
-        session = stream_mod.StreamSession(
-            sid, tenant, model_name, self.config.root,
-            reorder_max=self.config.stream_reorder,
-            trace=trace_id, trace_parent=trace_parent)
-        runner = self._make_runner(session)
+        try:
+            session = stream_mod.StreamSession(
+                sid, tenant, model_name, self.config.root,
+                reorder_max=self.config.stream_reorder,
+                trace=trace_id, trace_parent=trace_parent)
+            runner = self._make_runner(session)
+        except BaseException:
+            with self._lock:
+                self._streams.pop(sid, None)
+            raise
         with self._lock:
             self._streams[sid] = session
         runner.start()
@@ -1753,7 +1784,8 @@ class CheckDaemon:
 
     def _stream_summary(self) -> Dict[str, Any]:
         with self._lock:
-            sessions = list(self._streams.values())
+            sessions = [s for s in self._streams.values()
+                        if s is not None]
         by_state = {"open": 0, "closed": 0, "done": 0, "failed": 0}
         ops = checked = lag = 0
         for s in sessions:
@@ -1809,6 +1841,8 @@ class CheckDaemon:
             depth = self._depth
             inflight = len(self._inflight)
             committed = self._footprint_committed
+            stats = dict(self.stats)
+            has_streams = bool(self._streams)
         doc = {
             "ok": True,
             "state": "draining" if self.draining else "serving",
@@ -1820,7 +1854,7 @@ class CheckDaemon:
             "tenants": tenants, "tenant-max": self.config.tenant_max,
             "committed-bytes": committed,
             "budget-bytes": self._capacity_budget(),
-            "stats": dict(self.stats),
+            "stats": stats,
             "replay": dict(self.replay_stats),
             "breakers": self.breaker.snapshot(),
             "engine": {
@@ -1841,7 +1875,7 @@ class CheckDaemon:
                                 hosts=len(self.placer.hosts),
                                 live=self.placer.live(),
                                 backend=self.config.fleet_backend)
-        if self._streams:
+        if has_streams:
             doc["streams"] = self._stream_summary()
         return doc
 
@@ -1893,7 +1927,8 @@ class CheckDaemon:
             # switched-off) streaming feature leaves progress.json
             # byte-identical
             if self._streams:
-                sessions = list(self._streams.values())
+                sessions = [s for s in self._streams.values()
+                            if s is not None]
                 ops = sum(len(s.ops) for s in sessions)
                 checked = sum(s.checked_events for s in sessions)
                 doc["serve"]["streams"] = sum(
@@ -1902,7 +1937,8 @@ class CheckDaemon:
                 doc["serve"]["stream-checked"] = checked
                 doc["serve"]["stream-lag"] = max(0, ops - checked)
         path = os.path.join(self.config.root, PROGRESS_NAME)
-        tmp = f"{path}.tmp.{os.getpid()}"
+        tmp = os.path.join(self.config.root,
+                           f".{PROGRESS_NAME}.tmp.{os.getpid()}")
         try:
             with open(tmp, "w") as f:
                 json.dump(doc, f)

@@ -109,7 +109,9 @@ def _atomic_json(path: str, doc: Any) -> None:
     """tmp+replace with deterministic serialization: the byte-identity
     tests compare these artifacts across delivery orders and across a
     SIGKILL replay."""
-    tmp = f"{path}.tmp.{os.getpid()}"
+    d, base = os.path.split(path)
+    # dot-prefixed so dir scanners (replay, GC) skip torn tmp files
+    tmp = os.path.join(d, f".{base}.tmp.{os.getpid()}")
     with open(tmp, "w") as f:
         json.dump(doc, f, separators=(",", ":"), sort_keys=True,
                   default=repr)
@@ -129,7 +131,7 @@ class StreamSession:
                  reorder_max: int = 64, trace: Optional[str] = None,
                  trace_parent: Optional[str] = None,
                  journal_open: bool = True):
-        self.id = sid
+        self.id = sid               # guarded-by: none — immutable after init
         self.tenant = tenant
         self.model = model
         self.dir = os.path.join(root, "streams", sid)
@@ -322,10 +324,13 @@ class StreamSession:
             return doc
 
     def stop_wal(self) -> None:
-        try:
-            self._wal.close()
-        except OSError:
-            pass
+        # under the session lock: closing mid-_journal would turn a
+        # concurrent fsync'd append into a ValueError on a closed file
+        with self.lock:
+            try:
+                self._wal.close()
+            except OSError:
+                pass
 
     # -- replay -------------------------------------------------------------
 

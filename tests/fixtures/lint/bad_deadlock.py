@@ -1,0 +1,40 @@
+"""Bad fixture for the deadlock pass: ``Left.poke`` acquires
+``Left._lock`` then calls into ``Right.touch`` (which takes
+``Right._lock``), while ``Right.prod`` acquires ``Right._lock`` then
+calls back into ``Left.poke`` — a lock-order cycle. ``Left.flush``
+additionally fsyncs while holding its lock (blocking-while-held)."""
+
+import os
+import threading
+
+
+class Right:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.left = make_left()
+
+    def touch(self):
+        with self._lock:
+            pass
+
+    def prod(self):
+        with self._lock:
+            self.left.poke()
+
+
+class Left:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.right = Right()
+
+    def poke(self):
+        with self._lock:
+            self.right.touch()
+
+    def flush(self, f):
+        with self._lock:
+            os.fsync(f.fileno())
+
+
+def make_left():
+    return Left()

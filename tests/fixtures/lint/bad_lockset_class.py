@@ -1,0 +1,39 @@
+"""Bad fixture for the generalized class lockset engine.
+
+``Counter._count`` is majority-guarded by ``_lock`` (three locked
+accesses) but mutated off-lock in ``racy_incr`` (LOCK-UNGUARDED) and
+read off-lock in the lifecycle method ``stop`` (LOCK-LIFECYCLE);
+``_items`` is annotated guarded-by ``_lock`` but appended under
+``_aux`` (LOCK-INCONSISTENT)."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._aux = threading.Lock()
+        self._count = 0
+        self._items = []  # guarded-by: _lock
+
+    def incr(self):
+        with self._lock:
+            self._count += 1
+
+    def decr(self):
+        with self._lock:
+            self._count -= 1
+
+    def snapshot(self):
+        with self._lock:
+            return self._count
+
+    def racy_incr(self):
+        self._count += 1  # off-lock mutation of a guarded attribute
+
+    def wrong_lock_add(self, x):
+        with self._aux:
+            self._items.append(x)  # wrong lock for an annotated attr
+
+    def stop(self):
+        return self._count  # off-lock, but in a lifecycle method

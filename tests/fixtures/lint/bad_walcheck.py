@@ -1,0 +1,41 @@
+"""Bad fixture for the crash-consistency pass: ``submit`` acks 202
+with no dominating WAL append (the journal write comes AFTER the
+return on no path at all), ``finish`` writes the artifact directly
+under its final name and journals ``done`` before any ``os.replace``,
+and ``publish`` builds a tmp name without a dot prefix in a module
+whose ``replay`` scans the directory."""
+
+import json
+import os
+
+
+class Intake:
+    def __init__(self, root):
+        self.root = root
+        self.wal = open(os.path.join(root, "intake.wal"), "ab")
+
+    def _journal(self, rec):
+        self.wal.write(json.dumps(rec).encode() + b"\n")
+        self.wal.flush()
+
+    def submit(self, req):
+        if req.get("bad"):
+            return 400, {"error": "bad request"}, {}
+        return 202, {"id": req["id"]}, {}  # acked, never journaled
+
+    def finish(self, req, verdict):
+        path = os.path.join(self.root, req["id"] + ".json")
+        with open(path, "w") as f:  # torn artifact under the final name
+            json.dump(verdict, f)
+        self._journal({"event": "done", "id": req["id"]})
+
+    def publish(self, req, doc):
+        tmp = os.path.join(self.root, req["id"] + ".json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, os.path.join(self.root, req["id"] + ".json"))
+
+    def replay(self):
+        for name in os.listdir(self.root):
+            if name.endswith(".json"):
+                yield name

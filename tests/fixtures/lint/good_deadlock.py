@@ -1,0 +1,26 @@
+"""Good fixture for the deadlock pass: a DIAMOND acquisition order —
+``_top`` before either ``_left`` or ``_right``, both before
+``_bottom``. Two paths converge on the same innermost lock without
+ever reversing an edge, so the acquisition graph is acyclic."""
+
+import threading
+
+
+class Diamond:
+    def __init__(self):
+        self._top = threading.Lock()
+        self._left = threading.Lock()
+        self._right = threading.Lock()
+        self._bottom = threading.Lock()
+
+    def via_left(self):
+        with self._top:
+            with self._left:
+                with self._bottom:
+                    return True
+
+    def via_right(self):
+        with self._top:
+            with self._right:
+                with self._bottom:
+                    return True

@@ -1,0 +1,24 @@
+"""Good fixture for the generalized class lockset engine: every
+access to the inferred-guarded attribute holds the lock, and the
+immutable attribute opts out with ``# guarded-by: none``."""
+
+import threading
+
+
+class GoodCounter:
+    def __init__(self, name):
+        self._lock = threading.Lock()
+        self._count = 0
+        self.name = name  # guarded-by: none — immutable after init
+
+    def incr(self):
+        with self._lock:
+            self._count += 1
+
+    def decr(self):
+        with self._lock:
+            self._count -= 1
+
+    def snapshot(self):
+        with self._lock:
+            return self.name, self._count

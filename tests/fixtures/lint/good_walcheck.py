@@ -1,0 +1,41 @@
+"""Good fixture for the crash-consistency pass: the WAL append
+dominates every 202 (the replay arm is exempt — a previous incarnation
+journaled it; the duplicate re-ack is idempotent), the artifact goes
+through a dot-prefixed tmp name and ``os.replace`` BEFORE the ``done``
+record, and ``replay`` skips dot-prefixed names."""
+
+import json
+import os
+
+
+class GoodIntake:
+    def __init__(self, root):
+        self.root = root
+        self.wal = open(os.path.join(root, "intake.wal"), "ab")
+
+    def _journal(self, rec):
+        self.wal.write(json.dumps(rec).encode() + b"\n")
+        self.wal.flush()
+        os.fsync(self.wal.fileno())
+
+    def submit(self, req, replayed=False):
+        if req.get("bad"):
+            return 400, {"error": "bad request"}, {}
+        if req.get("seen"):
+            return 202, {"id": req["id"], "duplicate": True}, {}
+        if not replayed:
+            self._journal({"event": "submit", "id": req["id"]})
+        return 202, {"id": req["id"]}, {}
+
+    def finish(self, req, verdict):
+        final = os.path.join(self.root, req["id"] + ".json")
+        tmp = os.path.join(self.root, f".{req['id']}.json.tmp.{os.getpid()}")
+        with open(tmp, "w") as f:
+            json.dump(verdict, f)
+        os.replace(tmp, final)
+        self._journal({"event": "done", "id": req["id"]})
+
+    def replay(self):
+        for name in os.listdir(self.root):
+            if not name.startswith("."):
+                yield name

@@ -324,6 +324,69 @@ class TestLocksetLint:
         assert all("conj_op" not in f.anchor for f in fs)
         assert not [f for f in fs if f.severity == "error"]
 
+    def test_bad_class_fixture_fires_every_rule(self):
+        fs = _lint("bad_lockset_class.py", passes=("lockset",))
+        assert _rules(fs) == {"LOCK-UNGUARDED", "LOCK-INCONSISTENT",
+                              "LOCK-LIFECYCLE"}
+        by_rule = {f.rule: f for f in fs}
+        # the off-lock mutation is the error; the lifecycle read and
+        # the wrong-lock access are downgraded to warnings
+        assert by_rule["LOCK-UNGUARDED"].severity == "error"
+        assert "racy_incr" in by_rule["LOCK-UNGUARDED"].anchor
+        assert by_rule["LOCK-LIFECYCLE"].severity == "warning"
+        assert "stop" in by_rule["LOCK-LIFECYCLE"].anchor
+        assert "_aux" in by_rule["LOCK-INCONSISTENT"].message
+
+    def test_good_class_fixture_is_clean(self):
+        # consistent locking + a '# guarded-by: none' opt-out: no
+        # findings, including no LOCK-LIFECYCLE noise
+        assert _lint("good_lockset_class.py", passes=("lockset",)) == []
+
+
+# ---------------------------------------------------------------------------
+# Pass 6: deadlock linter
+# ---------------------------------------------------------------------------
+
+class TestDeadlockLint:
+    def test_cycle_fixture_fires(self):
+        fs = _lint("bad_deadlock.py", passes=("deadlock",))
+        assert _rules(fs) == {"LOCK-ORDER-CYCLE", "LOCK-HELD-BLOCKING"}
+        cyc = [f for f in fs if f.rule == "LOCK-ORDER-CYCLE"]
+        assert len(cyc) == 1 and cyc[0].severity == "error"
+        # both locks named, with the witnessing call-site edges
+        assert "Left._lock" in cyc[0].message
+        assert "Right._lock" in cyc[0].message
+        assert "poke() calls touch()" in cyc[0].message
+        blk = [f for f in fs if f.rule == "LOCK-HELD-BLOCKING"]
+        assert len(blk) == 1 and "os.fsync" in blk[0].message
+
+    def test_diamond_lock_order_is_clean(self):
+        # two paths through a diamond (top -> left|right -> bottom)
+        # converge without reversing an edge: acyclic, no findings
+        assert _lint("good_deadlock.py", passes=("deadlock",)) == []
+
+
+# ---------------------------------------------------------------------------
+# Pass 7: crash-consistency (walcheck) linter
+# ---------------------------------------------------------------------------
+
+class TestWalcheckLint:
+    def test_bad_fixture_fires_every_rule(self):
+        fs = _lint("bad_walcheck.py", passes=("walcheck",))
+        assert _rules(fs) == {"WAL-ACK-BEFORE-JOURNAL",
+                              "ATOMIC-WRITE-DIRECT",
+                              "ATOMIC-TMP-SCANNED"}
+        wal = [f for f in fs if f.rule == "WAL-ACK-BEFORE-JOURNAL"]
+        # both shapes: the unjournaled 202 ack AND the 'done' record
+        # journaled before the artifact's os.replace
+        assert any("202" in f.message for f in wal)
+        assert any("'done'" in f.message for f in wal)
+
+    def test_good_fixture_is_clean(self):
+        # journal-before-ack (with the replay-arm and duplicate-re-ack
+        # exemptions exercised) + dot-prefixed tmp + os.replace: clean
+        assert _lint("good_walcheck.py", passes=("walcheck",)) == []
+
 
 # ---------------------------------------------------------------------------
 # Baseline + CLI + self-lint
@@ -440,6 +503,32 @@ class TestLintCLI:
         rc, out = _run_cli(["lint", "--baseline", str(p), target])
         assert rc == cli.OK and "accepted" in out
 
+    def test_prune_stale_drops_fixed_entries_only(self, tmp_path):
+        p = tmp_path / "b.baseline"
+        target = os.path.join(FIX, "bad_lockset.py")
+        rc, _ = _run_cli(["lint", "--baseline", str(p),
+                          "--write-baseline", target])
+        assert rc == cli.OK
+        # justify the live entries, then plant one stale entry
+        p.write_text(p.read_text().replace(
+            bl.STUB, "reviewed: fixture intentionally racy"))
+        stale_key = "LOCK-UNGUARDED gone.py#fixed/x"
+        with open(p, "a", encoding="utf-8") as f:
+            f.write(f"{stale_key} — was fixed long ago\n")
+        rc, out = _run_cli(["lint", "--baseline", str(p),
+                            "--prune-stale", target])
+        assert rc == cli.OK
+        assert stale_key in out and "1 stale baseline entry pruned" in out
+        loaded = bl.load(str(p))
+        assert stale_key not in loaded
+        # survivors keep their justifications verbatim
+        assert loaded and all(j == "reviewed: fixture intentionally racy"
+                              for j in loaded.values())
+        # a second prune is a no-op
+        rc, out = _run_cli(["lint", "--baseline", str(p),
+                            "--prune-stale", target])
+        assert rc == cli.OK and "0 stale baseline entries pruned" in out
+
     def test_self_lint_repo_clean_against_committed_baseline(self):
         # the acceptance gate: all four passes over the live tree,
         # exit 0 against lint.baseline
@@ -455,6 +544,44 @@ class TestLintCLI:
         assert pr.returncode == 0, pr.stdout + pr.stderr
         assert "clean against the baseline" in pr.stdout
         assert "stale baseline entry" not in pr.stdout
+
+    def test_lint_gate_stale_escalation(self, tmp_path):
+        """A stale baseline entry warns for --stale-grace runs (sidecar
+        counter), then FAILS the gate until pruned; the prune clears
+        the escalation and the next clean run removes the sidecar."""
+        import shutil
+        import subprocess
+        p = tmp_path / "lint.baseline"
+        shutil.copyfile(os.path.join(REPO, "lint.baseline"), str(p))
+        stale_key = "LOCK-UNGUARDED gone.py#fixed/x"
+        with open(p, "a", encoding="utf-8") as f:
+            f.write(f"{stale_key} — was fixed long ago\n")
+        cmd = [sys.executable,
+               os.path.join(REPO, "tools", "lint_gate.py"),
+               "--baseline", str(p), "--no-plan", "--stale-grace", "1"]
+
+        r1 = subprocess.run(cmd, capture_output=True, text=True,
+                            timeout=120)
+        assert r1.returncode == 0, r1.stdout + r1.stderr
+        assert "stale baseline entry" in r1.stdout
+        assert "[1/1 warning(s)]" in r1.stdout
+        assert (tmp_path / "lint.baseline.stale").exists()
+
+        r2 = subprocess.run(cmd, capture_output=True, text=True,
+                            timeout=120)
+        assert r2.returncode == 1, r2.stdout + r2.stderr
+        assert "stale past the 1-run grace" in r2.stderr
+        assert "--prune-stale" in r2.stderr
+
+        rc, out = _run_cli(["lint", "--baseline", str(p),
+                            "--prune-stale"])
+        assert rc == cli.OK and stale_key in out
+
+        r3 = subprocess.run(cmd, capture_output=True, text=True,
+                            timeout=120)
+        assert r3.returncode == 0, r3.stdout + r3.stderr
+        assert "stale baseline entry" not in r3.stdout
+        assert not (tmp_path / "lint.baseline.stale").exists()
 
 
 class TestRecoverPathGate:

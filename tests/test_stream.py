@@ -536,6 +536,48 @@ class TestDaemonStreaming:
         finally:
             d.stop()
 
+    def test_stream_quota_has_no_toctou_window(self, tmp_path,
+                                               monkeypatch):
+        """Two concurrent opens racing at stream_max - 1 live sessions
+        must not BOTH be admitted. The quota check and the slot
+        reservation are one critical section; a session ctor stalled
+        mid-construction (I/O) still holds its reserved slot, so the
+        second open sees the quota as full and answers 429."""
+        entered, release = threading.Event(), threading.Event()
+        real_session = stream_ns.StreamSession
+
+        class StalledSession(real_session):
+            def __init__(self, *a, **kw):
+                entered.set()
+                assert release.wait(30), "test never released the ctor"
+                super().__init__(*a, **kw)
+
+        monkeypatch.setattr(stream_ns, "StreamSession", StalledSession)
+        d = _daemon(tmp_path, start=False, stream_max=1)
+        first = {}
+
+        def open_first():
+            first["resp"] = d.stream_open({"model": "cas-register"})
+
+        t = threading.Thread(target=open_first, daemon=True)
+        try:
+            t.start()
+            assert entered.wait(30), "first open never reached the ctor"
+            # the first open is parked INSIDE session construction:
+            # its slot is reserved but the session object doesn't
+            # exist yet — exactly the window the old split check raced
+            code, body, _ = d.stream_open({"model": "cas-register"})
+            assert code == 429 and body["error"] == "stream-quota"
+            # the daemon stays serviceable around the placeholder
+            assert d.healthz()["ok"] is True
+        finally:
+            release.set()
+            t.join(30)
+            d.stop()
+        assert not t.is_alive()
+        code, body, _ = first["resp"]
+        assert code == 202 and body["state"] == "open"
+
     def test_backpressure_429_when_intake_outruns_checker(self, tmp_path):
         d = _daemon(tmp_path, start=False, stream_buffer_ops=10)
         try:

@@ -82,6 +82,11 @@ qualify a new accelerator image before trusting it with long runs):
                    twice, pairs swapped, re-post after close): the
                    sealed history.json is byte-identical to a clean
                    in-order session's and the verdict matches offline
+  lint-seeded-race patch a known-bad pattern (off-lock queue append +
+                   depth bump) into a COPY of serve.py and assert the
+                   lockset static-analysis pass fires LOCK-UNGUARDED
+                   on exactly the seeded method — proving the analyzer
+                   catches the bug class that motivated it
 
 Usage: python tools/chaos_matrix.py [--seed N] [--only NAME ...]
 Exit code 0 iff every selected scenario passes — nonzero on any
@@ -1813,6 +1818,64 @@ def scenario_stream_dup(seed):
     return True, "; ".join(details)
 
 
+def scenario_lint_seeded_race(seed):
+    """Seed a known-bad concurrency pattern (off-lock queue append +
+    depth bump — the exact bug class the lockset pass was built to
+    catch) into a COPY of serve.py; assert LOCK-UNGUARDED fires on the
+    seeded method and on nothing else new. The unpatched copy's
+    findings are the control: only the delta counts, so pre-existing
+    baselined findings can't mask (or fake) the signal."""
+    import shutil
+    import tempfile
+
+    from jepsen_tpu.analysis import lockset_lint
+
+    anchor = "    def _dequeue(self) -> Optional[CheckRequest]:"
+    seeded_method = (
+        "    def _seeded_bad_append(self, req):\n"
+        "        q = self._queues.get(req.tenant)\n"
+        "        if q is None:\n"
+        "            q = self._queues[req.tenant] = deque()\n"
+        "        q.append(req)\n"
+        "        self._depth += 1\n"
+        "        return q\n"
+        "\n"
+    )
+    src_path = os.path.join(REPO, "jepsen_tpu", "serve.py")
+    with open(src_path, encoding="utf-8") as f:
+        src = f.read()
+    if src.count(anchor) != 1:
+        return False, (f"insertion anchor matched {src.count(anchor)} "
+                       f"time(s) in serve.py (need exactly 1) — update "
+                       f"the seeded-race anchor to track the refactor")
+
+    with tempfile.TemporaryDirectory(prefix="jtpu-seeded-race-") as td:
+        pkg = os.path.join(td, "jepsen_tpu")
+        os.makedirs(pkg)
+        clean = os.path.join(pkg, "serve.py")
+        shutil.copyfile(src_path, clean)
+        control = {f.key() for f in lockset_lint.lint_file(clean, td)}
+
+        with open(clean, "w", encoding="utf-8") as f:
+            f.write(src.replace(anchor, seeded_method + anchor))
+        seeded = {f.key() for f in lockset_lint.lint_file(clean, td)}
+
+    delta = sorted(seeded - control)
+    want = [k for k in delta
+            if k.startswith("LOCK-UNGUARDED ")
+            and "_seeded_bad_append" in k]
+    if not want:
+        return False, (f"lockset pass missed the seeded off-lock "
+                       f"append (delta: {delta or 'empty'})")
+    noise = [k for k in delta if "_seeded_bad_append" not in k]
+    if noise:
+        return False, (f"seeding one bad method changed unrelated "
+                       f"findings: {noise}")
+    return True, (f"seeded off-lock append caught: {len(want)} "
+                  f"LOCK-UNGUARDED finding(s) on _seeded_bad_append, "
+                  f"zero collateral findings")
+
+
 SCENARIOS = (
     ("oom", scenario_oom),
     ("wedge", scenario_wedge),
@@ -1833,6 +1896,7 @@ SCENARIOS = (
     ("serve-fleet-host-kill", scenario_serve_fleet_host_kill),
     ("stream-kill", scenario_stream_kill),
     ("stream-dup", scenario_stream_dup),
+    ("lint-seeded-race", scenario_lint_seeded_race),
 )
 
 

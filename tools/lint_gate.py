@@ -1,6 +1,6 @@
 #!/usr/bin/env python
-"""CI lint gate: run the five-pass static analyzer over the repo and
-exit nonzero on any finding not covered by the committed baseline.
+"""CI lint gate: run the full static-analyzer pass set over the repo
+and exit nonzero on any finding not covered by the committed baseline.
 
 Stricter than ``python -m jepsen_tpu lint`` (whose exit code gates on
 new *errors* only): CI should not accumulate new warnings silently
@@ -16,14 +16,23 @@ on CPU, with zero XLA compiles, instead of failing on device minutes
 into a run. ``--no-plan`` skips the traced matrix (the arithmetic
 matrix still runs inside the repo scan).
 
+Stale baseline entries (accepted debt that was since fixed) warn, and
+the warnings ESCALATE: a sidecar counter file next to the baseline
+(``<baseline>.stale``) tracks how many consecutive gate runs each
+entry has been stale; past ``--stale-grace`` runs (default 3) the gate
+fails until someone runs ``python -m jepsen_tpu lint --prune-stale``.
+A clean run deletes the sidecar.
+
 Usage: python tools/lint_gate.py [--baseline FILE] [--root DIR]
                                  [--sarif OUT] [--no-plan]
+                                 [--stale-grace N]
 Exit code 0 iff the tree is clean against the baseline.
 ``--sarif OUT`` additionally writes the new findings as SARIF 2.1.0
 (doc/lint.md) so CI can annotate the pull request inline.
 """
 
 import argparse
+import json
 import os
 import sys
 import time
@@ -49,6 +58,11 @@ def main() -> int:
     ap.add_argument("--no-plan", action="store_true",
                     help="skip the traced plan fixture matrix (the "
                          "arithmetic plan pass still runs)")
+    ap.add_argument("--stale-grace", type=int, default=3, metavar="N",
+                    help="fail once a baseline entry has been stale "
+                         "for more than N consecutive gate runs "
+                         "(default: 3; prune with 'python -m "
+                         "jepsen_tpu lint --prune-stale')")
     args = ap.parse_args()
 
     root = args.root or REPO
@@ -70,12 +84,36 @@ def main() -> int:
     new, accepted = bl.split(findings, accepted_keys)
 
     # A baseline entry that no longer matches anything is stale — warn
-    # so accepted debt gets cleaned out when the finding is fixed.
+    # so accepted debt gets cleaned out when the finding is fixed. The
+    # warnings escalate: the sidecar counts consecutive stale runs per
+    # key, and past the grace the gate fails until a prune.
     live = {f.key() for f in findings}
     stale = [k for k in accepted_keys if k not in live]
+    sidecar = bpath + ".stale"
+    counts = {}
+    if os.path.exists(sidecar):
+        try:
+            with open(sidecar, encoding="utf-8") as f:
+                counts = {k: int(v) for k, v in json.load(f).items()}
+        except (OSError, ValueError):
+            counts = {}
+    counts = {k: counts.get(k, 0) + 1 for k in stale}
+    if stale:
+        try:
+            with open(sidecar, "w", encoding="utf-8") as f:
+                json.dump(counts, f, indent=0, sort_keys=True)
+        except OSError:
+            pass
+    elif os.path.exists(sidecar):
+        try:
+            os.unlink(sidecar)
+        except OSError:
+            pass
+    stale_over = sorted(k for k, n in counts.items()
+                        if n > args.stale_grace)
     for k in stale:
         print(f"# lint-gate: stale baseline entry (fixed? remove it): "
-              f"{k}")
+              f"{k} [{counts[k]}/{args.stale_grace} warning(s)]")
 
     for f in sorted(new, key=lambda x: (x.path, x.line)):
         print(f.format())
@@ -92,6 +130,12 @@ def main() -> int:
         print(f"# lint-gate: FAILED — {len(new)} new finding(s) not in "
               f"the baseline; fix them or accept them with a "
               f"justification", file=sys.stderr)
+        return 1
+    if stale_over:
+        print(f"# lint-gate: FAILED — {len(stale_over)} baseline "
+              f"entr{'y' if len(stale_over) == 1 else 'ies'} stale "
+              f"past the {args.stale_grace}-run grace; run 'python -m "
+              f"jepsen_tpu lint --prune-stale'", file=sys.stderr)
         return 1
     print("# lint-gate: clean against the baseline")
     return 0
