@@ -348,6 +348,21 @@ def serve_cmd() -> dict:
         daemon, server = serve_ns.run_daemon(
             cfg, host=opts["host"], port=opts["port"],
             store_root=opts["store_root"])
+        if daemon.flightrec is not None:
+            import signal as _signal
+
+            def _on_sigterm(_sig, _frm):
+                # last words before an orderly kill: dump the flight
+                # recorder's window, then release the drain wait below
+                # (SIGKILL skips this — the flightrec-kill chaos
+                # scenario asserts exactly that asymmetry)
+                daemon.flightrec.dump("sigterm")
+                daemon.drained.set()
+
+            try:
+                _signal.signal(_signal.SIGTERM, _on_sigterm)
+            except ValueError:
+                pass  # embedded off the main thread: no handler
         print(f"Listening on http://{opts['host']}:{server.server_port}/"
               f" (check daemon: POST /check, GET /check/<id>, /healthz, "
               f"/drain)", flush=True)
@@ -1550,6 +1565,139 @@ def plan_cmd() -> dict:
     return {"plan": {"parser": build_parser, "run": run_}}
 
 
+def usage_cmd() -> dict:
+    """The 'usage' subcommand: per-tenant usage totals for a serve
+    daemon directory — device-seconds, ops checked, transfer bytes,
+    gang-lane share, wall seconds, request count — recomputed straight
+    from the WAL's ``done`` records (:func:`jepsen_tpu.obs.usage.
+    from_wal`), so it works offline, after a SIGKILL, and always agrees
+    with a live daemon's ``GET /usage`` (the meter folds the exact same
+    records). Requires a daemon run with the telemetry stack on
+    (JTPU_TSDB, the default)."""
+
+    def build_parser():
+        p = Parser(prog="usage",
+                   description="Per-tenant usage totals from a serve "
+                               "daemon's request journal.")
+        p.add_argument("--serve-dir", default=None, metavar="DIR",
+                       help="daemon directory (default: "
+                            "<store-root>/serve)")
+        p.add_argument("--store-root", default="store")
+        p.add_argument("--tenant", default=None,
+                       help="one tenant only (default: all)")
+        p.add_argument("--json", action="store_true",
+                       help="raw JSON instead of the table")
+        return p
+
+    def run_(opts) -> int:
+        import json as _json
+        import os as _os
+
+        from jepsen_tpu import serve as serve_ns
+        from jepsen_tpu.obs import usage as obs_usage
+        d = opts.get("serve_dir") \
+            or _os.path.join(opts.get("store_root") or "store", "serve")
+        wal = _os.path.join(d, serve_ns.WAL_NAME)
+        if not _os.path.exists(wal):
+            print(f"no request journal at {wal}", file=sys.stderr)
+            return INVALID_ARGS
+        doc = obs_usage.from_wal(wal)
+        tenant = opts.get("tenant")
+        if tenant is not None:
+            doc["tenants"] = {t: u for t, u in doc["tenants"].items()
+                              if t == tenant}
+        if opts.get("json"):
+            print(_json.dumps(doc, indent=2))
+            return OK
+        for t in sorted(doc["tenants"]):
+            u = doc["tenants"][t]
+            print(f"# usage: {t}: {u['requests']} request(s), "
+                  f"{u['ops']:g} op(s), {u['device-s']:g} device-s, "
+                  f"{u['bytes']:g} byte(s), lane-share "
+                  f"{u['lane-share']:g}, {u['seconds']:g}s wall")
+        tot = doc["total"]
+        print(f"# usage: total: {tot['requests']} request(s), "
+              f"{tot['ops']:g} op(s), {tot['device-s']:g} device-s, "
+              f"{tot['bytes']:g} byte(s), {tot['seconds']:g}s wall")
+        return OK
+
+    return {"usage": {"parser": build_parser, "run": run_}}
+
+
+def flightrec_cmd() -> dict:
+    """The 'flightrec' subcommand: read a serve daemon's flight-
+    recorder dumps (doc/observability.md "Flight recorder"). Bare, it
+    lists the ``flightrec/`` inventory newest first; with a dump name
+    it summarizes that dump (reason, window, span/trace counts, the
+    trigger's extra doc) or relays the raw JSON with ``--json``."""
+
+    def build_parser():
+        p = Parser(prog="flightrec",
+                   description="List or show a serve daemon's "
+                               "flight-recorder dumps.")
+        p.add_argument("dump", nargs="?", default=None,
+                       help="dump file name (default: list them)")
+        p.add_argument("--serve-dir", default=None, metavar="DIR",
+                       help="daemon directory (default: "
+                            "<store-root>/serve)")
+        p.add_argument("--store-root", default="store")
+        p.add_argument("--json", action="store_true",
+                       help="raw JSON instead of the summary")
+        return p
+
+    def run_(opts) -> int:
+        import json as _json
+        import os as _os
+        import time as _time
+
+        from jepsen_tpu.obs import flightrec as obs_flightrec
+        d = opts.get("serve_dir") \
+            or _os.path.join(opts.get("store_root") or "store", "serve")
+        if opts.get("dump"):
+            doc = obs_flightrec.load_dump(d, opts["dump"])
+            if doc is None:
+                print(f"no such dump: {opts['dump']}", file=sys.stderr)
+                return INVALID_ARGS
+            if opts.get("json"):
+                print(_json.dumps(doc, indent=2))
+                return OK
+            when = _time.strftime(
+                "%Y-%m-%d %H:%M:%S",
+                _time.localtime(doc.get("wall-ts") or 0))
+            print(f"# flightrec: {opts['dump']}: "
+                  f"reason={doc.get('reason')} at {when}, "
+                  f"window {doc.get('window-s'):g}s")
+            print(f"# flightrec: {len(doc.get('spans') or [])} span(s), "
+                  f"{len(doc.get('trace-ids') or [])} trace id(s), "
+                  f"{len(doc.get('metrics') or {})} metric(s)")
+            if doc.get("extra"):
+                print(f"# flightrec: extra: "
+                      f"{_json.dumps(doc['extra'], default=repr)}")
+            for tid in doc.get("trace-ids") or []:
+                print(f"# flightrec: trace {tid}")
+            return OK
+        dumps = obs_flightrec.list_dumps(d)
+        if opts.get("json"):
+            print(_json.dumps({"dumps": dumps}, indent=2))
+            return OK
+        if not dumps:
+            print(f"# flightrec: no dumps under "
+                  f"{_os.path.join(d, obs_flightrec.DIR_NAME)}")
+            return OK
+        for rec in dumps:
+            when = _time.strftime(
+                "%Y-%m-%d %H:%M:%S",
+                _time.localtime(rec.get("wall-ts") or 0))
+            print(f"# flightrec: {rec['name']}: "
+                  f"reason={rec.get('reason')} at {when}, "
+                  f"{rec.get('spans', 0)} span(s), "
+                  f"{rec.get('trace-ids', 0)} trace(s), "
+                  f"{rec.get('bytes', 0)} byte(s)")
+        return OK
+
+    return {"flightrec": {"parser": build_parser, "run": run_}}
+
+
 def merge_commands(*cmds: dict) -> dict:
     out: Dict[str, dict] = {}
     for c in cmds:
@@ -1599,12 +1747,12 @@ def main(subcommands: Dict[str, dict],
 def default_commands() -> dict:
     """The stock subcommand set: runner + analyzer + recovery + linter
     + plan verifier + trace tooling + live watch + server + streaming
-    client + verdict explainer (what ``python -m jepsen_tpu``
-    dispatches)."""
+    client + verdict explainer + usage meter + flight-recorder reader
+    (what ``python -m jepsen_tpu`` dispatches)."""
     return merge_commands(suite_run_cmd(), analyze_cmd(), recover_cmd(),
                           lint_cmd(), plan_cmd(), trace_cmd(),
                           watch_cmd(), serve_cmd(), stream_cmd(),
-                          explain_cmd())
+                          explain_cmd(), usage_cmd(), flightrec_cmd())
 
 
 if __name__ == "__main__":  # default main

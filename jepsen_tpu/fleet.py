@@ -131,7 +131,8 @@ _JOIN_TOTAL = obs_metrics.counter(
 _HOST_LOST_TOTAL = obs_metrics.counter(
     "jtpu_fleet_host_lost_total",
     "fleet hosts removed from the mesh (dead pid, stale heartbeat, "
-    "wedged segment, OOM), labeled class")
+    "wedged segment, OOM), labeled class and host — per-host series "
+    "so the tsdb layer can chart which hosts keep dying")
 _DCN_RETRY_TOTAL = obs_metrics.counter(
     "jtpu_fleet_dcn_retries_total",
     "per-host shard segments retried on DCN/transient faults before "
@@ -1308,10 +1309,13 @@ class ElasticFleet:
         if h.state == "dead":
             return
         h.state = "dead"
-        _HOST_LOST_TOTAL.inc(**{"class": cls})
+        _HOST_LOST_TOTAL.inc(**{"class": cls, "host": h.name})
         self.stats["hosts-lost"] += 1
+        # wall_ns dates the loss for flight-recorder dumps, whose span
+        # timestamps are otherwise process-monotonic
         self._trail("host-lost", round=round_idx, host=h.name,
-                    **{"class": cls}, outcome="host-removed", error=err)
+                    **{"class": cls}, outcome="host-removed", error=err,
+                    wall_ns=time.time_ns())
         log.warning("fleet host %s lost (%s): %s; surviving hosts "
                     "re-mesh at the barrier", h.name, cls, err)
 

@@ -161,6 +161,49 @@ def read_json_records(path: str) -> Tuple[list, dict]:
     return out, stats
 
 
+class JsonRecordWriter:
+    """Append-only CRC'd-record writer for *generic* JSON journals —
+    the framing half of :class:`Journal` without the op typing, shared
+    by the serve daemon's sidecar files (``metrics.tsdb``). Single
+    unbuffered write per record (SIGKILL loses at most the torn tail);
+    ``fsync=True`` adds a sync per append for power-loss safety. A
+    write failure disables the writer (:attr:`failed`) — telemetry must
+    never take its host process down."""
+
+    def __init__(self, path: str, fsync: bool = False):
+        self.path = path
+        # guarded-by: none — immutable after init
+        self.fsync = fsync
+        self.records = 0
+        self.failed: Optional[str] = None
+        self._lock = threading.Lock()
+        self._f = open(path, "ab", buffering=0)
+
+    def append(self, doc: dict) -> None:
+        line = encode_json_record(doc)
+        with self._lock:
+            if self._f is None or self.failed is not None:
+                return
+            try:
+                self._f.write(line)
+                self.records += 1
+                if self.fsync:
+                    os.fsync(self._f.fileno())
+            except OSError as e:
+                self.failed = f"{type(e).__name__}: {e}"
+                log.warning("journal append to %s failed (%s); the "
+                            "writer is disabled", self.path, self.failed)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is None:
+                return
+            try:
+                self._f.close()
+            finally:
+                self._f = None
+
+
 def encode_record(op: Op) -> bytes:
     """One WAL line for an op: crc-prefixed compact JSON."""
     return encode_json_record(op.to_dict())

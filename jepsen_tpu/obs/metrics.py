@@ -26,8 +26,10 @@ layers load, not only after the first event.
 from __future__ import annotations
 
 import json
+import math
 import os
 import threading
+import time
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 #: Default histogram bounds (seconds): 100us .. 30s, log-ish spacing —
@@ -37,6 +39,28 @@ DEFAULT_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
                    30.0)
 
 _LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def quantile_from_buckets(q: float, counts: List[int],
+                          bounds: Tuple[float, ...]) -> Optional[float]:
+    """Nearest-rank quantile over non-cumulative bucket ``counts``
+    (``len(bounds) + 1`` entries, the last being the +Inf overflow):
+    the upper bound of the bucket holding the ``ceil(q * total)``-th
+    observation. Observations past the last bound report the last bound
+    — the histogram cannot resolve further. None when empty. Module
+    level so the tsdb/SLO layers can run it over *windowed* bucket
+    deltas, not just live series."""
+    total = sum(counts)
+    if total <= 0:
+        return None
+    q = min(max(float(q), 0.0), 1.0)
+    rank = max(1, math.ceil(q * total))
+    cum = 0
+    for i, c in enumerate(counts[:len(bounds)]):
+        cum += c
+        if cum >= rank:
+            return bounds[i]
+    return bounds[-1] if bounds else None
 
 
 def _labels_key(labels: Dict[str, Any]) -> _LabelKey:
@@ -210,6 +234,22 @@ class Histogram(_Metric):
                     tot_count += s[-1]
         return {"sum": tot_sum, "count": tot_count}
 
+    def quantile(self, q: float, **labels) -> Optional[float]:
+        """Nearest-rank quantile estimate over bucket counts: the sum
+        runs across every series whose labels include ``labels`` (all
+        of them when empty — the SLO engine asks for p99 across
+        tenants). Returns the upper bound of the bucket holding the
+        q-th observation (:func:`quantile_from_buckets`); None when no
+        matching series has observations."""
+        want = set(_labels_key(labels))
+        counts = [0] * (len(self.bounds) + 1)
+        with self._lock:
+            for k, s in self._series.items():
+                if want <= set(k):
+                    for i in range(len(counts)):
+                        counts[i] += s[i]
+        return quantile_from_buckets(q, counts, self.bounds)
+
     def _exemplar_suffix(self, key: _LabelKey, i: int) -> str:
         ex = self._exemplars.get((key, i))
         if not ex:
@@ -292,11 +332,18 @@ class Registry:
         return "\n".join(lines) + "\n"
 
     def snapshot(self) -> Dict[str, Any]:
+        """``{"ts": wall-clock, <name>: {"kind", "help", "series"}}``.
+        The ``ts`` field rides at top level next to the metric names
+        (names are ``jtpu_``-prefixed, no collision); consumers that
+        iterate metric entries skip the float. The tsdb sampler and
+        ``watch`` both date samples off it."""
         with self._lock:
             metrics = dict(self._metrics)
-        return {name: {"kind": m.kind, "help": m.help,
-                       "series": m.snapshot()}
-                for name, m in sorted(metrics.items())}
+        doc: Dict[str, Any] = {"ts": time.time()}
+        for name, m in sorted(metrics.items()):
+            doc[name] = {"kind": m.kind, "help": m.help,
+                         "series": m.snapshot()}
+        return doc
 
     def reset(self) -> None:
         """Drop every metric (tests)."""

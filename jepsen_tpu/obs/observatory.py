@@ -361,6 +361,17 @@ def format_status(p: Optional[Dict[str, Any]]) -> str:
             if s.get("stream-lag"):
                 sbit += f" | stream-lag {s['stream-lag']}"
             bits.append(sbit)
+        if s.get("slo") is not None:
+            # the SLO engine's verdict: breach count when burning,
+            # plus the worst short-window burn rate either way
+            n = s["slo"].get("breached", 0)
+            burn = s["slo"].get("max-burn", 0)
+            bits.append(f"slo BURN x{n} ({burn:g})" if n
+                        else f"slo OK ({burn:g})")
+        if s.get("usage-top"):
+            # the biggest tenant by device-seconds (GET /usage for all)
+            t, dev = s["usage-top"][0], s["usage-top"][1]
+            bits.append(f"usage {t}:{dev:g}s")
         if s.get("warm-buckets") is not None:
             bits.append(f"warm {s['warm-buckets']} bucket(s)")
         if p.get("state") and p["state"] != "serving":

@@ -326,6 +326,38 @@ class ServeConfig:
     #: thread); opens past it answer 429 + Retry-After.
     stream_max: int = field(
         default_factory=lambda: _env_int("JTPU_SERVE_STREAM_MAX", 8))
+    # -- telemetry (doc/observability.md "Time series") ---------------------
+    #: Kill switch for the whole telemetry stack: the time-series
+    #: store, SLO engine, usage meter, and flight recorder
+    #: (JTPU_TSDB). Off constructs none of them — no metrics.tsdb /
+    #: flightrec/ files, no /usage /slo /flightrec routes, no new
+    #: metric series, keys, or WAL fields (see :attr:`tsdb_on`).
+    tsdb_enabled: bool = field(
+        default_factory=lambda: os.environ.get(
+            "JTPU_TSDB", "1").strip().lower()
+        not in ("0", "false", "no", "off"))
+    #: Sampling cadence for the time-series store, seconds.
+    tsdb_cadence_s: float = field(
+        default_factory=lambda: _env_float("JTPU_TSDB_CADENCE", 2.0))
+    #: Flight-recorder window: how many trailing seconds of spans +
+    #: samples each dump captures.
+    flightrec_seconds: float = field(
+        default_factory=lambda: _env_float(
+            "JTPU_FLIGHTREC_SECONDS", 120.0))
+    #: Optional URL POSTed on every SLO breach/recovery transition.
+    slo_webhook: Optional[str] = field(
+        default_factory=lambda: os.environ.get(
+            "JTPU_SLO_WEBHOOK") or None)
+
+    @property
+    def tsdb_on(self) -> bool:
+        """Whether the telemetry stack is constructed. Read at call
+        time so JTPU_TSDB=0 wins even against an explicitly configured
+        ``tsdb_enabled`` — the same kill-switch discipline as
+        :attr:`stream_on`."""
+        if os.environ.get("JTPU_TSDB", "").strip() == "0":
+            return False
+        return bool(self.tsdb_enabled)
 
     @property
     def stream_on(self) -> bool:
@@ -402,6 +434,10 @@ class CircuitBreaker:
         self.fails = max(1, int(fails))
         self.cooldown_s = float(cooldown_s)
         self._rng = rng or random.Random()
+        #: trip hook (the flight recorder): called OUTSIDE the lock
+        #: with (bucket, failure_class) each time a breaker opens.
+        #: Set once before serving starts.
+        self.on_trip = None  # guarded-by: none
         self._lock = threading.Lock()
         #: bucket -> {"state", "fails", "until", "cooldown", "probing"}
         self._b: Dict[tuple, Dict[str, Any]] = {}
@@ -447,6 +483,7 @@ class CircuitBreaker:
         from jepsen_tpu.resilience import OOM, RETRYABLE, WEDGE
         failed = failure_class in (OOM, WEDGE)
         now = time.monotonic()
+        tripped = False
         with self._lock:
             rec = self._rec(bucket)
             if failure_class in RETRYABLE:
@@ -469,6 +506,7 @@ class CircuitBreaker:
                     jit = 0.75 + self._rng.random() / 2
                     rec.update(state="open", probing=False,
                                until=now + rec["cooldown"] * jit)
+                    tripped = True
                     log.warning("breaker OPEN for bucket %s (%s, "
                                 "cooldown %.1fs)", bucket, failure_class,
                                 rec["cooldown"])
@@ -481,6 +519,13 @@ class CircuitBreaker:
             open_n = sum(1 for r in self._b.values()
                          if r["state"] == "open")
         _BREAKERS_OPEN.set(open_n)
+        cb = self.on_trip
+        if tripped and cb is not None:
+            try:
+                cb(bucket, failure_class)
+            except Exception:
+                log.warning("breaker on_trip hook failed",
+                            exc_info=True)
 
     def snapshot(self) -> Dict[str, Dict[str, Any]]:
         now = time.monotonic()
@@ -666,6 +711,11 @@ class FleetPlacer:
         self.config = config
         self.hosts: list = []
         self.on_round = None
+        #: fired (once, latched) the first time a gang finishes with
+        #: zero live hosts — the flight recorder's trigger. Set before
+        #: serving starts; called outside the lock.
+        self.on_all_lost = None      # guarded-by: none
+        self._all_lost_fired = False  # guarded-by: none — gangs serialize
         self._lock = threading.Lock()
         self.stats = {"gangs": 0, "rounds": 0, "remeshes": 0,
                       "host-losses": 0, "dcn-retries": 0}
@@ -727,6 +777,15 @@ class FleetPlacer:
         if remeshed:
             _FLEET_REMESH.inc(remeshed)
         _FLEET_LIVE.set(self.live())
+        cb = self.on_all_lost
+        if cb is not None and not self._all_lost_fired \
+                and self.live() == 0:
+            self._all_lost_fired = True
+            try:
+                cb()
+            except Exception:
+                log.warning("fleet on_all_lost hook failed",
+                            exc_info=True)
         for ev in trail:
             obs_trace.event(f"serve.fleet.{ev.pop('event')}", **ev)
         return out
@@ -798,6 +857,55 @@ class CheckDaemon:
             {} if self.config.stream_on else None)
         self._stream_seq = 0
         self._progress_last = 0.0
+        # JTPU_TSDB kill switch: None telemetry members mean no
+        # metrics.tsdb / flightrec/ files, no /usage /slo /flightrec
+        # routes, no usage fields in WAL done records, no slo/usage
+        # progress or healthz keys, and no new metric series (the
+        # request histogram and burn gauge register lazily below
+        # because expose() prints HELP/TYPE even for zero series) —
+        # byte-identical to the pre-telemetry daemon
+        self.tsdb = None
+        self.slo = None
+        self.usage = None
+        self.flightrec = None
+        self._request_seconds = None
+        if self.config.tsdb_on:
+            from jepsen_tpu.obs import flightrec as obs_flightrec
+            from jepsen_tpu.obs import slo as obs_slo
+            from jepsen_tpu.obs import tsdb as obs_tsdb
+            from jepsen_tpu.obs import usage as obs_usage
+            self._request_seconds = obs_metrics.histogram(
+                "jtpu_serve_request_seconds",
+                "end-to-end seconds from admission to verdict, "
+                "labeled tenant")
+            self.tsdb = obs_tsdb.TSDB(
+                self.config.root, cadence=self.config.tsdb_cadence_s)
+            self.slo = obs_slo.SLOEngine(
+                self.tsdb, webhook=self.config.slo_webhook)
+            self.usage = obs_usage.UsageMeter()
+            self.flightrec = obs_flightrec.FlightRecorder(
+                self.config.root,
+                seconds=self.config.flightrec_seconds, tsdb=self.tsdb)
+            self.breaker.on_trip = self._breaker_tripped
+            if self.placer is not None:
+                self.placer.on_all_lost = self._all_hosts_lost
+
+    # -- flight-recorder triggers -------------------------------------------
+
+    def _breaker_tripped(self, bucket: tuple,
+                         failure_class: Optional[str]) -> None:
+        fr = self.flightrec
+        if fr is not None:
+            fr.dump("breaker-trip",
+                    extra={"bucket": [str(x) for x in bucket],
+                           "class": failure_class})
+
+    def _all_hosts_lost(self) -> None:
+        fr = self.flightrec
+        if fr is not None:
+            fr.dump("all-hosts-lost",
+                    extra={"stats": dict(self.placer.stats)
+                           if self.placer else None})
 
     # -- model / planning helpers -------------------------------------------
 
@@ -1441,6 +1549,26 @@ class CheckDaemon:
                 "seconds": round(secs, 6)}
         if gang is not None:
             done["gang"] = list(gang)
+        if self.usage is not None:
+            # the meter folds the EXACT doc the WAL holds, so
+            # usage.from_wal(wal) == the live totals, digit for digit
+            # (the serve_gate reconciliation leg), and restart replay
+            # rebuilds the meter from these same records
+            phases = (result.get("serve") or {}).get("phases") or {}
+            u = {"ops": len(req.history or []),
+                 "device-s": round(phases.get("device_s", 0.0)
+                                   + phases.get("compile_s", 0.0), 9),
+                 "bytes": int(req.footprint or 0),
+                 "lane-share": round(1.0 / max(1, batch_size), 9),
+                 "seconds": round(secs, 6)}
+            done["tenant"] = req.tenant
+            done["usage"] = u
+            self.usage.record(req.tenant, u)
+            if self._request_seconds is not None:
+                self._request_seconds.observe(
+                    secs, tenant=req.tenant,
+                    exemplar=({"trace_id": req.trace}
+                              if req.trace else None))
         self.journal.append(done)
         if req.trace and obs_trace.enabled():
             # the trace's terminal marker: POST /check ... serve.verdict
@@ -1524,6 +1652,18 @@ class CheckDaemon:
             obs_trace.sync_event()
         if self.placer is not None:
             self.placer.start()
+        if self.tsdb is not None:
+            # resume the pre-kill series prefix, then sample; the
+            # usage meter replays from the same WAL the request-replay
+            # below reads — done records carry the usage docs
+            self.tsdb.start()
+            try:
+                from jepsen_tpu.obs import usage as obs_usage
+                records, _ustats = journal_ns.read_json_records(
+                    self.journal.path)
+                obs_usage.replay(self.usage, records)
+            except OSError:
+                pass
         pending, stats = RequestJournal.replay(self.journal.path)
         self.replay_stats = dict(stats, requeued=len(pending))
         replayed_n = 0
@@ -1579,6 +1719,10 @@ class CheckDaemon:
         with self._lock:
             inflight = len(self._inflight)
             completed = self.stats["completed"]
+        if self.flightrec is not None:
+            self.flightrec.dump("drain",
+                                extra={"was-queued": queued,
+                                       "inflight-remaining": inflight})
         self._publish(force=True, state="drained")
         self.drained.set()
         return {"drained": True, "was-queued": queued,
@@ -1604,6 +1748,8 @@ class CheckDaemon:
                 s.stop_wal()
         if self.placer is not None:
             self.placer.stop()
+        if self.tsdb is not None:
+            self.tsdb.stop()
         self.journal.close()
         tr = obs_trace.tracer()
         if getattr(self, "_trace_path", None) and \
@@ -1877,6 +2023,10 @@ class CheckDaemon:
                                 backend=self.config.fleet_backend)
         if has_streams:
             doc["streams"] = self._stream_summary()
+        # slo section only when the telemetry stack is on: a
+        # JTPU_TSDB=0 daemon's healthz stays byte-identical
+        if self.slo is not None:
+            doc["slo"] = self.slo.snapshot()
         return doc
 
     def _publish(self, force: bool = False,
@@ -1936,6 +2086,17 @@ class CheckDaemon:
                 doc["serve"]["stream-ops"] = ops
                 doc["serve"]["stream-checked"] = checked
                 doc["serve"]["stream-lag"] = max(0, ops - checked)
+            # slo / usage bits only when the telemetry stack is on —
+            # same byte-identity discipline as the fleet/stream keys
+            if self.slo is not None:
+                doc["serve"]["slo"] = {
+                    "breached": self.slo.breached(),
+                    "max-burn": round(self.slo.max_burn(), 3)}
+            if self.usage is not None:
+                top = self.usage.top()
+                if top is not None:
+                    doc["serve"]["usage-top"] = [top[0],
+                                                 round(top[1], 3)]
         path = os.path.join(self.config.root, PROGRESS_NAME)
         tmp = os.path.join(self.config.root,
                            f".{PROGRESS_NAME}.tmp.{os.getpid()}")
@@ -2040,10 +2201,37 @@ def make_handler(daemon: CheckDaemon, root: str = "store"):
             pass
 
     def do_GET(self):  # noqa: N802
-        from urllib.parse import unquote, urlparse
-        path = unquote(urlparse(self.path).path)
+        from urllib.parse import parse_qs, unquote, urlparse
+        parsed = urlparse(self.path)
+        path = unquote(parsed.path)
         if path == "/healthz":
             return _json(self, 200, self.daemon.healthz())
+        # telemetry routes only when the stack is on; with JTPU_TSDB=0
+        # these fall through to web.Handler's 404 — route-for-route
+        # identical to the pre-telemetry daemon
+        if path == "/usage" and self.daemon.usage is not None:
+            q = parse_qs(parsed.query)
+            tenant = (q.get("tenant") or [None])[0]
+            return _json(self, 200,
+                         self.daemon.usage.totals(tenant=tenant))
+        if path == "/slo" and self.daemon.slo is not None:
+            return _json(self, 200, self.daemon.slo.snapshot())
+        if path.startswith("/flightrec") and \
+                self.daemon.flightrec is not None:
+            from jepsen_tpu.obs import flightrec as obs_flightrec
+            root_dir = self.daemon.config.root
+            name = path[len("/flightrec"):].strip("/")
+            if not name:
+                dumps = obs_flightrec.list_dumps(root_dir)
+                if "json" in parse_qs(parsed.query).get("format", []):
+                    return _json(self, 200, {"dumps": dumps})
+                return self._page("flight recorder",
+                                  web.flightrec_html(dumps))
+            doc = obs_flightrec.load_dump(root_dir, name)
+            if doc is None:
+                return _json(self, 404, {"error": "no such dump",
+                                         "name": name})
+            return _json(self, 200, doc)
         if path.startswith("/check/"):
             rid = path[len("/check/"):].strip("/")
             doc = self.daemon.status(rid)

@@ -23,6 +23,7 @@ import html
 import json
 import os
 import threading
+import time
 import zipfile
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional, Tuple
@@ -661,6 +662,33 @@ def request_trace_html(stitched: dict, cap: int = 2000) -> str:
             + f". CLI: <code>jtpu trace request {html.escape(tid)}"
               f"</code></p>")
     return head + _waterfall_html(records, stats, cap=cap)
+
+
+def flightrec_html(dumps: list) -> str:
+    """The flight-recorder inventory (:func:`jepsen_tpu.obs.flightrec.
+    list_dumps`) -> the serve daemon's ``/flightrec`` page: one row per
+    dump, newest first, linking the raw JSON."""
+    if not dumps:
+        return ("<p>No flight-recorder dumps. The daemon writes one to "
+                "<code>flightrec/</code> on breaker trip, "
+                "all-hosts-lost, drain, and SIGTERM "
+                "(<code>JTPU_FLIGHTREC_SECONDS</code> window).</p>")
+    rows = ["<table><tr><th>dump</th><th>reason</th><th>when</th>"
+            "<th>spans</th><th>traces</th><th>bytes</th></tr>"]
+    for d in dumps:
+        name = html.escape(str(d.get("name", "")))
+        ts = d.get("wall-ts")
+        when = time.strftime("%Y-%m-%d %H:%M:%S",
+                             time.localtime(ts)) if ts else "?"
+        rows.append(
+            f"<tr><td><a href='/flightrec/{name}'><code>{name}</code>"
+            f"</a></td><td>{html.escape(str(d.get('reason', '')))}</td>"
+            f"<td>{when}</td><td>{d.get('spans', 0)}</td>"
+            f"<td>{d.get('trace-ids', 0)}</td>"
+            f"<td>{d.get('bytes', 0)}</td></tr>")
+    rows.append("</table>")
+    rows.append("<p>CLI: <code>jtpu flightrec [dump]</code></p>")
+    return "".join(rows)
 
 
 def serve(host: str = "127.0.0.1", port: int = 8080,

@@ -1,0 +1,606 @@
+"""Time-series + SLO + usage + flight-recorder tests (the `obs`
+marker, doc/observability.md).
+
+Covers the tsdb ring buffers (frame merge, downsampling, wraparound),
+the CRC'd segment file's torn-tail resume (the restarted store equals
+the pre-kill series prefix), windowed quantiles, the SLO engine's
+multi-window burn-rate breach/recovery state machine, per-tenant usage
+metering and its WAL reconciliation invariant, the flight recorder's
+atomic dumps, and the JTPU_TSDB kill-switch identity contract
+(`tsdb_enabled=False` leaves the daemon's metric families, artifacts,
+and HTTP surface exactly as PR-18 shipped them).
+"""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from jepsen_tpu import serve as serve_ns
+from jepsen_tpu.obs import flightrec as flightrec_ns
+from jepsen_tpu.obs import metrics as obs_metrics
+from jepsen_tpu.obs import slo as slo_ns
+from jepsen_tpu.obs import tsdb as tsdb_ns
+from jepsen_tpu.obs import usage as usage_ns
+
+pytestmark = pytest.mark.obs
+
+
+def _clock(start=1000.0):
+    """A settable fake wall clock for driving sample_once()."""
+    now = [float(start)]
+
+    def fn():
+        return now[0]
+
+    fn.set = lambda t: now.__setitem__(0, float(t))
+    fn.advance = lambda d: now.__setitem__(0, now[0] + d)
+    return fn
+
+
+def _db(tmp_path, clock, resolutions=(("1s", 1.0, 8),), persist=False,
+        registry=None):
+    return tsdb_ns.TSDB(str(tmp_path / "tsdb"), cadence=999.0,
+                        now_fn=clock, registry=registry,
+                        resolutions=resolutions, persist=persist)
+
+
+# ---------------------------------------------------------------------------
+# Rings: merge, downsample, wraparound
+# ---------------------------------------------------------------------------
+
+
+class TestRings:
+    def test_counter_frames_merge_and_downsample(self, tmp_path):
+        reg = obs_metrics.Registry()
+        c = reg.counter("jobs_total")
+        clock = _clock(100.0)
+        db = _db(tmp_path, clock, registry=reg,
+                 resolutions=(("1s", 1.0, 32), ("4s", 4.0, 32)))
+        for _ in range(8):          # ticks at t=100..107, +2 each
+            c.inc(2)
+            db.sample_once()
+            clock.advance(1.0)
+        fine = db.series("jobs_total", "1s")
+        assert fine == [[100.0 + i, 2.0] for i in range(8)]
+        coarse = db.series("jobs_total", "4s")
+        # 100..103 fold into the t0=100 frame, 104..107 into t0=104
+        assert coarse == [[100.0, 8.0], [104.0, 8.0]]
+        assert db.kind("jobs_total") == "counter"
+
+    def test_ring_wraparound_keeps_newest_frames(self, tmp_path):
+        reg = obs_metrics.Registry()
+        c = reg.counter("spins_total")
+        clock = _clock(0.0)
+        db = _db(tmp_path, clock, registry=reg,
+                 resolutions=(("1s", 1.0, 4),))
+        for _ in range(10):
+            c.inc()
+            db.sample_once()
+            clock.advance(1.0)
+        frames = db.series("spins_total")
+        assert len(frames) == 4     # maxlen, not uptime
+        assert [fr[0] for fr in frames] == [6.0, 7.0, 8.0, 9.0]
+
+    def test_gauge_is_last_write_wins_within_a_frame(self, tmp_path):
+        reg = obs_metrics.Registry()
+        g = reg.gauge("depth")
+        clock = _clock(50.0)
+        db = _db(tmp_path, clock, registry=reg,
+                 resolutions=(("10s", 10.0, 8),))
+        for v, t in ((3, 50.0), (9, 51.0), (1, 62.0)):
+            g.set(v)
+            clock.set(t)
+            db.sample_once()
+        assert db.series("depth", "10s") == [[50.0, 9.0], [60.0, 1.0]]
+        assert db.latest("depth", "10s") == 1.0
+
+    def test_histogram_window_and_quantile(self, tmp_path):
+        reg = obs_metrics.Registry()
+        h = reg.histogram("lat_s", buckets=(0.1, 1.0))
+        clock = _clock(200.0)
+        db = _db(tmp_path, clock, registry=reg,
+                 resolutions=(("1s", 1.0, 64),))
+        for _ in range(9):
+            h.observe(0.05, tenant="a")
+        h.observe(0.5, tenant="b")
+        db.sample_once()
+        cnt, sm, buckets = db.window_hist("lat_s", 10.0)
+        assert cnt == 10 and buckets[:2] == [9, 1]
+        assert sm == pytest.approx(9 * 0.05 + 0.5)
+        assert db.quantile("lat_s", 0.5, 10.0) == 0.1
+        assert db.quantile("lat_s", 0.99, 10.0) == 1.0
+        # label-superset matching: only tenant=b's series
+        assert db.quantile("lat_s", 0.5, 10.0, tenant="b") == 1.0
+        assert db.bounds("lat_s") == [0.1, 1.0]
+        # an empty window has no quantile
+        assert db.quantile("lat_s", 0.5, 10.0,
+                           now=clock() + 100.0) is None
+
+    def test_window_delta_sums_matching_series(self, tmp_path):
+        reg = obs_metrics.Registry()
+        c = reg.counter("reqs_total")
+        clock = _clock(0.0)
+        db = _db(tmp_path, clock, registry=reg,
+                 resolutions=(("1s", 1.0, 64),))
+        c.inc(3, tenant="a")
+        c.inc(5, tenant="b")
+        db.sample_once()
+        assert db.window_delta("reqs_total", 10.0) == 8.0
+        assert db.window_delta("reqs_total", 10.0, tenant="a") == 3.0
+        assert sorted(db.series_keys("reqs_total")) == \
+            ['{tenant="a"}', '{tenant="b"}']
+
+    def test_registry_reset_clamps_the_delta(self, tmp_path):
+        reg = obs_metrics.Registry()
+        c = reg.counter("boots_total")
+        clock = _clock(0.0)
+        db = _db(tmp_path, clock, registry=reg,
+                 resolutions=(("1s", 1.0, 64),))
+        c.inc(10)
+        db.sample_once()
+        reg.reset()
+        c = reg.counter("boots_total")
+        c.inc(2)
+        clock.advance(1.0)
+        db.sample_once()
+        # a reset must not show up as a -8 spike: new value is the delta
+        assert db.series("boots_total") == [[0.0, 10.0], [1.0, 2.0]]
+
+
+# ---------------------------------------------------------------------------
+# Segment file: persistence, torn-tail resume, compaction
+# ---------------------------------------------------------------------------
+
+
+class TestResume:
+    def _run_ticks(self, tmp_path, n=8):
+        reg = obs_metrics.Registry()
+        c = reg.counter("work_total")
+        clock = _clock(100.0)
+        db = tsdb_ns.TSDB(str(tmp_path / "tsdb"), cadence=999.0,
+                          now_fn=clock, registry=reg,
+                          resolutions=(("1s", 1.0, 32),), persist=True)
+        db.start()
+        try:
+            for _ in range(n):
+                c.inc(2)
+                db.sample_once()
+                clock.advance(1.0)
+            return db.series("work_total"), db.path
+        finally:
+            db.stop()
+
+    def test_resume_rebuilds_the_series(self, tmp_path):
+        pre, path = self._run_ticks(tmp_path)
+        assert os.path.exists(path)
+        db2 = tsdb_ns.TSDB(os.path.dirname(path), cadence=999.0,
+                           now_fn=_clock(200.0),
+                           registry=obs_metrics.Registry(),
+                           resolutions=(("1s", 1.0, 32),), persist=True)
+        db2.resume()
+        assert db2.series("work_total") == pre
+        assert db2.kind("work_total") == "counter"
+        assert db2.resumed_records == len(pre)
+
+    def test_torn_tail_resume_equals_prekill_prefix(self, tmp_path):
+        """SIGKILL mid-append loses at most the torn final record; the
+        resumed series is exactly the pre-kill prefix."""
+        pre, path = self._run_ticks(tmp_path)
+        with open(path, "rb") as f:
+            blob = f.read()
+        with open(path, "wb") as f:
+            f.write(blob[:-5])      # tear the final record mid-line
+        db2 = tsdb_ns.TSDB(os.path.dirname(path), cadence=999.0,
+                           now_fn=_clock(200.0),
+                           registry=obs_metrics.Registry(),
+                           resolutions=(("1s", 1.0, 32),), persist=True)
+        db2.resume()
+        assert db2.series("work_total") == pre[:-1]
+
+    def test_compaction_bounds_the_file_and_survives_resume(
+            self, tmp_path):
+        reg = obs_metrics.Registry()
+        c = reg.counter("churn_total")
+        clock = _clock(0.0)
+        db = tsdb_ns.TSDB(str(tmp_path / "tsdb"), cadence=999.0,
+                          now_fn=clock, registry=reg,
+                          resolutions=(("1s", 1.0, 8),), persist=True)
+        db.start()
+        try:
+            for _ in range(tsdb_ns.COMPACT_RECORDS + 5):
+                c.inc()
+                db.sample_once()
+                clock.advance(1.0)
+        finally:
+            db.stop()
+        records, stats = __import__(
+            "jepsen_tpu.journal", fromlist=["journal"]
+        ).read_json_records(db.path)
+        assert not stats.get("corrupt") and not stats.get("torn")
+        assert len(records) < tsdb_ns.COMPACT_RECORDS
+        assert records[0]["k"] == "ckpt"
+        db2 = tsdb_ns.TSDB(os.path.dirname(db.path), cadence=999.0,
+                           now_fn=clock, registry=obs_metrics.Registry(),
+                           resolutions=(("1s", 1.0, 8),), persist=True)
+        db2.resume()
+        assert db2.series("churn_total") == db.series("churn_total")
+
+
+# ---------------------------------------------------------------------------
+# Histogram.quantile + snapshot ts (the metrics satellites)
+# ---------------------------------------------------------------------------
+
+
+class TestMetricsSatellites:
+    def test_histogram_quantile_nearest_rank(self):
+        reg = obs_metrics.Registry()
+        h = reg.histogram("q_s", buckets=(0.1, 1.0))
+        for _ in range(9):
+            h.observe(0.05)
+        h.observe(0.5)
+        assert h.quantile(0.5) == 0.1
+        assert h.quantile(0.9) == 0.1
+        assert h.quantile(0.91) == 1.0
+        assert h.quantile(1.0) == 1.0
+
+    def test_histogram_quantile_filters_by_labels(self):
+        reg = obs_metrics.Registry()
+        h = reg.histogram("ql_s", buckets=(0.1, 1.0))
+        h.observe(0.05, tenant="a")
+        h.observe(0.5, tenant="b")
+        assert h.quantile(0.99, tenant="a") == 0.1
+        assert h.quantile(0.99, tenant="b") == 1.0
+        assert h.quantile(0.99) == 1.0      # no filter: both series
+        assert h.quantile(0.5, tenant="missing") is None
+
+    def test_quantile_overflow_clamps_to_top_bound(self):
+        reg = obs_metrics.Registry()
+        h = reg.histogram("ovf_s", buckets=(0.1, 1.0))
+        h.observe(50.0)
+        assert h.quantile(0.99) == 1.0
+
+    def test_snapshot_carries_wall_clock_ts(self):
+        before = time.time()
+        snap = obs_metrics.REGISTRY.snapshot()
+        assert before <= snap["ts"] <= time.time()
+        for name, doc in snap.items():
+            if name != "ts":
+                assert isinstance(doc, dict) and "kind" in doc
+
+
+# ---------------------------------------------------------------------------
+# SLO engine: burn rates, breach, recovery
+# ---------------------------------------------------------------------------
+
+
+class TestSLO:
+    def _engine(self, tmp_path):
+        reg = obs_metrics.Registry()
+        h = reg.histogram("req_s", buckets=(0.1, 1.0))
+        clock = _clock(1000.0)
+        db = _db(tmp_path, clock, registry=reg,
+                 resolutions=(("1s", 1.0, 120),))
+        events = []
+        obj = slo_ns.Objective("lat-p90", "latency", target=0.9,
+                               metric="req_s", threshold=0.1)
+        eng = slo_ns.SLOEngine(db, objectives=[obj],
+                               windows=(("2s", 2.0), ("60s", 60.0)),
+                               burn_threshold=1.0,
+                               on_transition=events.append)
+        return reg, h, clock, db, eng, events
+
+    def test_breach_then_recovery(self, tmp_path):
+        reg, h, clock, db, eng, events = self._engine(tmp_path)
+        # 5 slow requests: bad ratio 1.0, budget 0.1 -> burn 10 in both
+        # windows -> breach
+        for _ in range(5):
+            h.observe(0.5)
+        db.sample_once()            # the tick drives eng.evaluate
+        snap = eng.snapshot()
+        obj = snap["objectives"]["lat-p90"]
+        assert obj["breached"] is True
+        assert obj["windows"]["2s"] == pytest.approx(10.0)
+        assert eng.breached() == 1
+        assert eng.max_burn() == pytest.approx(10.0)
+        assert [e["event"] for e in events] == ["slo.breach"]
+        # 10s later the short window holds only fast requests: it
+        # cools below the threshold -> recovery (the long window still
+        # burns, by design: recovery needs only the short window)
+        clock.advance(10.0)
+        for _ in range(20):
+            h.observe(0.05)
+        db.sample_once()
+        obj = eng.snapshot()["objectives"]["lat-p90"]
+        assert obj["breached"] is False
+        assert eng.breached() == 0
+        assert [e["event"] for e in events] == ["slo.breach",
+                                               "slo.recovered"]
+
+    def test_no_traffic_burns_nothing(self, tmp_path):
+        reg, h, clock, db, eng, events = self._engine(tmp_path)
+        snap = eng.evaluate()
+        obj = snap["objectives"]["lat-p90"]
+        assert obj["breached"] is False
+        assert obj["windows"] == {"2s": 0.0, "60s": 0.0}
+        assert events == []
+
+    def test_burn_rate_gauge_is_set(self, tmp_path):
+        reg, h, clock, db, eng, events = self._engine(tmp_path)
+        for _ in range(5):
+            h.observe(0.5, tenant="hot")
+        db.sample_once()
+        g = obs_metrics.REGISTRY.gauge("jtpu_slo_burn_rate")
+        assert g.value(slo="lat-p90", tenant="all") == \
+            pytest.approx(10.0)
+        assert g.value(slo="lat-p90", tenant="hot") == \
+            pytest.approx(10.0)
+
+    def test_default_objectives_cover_the_serve_slos(self):
+        names = {o.name for o in slo_ns.default_objectives()}
+        assert names == {"verdict-latency-p99", "queue-wait-p95",
+                         "availability"}
+
+
+# ---------------------------------------------------------------------------
+# Usage metering
+# ---------------------------------------------------------------------------
+
+
+class TestUsage:
+    def test_totals_roll_up_and_replay_reconciles(self):
+        m = usage_ns.UsageMeter()
+        u1 = {"ops": 8, "device-s": 0.25, "bytes": 100,
+              "lane-share": 0.5, "seconds": 1.5}
+        u2 = {"ops": 4, "device-s": 0.5, "bytes": 50,
+              "lane-share": 1.0, "seconds": 0.5}
+        m.record("a", u1)
+        m.record("a", u1)
+        m.record("b", u2)
+        doc = m.totals()
+        assert doc["tenants"]["a"]["requests"] == 2
+        assert doc["tenants"]["a"]["device-s"] == pytest.approx(0.5)
+        assert doc["total"]["ops"] == pytest.approx(20)
+        assert m.top() == ("a", 0.5)
+        # the WAL fold is the same meter over the same docs
+        m2 = usage_ns.UsageMeter()
+        n = usage_ns.replay(m2, [
+            {"event": "done", "tenant": "a", "usage": u1},
+            {"event": "done", "tenant": "a", "usage": u1},
+            {"event": "done", "tenant": "b", "usage": u2},
+            {"event": "submit", "tenant": "a"},
+            {"event": "done", "tenant": "old-no-usage"},
+        ])
+        assert n == 3
+        assert m2.totals() == doc
+
+    def test_tenant_filter(self):
+        m = usage_ns.UsageMeter()
+        m.record("a", {"ops": 1})
+        m.record("b", {"ops": 2})
+        doc = m.totals(tenant="b")
+        assert sorted(doc["tenants"]) == ["b"]
+        assert doc["total"]["ops"] == pytest.approx(2)
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_dump_is_whole_listable_and_loadable(self, tmp_path):
+        fr = flightrec_ns.FlightRecorder(str(tmp_path), seconds=60.0)
+        path = fr.dump("unit-test", extra={"k": "v"})
+        assert path and os.path.exists(path)
+        with open(path) as f:
+            doc = json.load(f)
+        assert doc["reason"] == "unit-test"
+        assert doc["window-s"] == 60.0
+        assert doc["extra"] == {"k": "v"}
+        assert "metrics" in doc and "spans" in doc
+        dumps = flightrec_ns.list_dumps(str(tmp_path))
+        assert len(dumps) == 1 and dumps[0]["reason"] == "unit-test"
+        loaded = flightrec_ns.load_dump(str(tmp_path),
+                                        dumps[0]["name"])
+        assert loaded["reason"] == "unit-test"
+
+    def test_same_reason_dumps_are_rate_limited(self, tmp_path):
+        fr = flightrec_ns.FlightRecorder(str(tmp_path), seconds=60.0)
+        assert fr.dump("flappy") is not None
+        assert fr.dump("flappy") is None            # inside cooldown
+        assert fr.dump("other-reason") is not None  # per-reason limit
+
+    def test_load_dump_rejects_path_traversal(self, tmp_path):
+        fr = flightrec_ns.FlightRecorder(str(tmp_path), seconds=60.0)
+        fr.dump("safe")
+        assert flightrec_ns.load_dump(str(tmp_path),
+                                      "../secrets.json") is None
+        assert flightrec_ns.load_dump(str(tmp_path), "nope.txt") is None
+
+    def test_tsdb_annex_rides_along(self, tmp_path):
+        reg = obs_metrics.Registry()
+        c = reg.counter("annex_total")
+        clock = _clock(time.time())
+        db = _db(tmp_path, clock, registry=reg,
+                 resolutions=(("1s", 1.0, 64),))
+        c.inc(3)
+        db.sample_once()
+        fr = flightrec_ns.FlightRecorder(str(tmp_path / "rec"),
+                                         seconds=60.0, tsdb=db)
+        path = fr.dump("with-tsdb")
+        with open(path) as f:
+            doc = json.load(f)
+        assert "annex_total" in doc["tsdb"]["series"]
+
+
+# ---------------------------------------------------------------------------
+# The serve daemon: wiring + the JTPU_TSDB kill-switch identity
+# ---------------------------------------------------------------------------
+
+
+def _ops(n_pairs=2, value=1):
+    rows = []
+    t = 0
+    for i in range(n_pairs):
+        rows.append({"type": "invoke", "f": "write", "value": value + i,
+                     "process": 0, "time": t})
+        rows.append({"type": "ok", "f": "write", "value": value + i,
+                     "process": 0, "time": t + 1})
+        rows.append({"type": "invoke", "f": "read", "value": None,
+                     "process": 1, "time": t + 2})
+        rows.append({"type": "ok", "f": "read", "value": value + i,
+                     "process": 1, "time": t + 3})
+        t += 4
+    return rows
+
+
+def _wait_done(daemon, rid, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        doc = daemon.status(rid)
+        if doc and doc["state"] == "done":
+            return doc
+        time.sleep(0.02)
+    raise AssertionError(f"request {rid} never finished: "
+                         f"{daemon.status(rid)}")
+
+
+class TestServeWiring:
+    def test_usage_totals_reconcile_with_the_wal(self, tmp_path):
+        """The acceptance invariant: live totals == the WAL fold, and a
+        restarted daemon replays the meter back to the same totals."""
+        cfg = serve_ns.ServeConfig(root=str(tmp_path / "serve"),
+                                   backend="tpu")
+        assert cfg.tsdb_on
+        d1 = serve_ns.CheckDaemon(cfg)
+        d1.start()
+        try:
+            for tenant in ("a", "b", "a"):
+                code, body, _ = d1.submit({"tenant": tenant,
+                                           "model": "cas-register",
+                                           "history": _ops()})
+                assert code == 202
+                _wait_done(d1, body["id"])
+            live = d1.usage.totals()
+        finally:
+            d1.stop()
+        wal = os.path.join(cfg.root, serve_ns.WAL_NAME)
+        assert live == usage_ns.from_wal(wal)
+        assert live["tenants"]["a"]["requests"] == 2
+        assert live["tenants"]["b"]["requests"] == 1
+        assert live["total"]["ops"] == pytest.approx(3 * len(_ops()))
+        assert live["total"]["device-s"] > 0
+        # the restarted daemon replays the meter from the same WAL
+        d2 = serve_ns.CheckDaemon(serve_ns.ServeConfig(
+            root=cfg.root, backend="tpu"))
+        d2.start()
+        try:
+            assert d2.usage.totals() == live
+            assert d2.tsdb.resumed_records >= 0   # tsdb resumed too
+        finally:
+            d2.stop()
+
+    def test_request_seconds_series_lands_in_the_tsdb(self, tmp_path):
+        cfg = serve_ns.ServeConfig(root=str(tmp_path / "serve"),
+                                   backend="tpu", tsdb_cadence_s=0.1)
+        d = serve_ns.CheckDaemon(cfg)
+        d.start()
+        try:
+            code, body, _ = d.submit({"tenant": "t1",
+                                      "model": "cas-register",
+                                      "history": _ops()})
+            assert code == 202
+            _wait_done(d, body["id"])
+            d.tsdb.sample_once()
+            cnt, sm, _b = d.tsdb.window_hist(
+                "jtpu_serve_request_seconds", 3600.0, tenant="t1")
+            assert cnt >= 1 and sm > 0
+            assert d.tsdb.quantile("jtpu_serve_request_seconds", 0.99,
+                                   3600.0) is not None
+            assert os.path.exists(os.path.join(cfg.root,
+                                               tsdb_ns.TSDB_NAME))
+            assert "slo" in d.healthz()
+        finally:
+            d.stop()
+
+    def test_kill_switch_leaves_pr18_surface_identical(self, tmp_path,
+                                                       monkeypatch):
+        """JTPU_TSDB=0: no new metric families, no new artifacts, no
+        new healthz keys, and the new routes 404."""
+        monkeypatch.setenv("JTPU_TSDB", "0")
+        cfg = serve_ns.ServeConfig(root=str(tmp_path / "serve"),
+                                   backend="tpu", tsdb_enabled=True)
+        assert cfg.tsdb_on is False     # env wins over the field
+        families_before = {
+            ln for ln in obs_metrics.REGISTRY.to_prometheus()
+            .splitlines() if ln.startswith("# TYPE ")}
+        daemon, server = serve_ns.run_daemon(
+            cfg, host="127.0.0.1", port=0)
+        port = server.server_port
+        try:
+            assert daemon.tsdb is None and daemon.slo is None
+            assert daemon.usage is None and daemon.flightrec is None
+            code, body, _ = daemon.submit({"model": "cas-register",
+                                           "history": _ops()})
+            assert code == 202
+            doc = _wait_done(daemon, body["id"])
+            assert doc["result"]["valid"] is True
+            assert "slo" not in daemon.healthz()
+            families_after = {
+                ln for ln in obs_metrics.REGISTRY.to_prometheus()
+                .splitlines() if ln.startswith("# TYPE ")}
+            assert families_after == families_before
+            for path in ("/usage", "/slo", "/flightrec"):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}{path}", timeout=10)
+                assert ei.value.code == 404
+        finally:
+            server.shutdown()
+            daemon.stop()
+        assert not os.path.exists(os.path.join(cfg.root,
+                                               tsdb_ns.TSDB_NAME))
+        assert not os.path.exists(os.path.join(cfg.root,
+                                               flightrec_ns.DIR_NAME))
+        # the WAL done records carry no usage field either
+        from jepsen_tpu import journal
+        records, _ = journal.read_json_records(
+            os.path.join(cfg.root, serve_ns.WAL_NAME))
+        assert all("usage" not in r for r in records
+                   if r.get("event") == "done")
+
+    def test_breaker_trip_dumps_the_flight_recorder(self, tmp_path,
+                                                    monkeypatch):
+        cfg = serve_ns.ServeConfig(root=str(tmp_path / "serve"),
+                                   backend="tpu", breaker_fails=1)
+        d = serve_ns.CheckDaemon(cfg)
+        monkeypatch.setattr(
+            serve_ns.CheckDaemon, "_check",
+            lambda self, req: {"valid": "unknown",
+                               "error": "RESOURCE_EXHAUSTED (fake)",
+                               "error-class": "oom"})
+        d.start()
+        try:
+            code, body, _ = d.submit({"tenant": "boom",
+                                      "model": "cas-register",
+                                      "history": _ops()})
+            assert code == 202
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                if flightrec_ns.list_dumps(cfg.root):
+                    break
+                time.sleep(0.05)
+        finally:
+            d.stop()
+        dumps = flightrec_ns.list_dumps(cfg.root)
+        reasons = {dmp["reason"] for dmp in dumps}
+        assert "breaker-trip" in reasons
+        trip = next(dmp for dmp in dumps
+                    if dmp["reason"] == "breaker-trip")
+        doc = flightrec_ns.load_dump(cfg.root, trip["name"])
+        assert doc["extra"]["class"]
+        assert doc["extra"]["bucket"]

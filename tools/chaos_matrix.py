@@ -82,6 +82,12 @@ qualify a new accelerator image before trusting it with long runs):
                    twice, pairs swapped, re-post after close): the
                    sealed history.json is byte-identical to a clean
                    in-order session's and the verdict matches offline
+  flightrec-kill   SIGKILL the daemon mid-burst after a poison request
+                   tripped its bucket's breaker: the breaker-trip
+                   flight-recorder dump written before the kill is
+                   whole (valid JSON, atomic rename), carries the
+                   poison's trace id, and renders via `jtpu
+                   flightrec`; the SIGTERM-path dump is absent
   lint-seeded-race patch a known-bad pattern (off-lock queue append +
                    depth bump) into a COPY of serve.py and assert the
                    lockset static-analysis pass fires LOCK-UNGUARDED
@@ -1818,6 +1824,175 @@ def scenario_stream_dup(seed):
     return True, "; ".join(details)
 
 
+def scenario_flightrec_kill(seed):
+    """SIGKILL the daemon MID-BURST, after one poison request tripped
+    its bucket's breaker: the breaker-trip flight-recorder dump written
+    BEFORE the kill must survive whole (the atomic tmp + rename
+    contract: valid JSON, never a half file), carry the poison
+    request's trace id, and render through `jtpu flightrec` — while the
+    SIGTERM-path dump is ABSENT, proving the dump came from the trip
+    trigger, not from an orderly shutdown the kill never allowed
+    (doc/observability.md, "Flight recorder")."""
+    import contextlib
+    import io
+    import tempfile
+    import urllib.request
+
+    from jepsen_tpu import cli
+    from jepsen_tpu.history import History
+    from jepsen_tpu.obs import flightrec as flightrec_ns
+
+    root = tempfile.mkdtemp(prefix="jepsen-chaos-flightrec-")
+    serve_dir = os.path.join(root, "serve")
+    port_file = os.path.join(root, "port.json")
+    # same poison-by-row-count trick as serve-batch-poison: survivors
+    # share a shape bucket with the poison, but only the poison's
+    # packed row count triggers the injected gang OOM
+    surv_ops = [[o.to_dict() for o in
+                 simulate_register_history(40, n_procs=3, n_vals=3,
+                                           seed=seed + i)]
+                for i in range(3)]
+    surv_ns = {pack_with_init(History.of(o), CASRegister())[0].n
+               for o in surv_ops}
+    poison_ops = poison_n = None
+    for s in range(seed + 9, seed + 29):
+        ops = [o.to_dict() for o in
+               simulate_register_history(48, n_procs=3, n_vals=3,
+                                         seed=s)]
+        n = pack_with_init(History.of(ops), CASRegister())[0].n
+        if n not in surv_ns:
+            poison_ops, poison_n = ops, n
+            break
+    if poison_ops is None:
+        return False, "poison history not distinguishable by row count"
+
+    # breaker_fails=1: the poison's isolated failure trips the bucket
+    # immediately, which fires the breaker-trip flight-recorder dump
+    child_src = (
+        "import json, sys\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "from jepsen_tpu import serve as S\n"
+        "from jepsen_tpu.checker import tpu as T\n"
+        "def _fault(pks):\n"
+        f"    if any(p.n == {poison_n} for p in pks):\n"
+        "        raise RuntimeError("
+        "'RESOURCE_EXHAUSTED: injected gang OOM (chaos)')\n"
+        "T._GANG_FAULT = _fault\n"
+        f"cfg = S.ServeConfig(root={serve_dir!r}, backend='tpu', "
+        "workers=1, batch_max=8, batch_wait_ms=1000.0, "
+        "breaker_fails=1)\n"
+        f"d, srv = S.run_daemon(cfg, host='127.0.0.1', port=0, "
+        f"store_root={root!r})\n"
+        f"json.dump({{'port': srv.server_port}}, "
+        f"open({port_file!r}, 'w'))\n"
+        "d.drained.wait()\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", JTPU_TSDB="1",
+               JTPU_TSDB_CADENCE="0.2")
+    proc = subprocess.Popen([sys.executable, "-c", child_src], env=env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+
+    def post(port, doc):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/check",
+            data=json.dumps(doc).encode(), method="POST")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.load(r)
+
+    try:
+        deadline = time.time() + 60
+        port = None
+        while time.time() < deadline:
+            if os.path.exists(port_file):
+                try:
+                    with open(port_file) as f:
+                        port = json.load(f)["port"]
+                    break
+                except (OSError, ValueError):
+                    pass
+            if proc.poll() is not None:
+                return False, f"daemon exited rc={proc.returncode} at boot"
+            time.sleep(0.1)
+        if port is None:
+            return False, "daemon never published its port"
+        # poison leads the gang; survivors land inside the 1 s
+        # coalesce window behind it
+        poison_trace = post(port, {"tenant": "a",
+                                   "model": "cas-register",
+                                   "history": poison_ops}).get("trace")
+        if not poison_trace:
+            return False, "poison 202 carried no trace id"
+        for i, o in enumerate(surv_ops):
+            post(port, {"tenant": "ab"[i % 2],
+                        "model": "cas-register", "history": o})
+        # the kill window: the breaker has tripped (its dump is on
+        # disk) but the burst is still being re-checked
+        rec_dir = os.path.join(serve_dir, flightrec_ns.DIR_NAME)
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if any(f.startswith("breaker-trip-")
+                   for f in (os.listdir(rec_dir)
+                             if os.path.isdir(rec_dir) else [])):
+                break
+            if proc.poll() is not None:
+                return False, (f"daemon died rc={proc.returncode} "
+                               f"before the breaker tripped")
+            time.sleep(0.05)
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    details = []
+    dumps = flightrec_ns.list_dumps(serve_dir)
+    reasons = [d["reason"] for d in dumps]
+    if "sigterm" in reasons or "drain" in reasons:
+        return False, (f"orderly-shutdown dump present after SIGKILL "
+                       f"({reasons}) — the kill was not a kill")
+    trips = [d for d in dumps if d["reason"] == "breaker-trip"]
+    if not trips:
+        return False, (f"no breaker-trip dump survived the kill "
+                       f"(found {reasons or 'none'})")
+    leftovers = [f for f in os.listdir(os.path.join(
+        serve_dir, flightrec_ns.DIR_NAME)) if f.startswith(".")]
+    if leftovers:
+        return False, f"half-written dump temp files survived: {leftovers}"
+    doc = flightrec_ns.load_dump(serve_dir, trips[0]["name"])
+    if doc is None:
+        return False, f"breaker-trip dump {trips[0]['name']} unreadable"
+    details.append(f"breaker-trip dump whole after SIGKILL "
+                   f"({trips[0]['bytes']} bytes, "
+                   f"{len(doc.get('spans') or [])} spans)")
+    if (doc.get("extra") or {}).get("class") != "oom":
+        return False, (f"dump blames class "
+                       f"{(doc.get('extra') or {}).get('class')!r}, "
+                       f"want 'oom'")
+    if poison_trace not in (doc.get("trace-ids") or []):
+        return False, (f"poison trace {poison_trace} missing from the "
+                       f"dump's {len(doc.get('trace-ids') or [])} "
+                       f"trace id(s)")
+    details.append("dump carries the poison request's trace id")
+    # the reader path: `jtpu flightrec` lists it, then renders it
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc_list = cli.run(cli.default_commands(),
+                          ["flightrec", "--serve-dir", serve_dir])
+    if rc_list != 0 or "breaker-trip" not in buf.getvalue():
+        return False, (f"jtpu flightrec list rc={rc_list}, output "
+                       f"{buf.getvalue()!r}")
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc_show = cli.run(cli.default_commands(),
+                          ["flightrec", trips[0]["name"],
+                           "--serve-dir", serve_dir])
+    if rc_show != 0 or f"trace {poison_trace}" not in buf.getvalue():
+        return False, (f"jtpu flightrec {trips[0]['name']} rc="
+                       f"{rc_show} did not render the poison trace")
+    details.append("jtpu flightrec renders the dump (list + show)")
+    return True, "; ".join(details)
+
+
 def scenario_lint_seeded_race(seed):
     """Seed a known-bad concurrency pattern (off-lock queue append +
     depth bump — the exact bug class the lockset pass was built to
@@ -1896,6 +2071,7 @@ SCENARIOS = (
     ("serve-fleet-host-kill", scenario_serve_fleet_host_kill),
     ("stream-kill", scenario_stream_kill),
     ("stream-dup", scenario_stream_dup),
+    ("flightrec-kill", scenario_flightrec_kill),
     ("lint-seeded-race", scenario_lint_seeded_race),
 )
 

@@ -38,7 +38,12 @@ served request. This gate IS that request:
   burst must shard over both workers, ``/healthz`` must report
   ``fleet.live == 2``, every verdict must equal the offline path's, and
   drain must release it — proving fleet-backed serving survives CI
-  (doc/serve.md, "Fleet-backed serving").
+  (doc/serve.md, "Fleet-backed serving");
+* the telemetry layer must reconcile: after the saturating burst,
+  ``GET /usage`` totals must equal a fold over the WAL's ``done``
+  records digit for digit, and ``GET /slo`` must answer every declared
+  objective with a finite burn rate for every window
+  (doc/observability.md, "Usage metering" / "SLOs").
 
 Usage: python tools/serve_gate.py [--budget SECONDS] [--time-limit S]
 Exit code 0 iff the served verdict matches offline within the budget.
@@ -381,6 +386,45 @@ def main() -> int:
             problems.append(f"healthz fleet {fl}, want 2/2 proc hosts")
         if not fl.get("gangs"):
             problems.append(f"fleet dispatched no gang: {fl}")
+        # 4b. the telemetry leg: after the saturating burst, the usage
+        # meter's live totals must equal a fold over the WAL's done
+        # records (doc/observability.md, "Usage metering"), and /slo
+        # must answer every declared objective with a finite burn rate
+        from jepsen_tpu.obs import usage as usage_ns
+        code, usage_doc = _get(fport, "/usage")
+        if code != 200:
+            problems.append(f"GET /usage answered {code}")
+        else:
+            wal_totals = usage_ns.from_wal(
+                os.path.join(fcfg.root, serve_ns.WAL_NAME))
+            if usage_doc != wal_totals:
+                problems.append(
+                    f"live usage {usage_doc} != WAL fold {wal_totals}")
+            tenants = usage_doc.get("tenants", {})
+            if len(tenants) < 3:
+                problems.append(
+                    f"usage meter saw {sorted(tenants)}, want the 3 "
+                    f"burst tenants")
+        code, slo_doc = _get(fport, "/slo")
+        if code != 200:
+            problems.append(f"GET /slo answered {code}")
+        else:
+            objectives = slo_doc.get("objectives", {})
+            if not objectives:
+                problems.append(f"/slo declares no objectives: "
+                                f"{slo_doc}")
+            for name, obj in objectives.items():
+                windows = obj.get("windows") or {}
+                if not windows:
+                    problems.append(f"objective {name} answers no "
+                                    f"windows: {obj}")
+                for win, burn in windows.items():
+                    if not (isinstance(burn, (int, float))
+                            and burn == burn
+                            and abs(burn) != float("inf")):
+                        problems.append(
+                            f"objective {name} window {win} burn "
+                            f"{burn!r} is not finite")
         code, drained, _ = _post(fport, "/drain", None)
         if code != 200 or not drained.get("drained"):
             problems.append(f"fleet drain answered {code}: {drained}")
