@@ -160,8 +160,12 @@ DEFAULT_SCOPES = {
     # spans modules, so its scope is one file set, not per-file
     "deadlock": ("jepsen_tpu/serve.py", "jepsen_tpu/stream.py",
                  "jepsen_tpu/fleet.py", "jepsen_tpu/checker/engine.py",
-                 "jepsen_tpu/obs/observatory.py"),
-    "walcheck": ("jepsen_tpu/serve.py", "jepsen_tpu/stream.py"),
+                 "jepsen_tpu/obs/observatory.py",
+                 "jepsen_tpu/obs/federation.py",
+                 "jepsen_tpu/obs/straggler.py"),
+    "walcheck": ("jepsen_tpu/serve.py", "jepsen_tpu/stream.py",
+                 "jepsen_tpu/obs/federation.py",
+                 "jepsen_tpu/obs/straggler.py"),
 }
 
 PASSES = ("suite", "history", "jax", "lockset", "deadlock", "walcheck",

@@ -1734,7 +1734,8 @@ def check_packed_gang_fleet(pks: Sequence[PackedHistory],
                             max_retries: int = 2,
                             segment_deadline_s: float = 120.0,
                             stats: Optional[Dict[str, int]] = None,
-                            trail: Optional[list] = None
+                            trail: Optional[list] = None,
+                            straggler: Optional[Any] = None
                             ) -> List[Dict[str, Any]]:
     """:func:`check_packed_gang`, placed onto FLEET HOSTS instead of
     the local device: each segment round shards the gang's vmapped
@@ -1776,7 +1777,7 @@ def check_packed_gang_fleet(pks: Sequence[PackedHistory],
     for ladder, idx in groups.items():
         _gang_ladder_fleet(pks, kernel, idx, ladder, seg, deadlines,
                            results, hosts, on_round, max_retries,
-                           segment_deadline_s, stats, trail)
+                           segment_deadline_s, stats, trail, straggler)
     return results
 
 
@@ -1791,7 +1792,8 @@ def _fleet_lost_result(lane_levels: int) -> Dict[str, Any]:
 
 def _gang_ladder_fleet(pks, kernel, idx, ladder, seg, deadlines,
                        results, hosts, on_round, max_retries,
-                       segment_deadline_s, stats, trail) -> None:
+                       segment_deadline_s, stats, trail,
+                       straggler=None) -> None:
     """One ladder-homogeneous gang group, sharded over fleet hosts
     per segment round (see :func:`check_packed_gang_fleet`)."""
     from jepsen_tpu import resilience
@@ -1847,6 +1849,12 @@ def _gang_ladder_fleet(pks, kernel, idx, ladder, seg, deadlines,
                 bump("remeshes")
                 note("remesh", round=round_idx, live=len(live),
                      rung=[cap, win, exp])
+            if straggler is not None:
+                # straggler advisory: unflagged hosts first (stable
+                # order otherwise) — with fewer shards than hosts a
+                # flagged host simply receives none. Verdict-neutral:
+                # every lane computes the same carry wherever it runs.
+                live = straggler.prefer(live)
             # shard ALL pending lanes over the live hosts: inactive
             # lanes no-op in-device (their while-condition is false),
             # which keeps every host's shard shape round-stable
@@ -1870,6 +1878,11 @@ def _gang_ladder_fleet(pks, kernel, idx, ladder, seg, deadlines,
                 while True:
                     try:
                         out, _secs = h.collect_gang(segment_deadline_s)
+                        if straggler is not None:
+                            from jepsen_tpu.obs import straggler as \
+                                _straggler_mod
+                            straggler.observe_segment(
+                                _straggler_mod.host_key(h), _secs)
                         for tgt, c in zip(new_carry, out):
                             tgt[sel] = c
                         advanced.update(int(j) for j in sel)

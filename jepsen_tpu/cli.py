@@ -1021,6 +1021,107 @@ def watch_cmd() -> dict:
     return {"watch": {"parser": build_parser, "run": run_}}
 
 
+def top_cmd() -> dict:
+    """The 'top' subcommand: one-screen live status of a serve
+    directory — queue depth, fleet width, per-host frame age and
+    straggler verdicts, SLO burn and the top tenant — read entirely
+    from the published artifacts (``progress.json`` + the federated
+    ``telemetry.frames``), so it works on a live daemon, a dead one,
+    or over a copied directory (doc/observability.md "Fleet
+    federation"). Pointed at a plain run directory it degrades to the
+    `watch` search line."""
+
+    def build_parser():
+        p = Parser(prog="top",
+                   description="One-screen live fleet/serve status "
+                               "from a serve directory's published "
+                               "artifacts.")
+        p.add_argument("--store", default=None,
+                       help="serve (or run) directory (default: "
+                            "latest under --store-root)")
+        p.add_argument("--store-root", default="store")
+        p.add_argument("--interval", type=float, default=2.0,
+                       help="seconds between refreshes")
+        p.add_argument("--once", action="store_true",
+                       help="print one screen and exit")
+        return p
+
+    def _screen(d: str) -> list:
+        import time as _time
+
+        from jepsen_tpu.obs import federation as obs_federation
+        from jepsen_tpu.obs import observatory
+
+        p = observatory.read_progress(d)
+        when = _time.strftime("%H:%M:%S")
+        lines = [f"# top: {d} at {when}"]
+        if p is None:
+            lines.append("# top: no progress.json yet (daemon not "
+                         "started, or JTPU_TRACE=0)")
+            return lines
+        s = p.get("serve")
+        if s is None:
+            # a plain search run directory: reuse the watch line
+            lines.append(observatory.format_status(p))
+            return lines
+        state = p.get("state") or "serving"
+        lines.append(f"# top: state {state} | queue "
+                     f"{s.get('queue-depth', 0)} | inflight "
+                     f"{s.get('inflight', 0)} | done "
+                     f"{s.get('completed', 0)} | rejected "
+                     f"{s.get('rejected', 0)}")
+        slo = s.get("slo")
+        bits = []
+        if slo is not None:
+            n = slo.get("breached", 0)
+            burn = slo.get("max-burn", 0)
+            bits.append(f"slo BURN x{n} ({burn:g})" if n
+                        else f"slo OK ({burn:g})")
+        if s.get("usage-top"):
+            t, dev = s["usage-top"][0], s["usage-top"][1]
+            bits.append(f"top tenant {t}: {dev:g} device-s")
+        if s.get("breakers-open"):
+            bits.append(f"breakers-open {s['breakers-open']}")
+        if bits:
+            lines.append("# top: " + " | ".join(bits))
+        if s.get("fleet-hosts") is not None:
+            fbit = (f"fleet {s.get('fleet-live', 0)}/"
+                    f"{s['fleet-hosts']} host(s)")
+            if s.get("remeshes"):
+                fbit += f" | remesh {s['remeshes']}"
+            lines.append("# top: " + fbit)
+        stragglers = set(s.get("straggler-hosts") or [])
+        ages = obs_federation.fleet_ages(d)
+        for host in sorted(set(ages) | stragglers):
+            age = ages.get(host)
+            abit = f"age {age:g}s" if age is not None else "age ?"
+            sbit = "  STRAGGLER" if host in stragglers else ""
+            lines.append(f"# top:   {host:<16} {abit}{sbit}")
+        return lines
+
+    def run_(opts) -> int:
+        import os as _os
+        import time as _time
+
+        from jepsen_tpu import store
+
+        d = opts.get("store")
+        if d is None:
+            t = store.latest(opts.get("store_root") or "store")
+            d = t.get("store-dir") if t else None
+        if not d or not _os.path.isdir(d):
+            print(f"no such store directory: {d}", file=sys.stderr)
+            return INVALID_ARGS
+        while True:
+            for line in _screen(d):
+                print(line, flush=True)
+            if opts.get("once"):
+                return OK
+            _time.sleep(max(opts.get("interval") or 2.0, 0.05))
+
+    return {"top": {"parser": build_parser, "run": run_}}
+
+
 def trace_cmd() -> dict:
     """The 'trace' subcommand family: read a run's ``trace.jsonl`` span
     artifact (doc/observability.md).
@@ -1037,6 +1138,11 @@ def trace_cmd() -> dict:
       default, ``--format chrome`` for Perfetto, ``--format json`` for
       the raw stitched document. ``<id>`` is a serve request id
       (resolved through serve.wal) or a literal 32-hex trace id.
+    * ``trace find`` — federated trace search over a serve directory
+      (doc/observability.md "Fleet federation"): filter completed
+      requests by ``--tenant``, ``--min-device-s``, ``--error-class``
+      and ``--host``, newest first; each hit links to
+      ``trace request <id>``.
 
     Reading is torn-tail tolerant (the run may have been SIGKILLed
     mid-span, or still be running)."""
@@ -1046,11 +1152,13 @@ def trace_cmd() -> dict:
                    description="Export or summarize a run's span "
                                "trace (trace.jsonl).")
         p.add_argument("action",
-                       choices=["export", "summary", "request"],
+                       choices=["export", "summary", "request",
+                                "find"],
                        help="export: write Chrome/Perfetto (or raw "
                             "jsonl) trace; summary: per-span rollup; "
                             "request: one request's stitched "
-                            "cross-process waterfall")
+                            "cross-process waterfall; find: federated "
+                            "trace search over a serve directory")
         p.add_argument("rid", nargs="?", default=None, metavar="ID",
                        help="with `request`: the serve request id (or "
                             "32-hex trace id) to stitch")
@@ -1076,6 +1184,21 @@ def trace_cmd() -> dict:
                             "dir(s) whose trace.jsonl joins the "
                             "stitch (repeatable; host dirs under the "
                             "store dir are discovered automatically)")
+        p.add_argument("--tenant", default=None,
+                       help="with `find`: only this tenant's requests")
+        p.add_argument("--min-device-s", type=float, default=None,
+                       metavar="S",
+                       help="with `find`: only requests that burned "
+                            "at least S device-seconds")
+        p.add_argument("--error-class", default=None, metavar="CLASS",
+                       help="with `find`: only requests whose result "
+                            "carries this error class")
+        p.add_argument("--host", default=None,
+                       help="with `find`: only requests with spans on "
+                            "this fleet host")
+        p.add_argument("--limit", type=int, default=50, metavar="N",
+                       help="with `find`: newest N matches "
+                            "(default 50)")
         return p
 
     def run_(opts) -> int:
@@ -1095,6 +1218,8 @@ def trace_cmd() -> dict:
         fmt = opts.get("format") or "chrome"
         if opts["action"] == "request":
             return _trace_request(opts, d)
+        if opts["action"] == "find":
+            return _trace_find(opts, d)
         path = _os.path.join(d, trace_ns.TRACE_NAME)
         if not _os.path.exists(path):
             print(f"no {trace_ns.TRACE_NAME} in {d} (run predates "
@@ -1279,6 +1404,44 @@ def _trace_request(opts, d: str) -> int:
         dur_bit = f"{dur / 1e9:>9.4f}s" if dur else "   instant"
         print(f"# trace: [{bar[:cols]}] {(ts - t0) / 1e9:>9.4f}s "
               f"{dur_bit}  {host:<{hostw}} {name:<{namew}}")
+    return OK
+
+
+def _trace_find(opts, d: str) -> int:
+    """``jtpu trace find`` — federated trace search: filter a serve
+    directory's completed requests by tenant / device-time / error
+    class / fleet host and print one line per hit, newest first."""
+    import json as _json
+
+    from jepsen_tpu.obs import federation as obs_federation
+
+    rows = obs_federation.trace_find(
+        d,
+        tenant=opts.get("tenant"),
+        min_device_s=opts.get("min_device_s"),
+        error_class=opts.get("error_class"),
+        host=opts.get("host"),
+        limit=opts.get("limit") or 50)
+    fmt = opts.get("format") or "text"
+    if fmt == "json":
+        print(_json.dumps({"requests": rows}, indent=2, default=repr))
+        return OK
+    print(f"# trace: find: {len(rows)} matching request(s) in {d}")
+    if not rows:
+        return OK
+    idw = max(len(str(r.get("id", ""))) for r in rows)
+    tw = max((len(str(r.get("tenant", ""))) for r in rows), default=6)
+    for r in rows:
+        dev = r.get("device-s")
+        hosts = " ".join(r.get("hosts") or []) or "-"
+        err = r.get("error-class") or "-"
+        print(f"# trace: {str(r.get('id', '')):<{idw}} "
+              f"{str(r.get('tenant', '')):<{tw}} "
+              f"valid={r.get('valid')} "
+              f"secs={r.get('seconds') if r.get('seconds') is not None else '-'} "
+              f"device-s={dev if dev is not None else '-'} "
+              f"err={err} hosts={hosts}")
+    print("# trace: drill in: jtpu trace request <id> --store " + d)
     return OK
 
 
@@ -1746,13 +1909,15 @@ def main(subcommands: Dict[str, dict],
 
 def default_commands() -> dict:
     """The stock subcommand set: runner + analyzer + recovery + linter
-    + plan verifier + trace tooling + live watch + server + streaming
-    client + verdict explainer + usage meter + flight-recorder reader
-    (what ``python -m jepsen_tpu`` dispatches)."""
+    + plan verifier + trace tooling + live watch + fleet top + server
+    + streaming client + verdict explainer + usage meter +
+    flight-recorder reader (what ``python -m jepsen_tpu``
+    dispatches)."""
     return merge_commands(suite_run_cmd(), analyze_cmd(), recover_cmd(),
                           lint_cmd(), plan_cmd(), trace_cmd(),
-                          watch_cmd(), serve_cmd(), stream_cmd(),
-                          explain_cmd(), usage_cmd(), flightrec_cmd())
+                          watch_cmd(), top_cmd(), serve_cmd(),
+                          stream_cmd(), explain_cmd(), usage_cmd(),
+                          flightrec_cmd())
 
 
 if __name__ == "__main__":  # default main

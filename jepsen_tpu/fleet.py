@@ -102,8 +102,10 @@ from jepsen_tpu import accel, obs, resilience
 from jepsen_tpu.checker import UNKNOWN
 from jepsen_tpu.checker import tpu as T
 from jepsen_tpu.models.core import KernelSpec
+from jepsen_tpu.obs import federation as obs_federation
 from jepsen_tpu.obs import metrics as obs_metrics
 from jepsen_tpu.obs import observatory as obs_observatory
+from jepsen_tpu.obs import straggler as obs_straggler
 from jepsen_tpu.obs import trace as obs_trace
 from jepsen_tpu.ops.encode import PackedHistory
 from jepsen_tpu.resilience import (CARRY_FIELDS, Checkpoint, RetryPolicy,
@@ -511,10 +513,19 @@ class LocalHost:
         unroll = T._unroll_factor()
         fn = T._jit_segment(T._kernel_key(self._kernel), cap, win, exp,
                             unroll)
+        # phase split mirrors the supervisor's compile/execute convention
+        # so every in-process checker.segment span carries a phase
+        phase = ("compile" if T._first_call(
+            ("fleet-segment", T._kernel_key(self._kernel), cap, win, exp,
+             unroll, self._cols["f"].shape[0], self._cols["cf"].shape[0]))
+            else "execute")
         t0 = time.perf_counter()
-        out = fn(*(self._cols[c] for c in T._COLS),
-                 np.int32(seg_iters), carry)
-        out = tuple(np.asarray(x) for x in out)
+        with obs.span("checker.segment", host=self.name, phase=phase,
+                      round=round_idx, rung=[cap, win, exp],
+                      seg_iters=seg_iters):
+            out = fn(*(self._cols[c] for c in T._COLS),
+                     np.int32(seg_iters), carry)
+            out = tuple(np.asarray(x) for x in out)
         return out, time.perf_counter() - t0
 
     # -- gang shards (serve fleet placement) --------------------------------
@@ -540,9 +551,17 @@ class LocalHost:
             self.chaos(ctx)
         fn = T._jit_batch_segment(T._kernel_key(kernel), cap, win, exp,
                                   T._unroll_factor())
+        phase = ("compile" if T._first_call(
+            ("fleet-gang", T._kernel_key(kernel), cap, win, exp,
+             T._unroll_factor(), ctx["gang"],
+             tuple(np.asarray(cols[0]).shape)))
+            else "execute")
         t0 = time.perf_counter()
-        out = fn(*cols, np.int32(seg_iters), carry)
-        out = tuple(np.asarray(x) for x in out)
+        with obs.span("checker.segment", host=self.name, phase=phase,
+                      round=round_idx, rung=[cap, win, exp],
+                      seg_iters=seg_iters, gang=ctx["gang"]):
+            out = fn(*cols, np.int32(seg_iters), carry)
+            out = tuple(np.asarray(x) for x in out)
         return out, time.perf_counter() - t0
 
 
@@ -799,12 +818,32 @@ def worker_main(host_dir: str) -> int:
         obs_trace.tracer().attach(
             os.path.join(host_dir, obs_trace.TRACE_NAME))
         obs_trace.sync_event()
+    exporter = None
+    if obs_federation.enabled():
+        # the host's live telemetry plane: registry deltas + the span
+        # tail, appended to telemetry.frames for the leader to federate
+        exporter = obs_federation.FrameExporter(host_dir)
+        exporter.start()
+    # chaos seam: JTPU_CHAOS_SLOW_HOST="<host-dir-basename>:<seconds>"
+    # stalls THIS worker before every segment — verdict-neutral added
+    # latency for the straggler-host scenario
+    slow_s = 0.0
+    spec = os.environ.get("JTPU_CHAOS_SLOW_HOST", "")
+    if ":" in spec:
+        who, _, secs = spec.partition(":")
+        if who == (os.path.basename(host_dir) or host_dir):
+            try:
+                slow_s = max(0.0, float(secs))
+            except ValueError:
+                slow_s = 0.0
     cols = None
     kernel = None
     done: set = set()
     while True:
         if os.path.exists(os.path.join(host_dir, "stop")):
             stop_beat.set()
+            if exporter is not None:
+                exporter.stop()
             obs_trace.tracer().detach()
             return 0
         reqs = []
@@ -836,11 +875,21 @@ def worker_main(host_dir: str) -> int:
                 state["state"], state["round"] = ("segment",
                                                   meta.get("round"))
                 obs_trace.set_context(meta.get("trace") or None)
+                if slow_s:
+                    time.sleep(slow_s)
                 exp = meta.get("expand")
                 exp = None if exp is None or exp < 0 else exp
                 g = int(np.asarray(gcols[0]).shape[0])
+                # phase stamped so the federated straggler feed can
+                # skip compile-time spans (compile is not skew)
+                phase = ("compile" if T._first_call(
+                    ("fleet-gang", kname, meta["capacity"],
+                     meta["window"], exp, T._unroll_factor(), g,
+                     tuple(np.asarray(gcols[0]).shape)))
+                    else "execute")
                 with obs.span("checker.segment",
                               host=os.path.basename(host_dir) or host_dir,
+                              phase=phase,
                               round=meta.get("round"),
                               rung=[meta["capacity"], meta["window"],
                                     exp],
@@ -887,12 +936,21 @@ def worker_main(host_dir: str) -> int:
             state["state"], state["round"] = ("segment",
                                               meta.get("round"))
             obs_trace.set_context(meta.get("trace") or None)
+            if slow_s:
+                time.sleep(slow_s)
             exp = meta.get("expand")
+            exp_eff = None if exp is None or exp < 0 else exp
+            phase = ("compile" if T._first_call(
+                ("fleet-segment", kname, meta["capacity"],
+                 meta["window"], exp_eff, T._unroll_factor(),
+                 cols["f"].shape[0], cols["cf"].shape[0]))
+                else "execute")
             with obs.span("checker.segment",
                           host=os.path.basename(host_dir) or host_dir,
+                          phase=phase,
                           round=meta.get("round"),
                           rung=[meta["capacity"], meta["window"],
-                                None if exp is None or exp < 0 else exp],
+                                exp_eff],
                           seg_iters=meta["seg_iters"]):
                 fn = T._jit_segment(
                     T._kernel_key(kernel), meta["capacity"],
@@ -944,6 +1002,12 @@ class ElasticFleet:
         self.stats = {"remesh-count": 0, "steal-count": 0,
                       "hosts-lost": 0, "hosts-joined": 0,
                       "peak-imbalance": 1.0, "rounds": 0}
+        # the straggler observatory: fed per-segment wall time at the
+        # collect barrier and heartbeat ages at the merge barrier; a
+        # flagged host forces the next work-steal re-deal. Gated so
+        # JTPU_FEDERATE=0 keeps the score gauge unregistered.
+        self.straggler = obs_straggler.StragglerDetector() \
+            if obs_federation.enabled() else None
 
     # -- elasticity API -----------------------------------------------------
 
@@ -1200,6 +1264,35 @@ class ElasticFleet:
                         streak = 0
                 else:
                     streak = 0
+                if self.straggler is not None:
+                    # straggler observatory: heartbeat ages join the
+                    # segment-time EWMAs, and a NEWLY flagged host
+                    # forces the next re-deal without waiting out the
+                    # row-imbalance streak — wall-clock skew is a
+                    # straggler signal even when rows are balanced
+                    for h in self.live_hosts():
+                        hd = getattr(h, "dir", None)
+                        hb = read_heartbeat(hd) if hd else None
+                        if hb is not None:
+                            self.straggler.observe_heartbeat(
+                                obs_straggler.host_key(h),
+                                max(0.0, time.time()
+                                    - float(hb.get("ts", 0.0))))
+                    newly = self.straggler.poll_new()
+                    if newly:
+                        scores = self.straggler.scores()
+                        for hn in sorted(newly):
+                            # round_idx already advanced at the merge
+                            # barrier above — stamp the round whose
+                            # segments triggered the flag, matching
+                            # the workers' span numbering
+                            self._trail("straggler-flagged",
+                                        round=round_idx - 1, host=hn,
+                                        score=scores.get(hn),
+                                        outcome="steal-requested")
+                        if policy.steal and naxis > 1 \
+                                and alive_n >= naxis:
+                            steal_next = True
                 obs_observatory.publish(
                     level=lvl1, frontier=alive_n, segments=round_idx,
                     seg_seconds=round_wall, levels_delta=lvl1 - lvl0,
@@ -1275,7 +1368,10 @@ class ElasticFleet:
         attempts = 0
         while True:
             try:
-                out, _secs = h.collect(policy.segment_deadline_s)
+                out, secs = h.collect(policy.segment_deadline_s)
+                if self.straggler is not None:
+                    self.straggler.observe_segment(
+                        obs_straggler.host_key(h), secs)
                 return out
             except HostLostError as e:
                 self._host_lost(h, round_idx, "host-lost", str(e))
@@ -1310,6 +1406,9 @@ class ElasticFleet:
             return
         h.state = "dead"
         _HOST_LOST_TOTAL.inc(**{"class": cls, "host": h.name})
+        if self.straggler is not None:
+            # a dead host must not skew the survivors' medians
+            self.straggler.forget(obs_straggler.host_key(h))
         self.stats["hosts-lost"] += 1
         # wall_ns dates the loss for flight-recorder dumps, whose span
         # timestamps are otherwise process-monotonic

@@ -213,8 +213,11 @@ def merge_metrics(hosts: List[Dict[str, Any]]) -> Dict[str, Any]:
                                         "series": {}, "fleet": {}})
             for key, val in (m.get("series") or {}).items():
                 ent["series"][_with_host(key, h["host"])] = val
+                if isinstance(val, dict):
+                    _merge_hist_series(ent["fleet"], key, val)
+                    continue
                 if not isinstance(val, (int, float)):
-                    continue  # histogram series aggregate below
+                    continue
                 cur = ent["fleet"].get(key)
                 if m.get("kind") == "gauge":
                     ent["fleet"][key] = (val if cur is None
@@ -222,6 +225,38 @@ def merge_metrics(hosts: List[Dict[str, Any]]) -> Dict[str, Any]:
                 else:
                     ent["fleet"][key] = (cur or 0) + val
     return out
+
+
+def _merge_hist_series(fleet: Dict[str, Any], key: str,
+                       val: Dict[str, Any]) -> None:
+    """Fold one host's histogram series doc into the fleet aggregate:
+    buckets/count/sum add, bounds come from the first host seen, and
+    **exemplars survive** — last-write-wins per bucket index, so the
+    fleet ``/metrics`` view keeps its trace-id links instead of
+    silently dropping every exemplar at the host merge. Exemplar keys
+    arrive as ints in-process but as strings after the metrics.json
+    round-trip; both fold onto the string key."""
+    cur = fleet.get(key)
+    if not isinstance(cur, dict):
+        cur = fleet[key] = {
+            "buckets": [0] * len(val.get("buckets") or []),
+            "bounds": list(val.get("bounds") or []),
+            "sum": 0.0, "count": 0}
+    buckets = [int(b) for b in (val.get("buckets") or [])]
+    old = cur["buckets"]
+    for i, b in enumerate(buckets):
+        if i < len(old):
+            old[i] += b
+        else:
+            old.append(b)
+    cur["sum"] = round(float(cur.get("sum", 0.0))
+                       + float(val.get("sum", 0.0)), 9)
+    cur["count"] = int(cur.get("count", 0)) + int(val.get("count", 0))
+    ex = val.get("exemplars")
+    if isinstance(ex, dict) and ex:
+        tgt = cur.setdefault("exemplars", {})
+        for i, doc in ex.items():
+            tgt[str(i)] = doc
 
 
 def _gauge_value(metrics: Optional[dict], name: str) -> Optional[float]:

@@ -349,6 +349,12 @@ def format_status(p: Optional[Dict[str, Any]]) -> str:
             if s.get("remeshes"):
                 fbit += f" | remesh {s['remeshes']}"
             bits.append(fbit)
+        if s.get("straggler-hosts"):
+            # the straggler observatory's verdict (doc/observability.md
+            # "Fleet federation"): hosts whose per-segment device time
+            # or heartbeat age runs sigma-x the fleet median
+            bits.append("straggler "
+                        + " ".join(s["straggler-hosts"]))
         if s.get("rate-limited") is not None:
             bits.append(f"rate-limited {s['rate-limited']}")
         if s.get("streams") is not None:

@@ -128,6 +128,11 @@ class TSDB:
         self._kinds: Dict[str, str] = {}
         self._bounds: Dict[str, List[float]] = {}
         self._cum: Dict[str, Dict[str, Any]] = {}
+        #: durable side-channel for ingest cursors (the federation
+        #: layer's per-host frame positions): carried by every
+        #: checkpoint and advanced by replayed tick ``src`` markers, so
+        #: external ingestion is exactly-once across SIGKILL+restart.
+        self.meta: Dict[str, Any] = {}              # guarded-by: _lock
         # sampler-thread-private (stop() joins before touching)
         self._writer: Optional[journal.JsonRecordWriter] = None  # guarded-by: none
         self._file_records = 0                      # guarded-by: none
@@ -208,6 +213,11 @@ class TSDB:
                             for k, v in (rec.get("kinds") or {}).items()})
         for name, b in (rec.get("bounds") or {}).items():
             self._bounds[str(name)] = [float(x) for x in b]
+        for mk, mv in (rec.get("meta") or {}).items():
+            if isinstance(mv, dict):
+                self.meta.setdefault(str(mk), {}).update(mv)
+            else:
+                self.meta[str(mk)] = mv
         npoints = {label: n for label, _, n in self.resolutions}
         for label, names in (rec.get("rings") or {}).items():
             if label not in self._rings:
@@ -234,6 +244,13 @@ class TSDB:
                 if isinstance(fr, list) and len(fr) == 3:
                     self._ingest_hist(name, sk, t, int(fr[0]),
                                       float(fr[1]), list(fr[2]))
+        src = rec.get("src")
+        if isinstance(src, list) and len(src) == 3:
+            # federated frame marker: advance the ingest cursor with
+            # the same record that carried the data — replay therefore
+            # never double-ingests a frame
+            self.meta.setdefault("fed", {})[str(src[0])] = \
+                [str(src[1]), int(src[2])]
 
     # -- ingestion ----------------------------------------------------
 
@@ -364,6 +381,36 @@ class TSDB:
                 log.warning("tsdb on_tick callback failed", exc_info=True)
         return wall
 
+    def ingest_external(self, t: float,
+                        c: Optional[dict] = None,
+                        g: Optional[dict] = None,
+                        h: Optional[dict] = None,
+                        hb: Optional[dict] = None,
+                        src: Optional[list] = None) -> None:
+        """Fold one externally-sampled tick (a federated host frame,
+        already delta-encoded and re-keyed) into the rings AND the
+        segment file. The appended record is a normal ``tick``, so
+        :meth:`resume` replays federated history exactly like local
+        history; ``src = [host, boot, seq]`` rides along and advances
+        the durable ingest cursor atomically with the data (see
+        :meth:`_apply_tick`). Sampler-thread-only (call from an
+        ``on_tick`` callback): the segment writer is private to that
+        thread, like :meth:`sample_once`."""
+        rec: Dict[str, Any] = {"k": "tick", "t": round(float(t), 3)}
+        for key, doc in (("hb", hb), ("c", c), ("g", g), ("h", h)):
+            if doc:
+                rec[key] = doc
+        if src is not None:
+            rec["src"] = [str(src[0]), str(src[1]), int(src[2])]
+        with self._lock:
+            self._apply_tick(rec)
+        w = self._writer
+        if w is not None and len(rec) > 2:
+            w.append(rec)
+            self._file_records += 1
+            if self._file_records >= COMPACT_RECORDS:
+                self._compact(float(t))
+
     # -- compaction ---------------------------------------------------
 
     def _ckpt_doc(self, wall: float) -> dict:
@@ -378,8 +425,11 @@ class TSDB:
                     out_n[name] = out_s
             if out_n:
                 rings[label] = out_n
-        return {"k": "ckpt", "t": round(wall, 3), "kinds": self._kinds,
-                "bounds": self._bounds, "rings": rings}
+        doc = {"k": "ckpt", "t": round(wall, 3), "kinds": self._kinds,
+               "bounds": self._bounds, "rings": rings}
+        if self.meta:
+            doc["meta"] = self.meta
+        return doc
 
     def _compact(self, wall: float) -> None:
         """Rewrite the segment as one checkpoint record (tmp +
@@ -435,6 +485,12 @@ class TSDB:
     def kind(self, name: str) -> Optional[str]:
         with self._lock:
             return self._kinds.get(name)
+
+    def meta_view(self, key: str) -> Any:
+        """A copy of one durable-meta entry (ingest cursors etc.)."""
+        with self._lock:
+            v = self.meta.get(key)
+            return dict(v) if isinstance(v, dict) else v
 
     def bounds(self, name: str) -> Optional[List[float]]:
         """A histogram's bucket bounds as sampled (None until seen)."""

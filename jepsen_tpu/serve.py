@@ -348,6 +348,28 @@ class ServeConfig:
     slo_webhook: Optional[str] = field(
         default_factory=lambda: os.environ.get(
             "JTPU_SLO_WEBHOOK") or None)
+    # -- fleet federation (doc/observability.md "Fleet federation") ---------
+    #: Kill switch for the federated telemetry plane: host frame
+    #: exporters, the tsdb federator, the straggler detector, and the
+    #: /trace/find route (JTPU_FEDERATE). Off restores the PR-19
+    #: surface byte-identically (see :attr:`federate_on`).
+    federate_enabled: bool = field(
+        default_factory=lambda: os.environ.get(
+            "JTPU_FEDERATE", "1").strip().lower()
+        not in ("0", "false", "no", "off"))
+    #: Host frame-export cadence, seconds (JTPU_FED_CADENCE).
+    federate_cadence_s: float = field(
+        default_factory=lambda: _env_float("JTPU_FED_CADENCE", 1.0))
+
+    @property
+    def federate_on(self) -> bool:
+        """Whether the federation plane is constructed: needs the
+        telemetry stack AND a fleet, and JTPU_FEDERATE=0 wins at call
+        time — the same kill-switch discipline as :attr:`tsdb_on`."""
+        if os.environ.get("JTPU_FEDERATE", "").strip() == "0":
+            return False
+        return bool(self.federate_enabled) and self.tsdb_on \
+            and self.fleet_enabled
 
     @property
     def tsdb_on(self) -> bool:
@@ -719,6 +741,11 @@ class FleetPlacer:
         self._lock = threading.Lock()
         self.stats = {"gangs": 0, "rounds": 0, "remeshes": 0,
                       "host-losses": 0, "dcn-retries": 0}
+        #: straggler advisory (set by the daemon when federation is
+        #: on): consulted by the gang ladder before placing each
+        #: round's shards. None = no reordering, PR-19 behavior.
+        self.straggler = None        # guarded-by: none — set pre-start
+        self._exporters: list = []
 
     def start(self) -> None:
         from jepsen_tpu import fleet as fleet_mod
@@ -732,10 +759,30 @@ class FleetPlacer:
                     os.path.join(self.config.root, f"fleet-host-{i}"))
             h.start(None, None)
             self.hosts.append(h)
+        if self.config.federate_on \
+                and self.config.fleet_backend == "local":
+            # LocalHosts share this process's registry (the daemon's
+            # sampler already covers it), so their frames carry only
+            # the span tail — each exporter ships the segments whose
+            # host= attribute names its host
+            from jepsen_tpu.obs import federation as obs_federation
+            for i, h in enumerate(self.hosts):
+                exp = obs_federation.FrameExporter(
+                    os.path.join(self.config.root, f"fleet-host-{i}"),
+                    host=h.name, metrics=False, span_host=h.name,
+                    cadence=self.config.federate_cadence_s)
+                exp.start()
+                self._exporters.append(exp)
         log.info("fleet placer up: %d %s host(s)", n,
                  self.config.fleet_backend)
 
     def stop(self) -> None:
+        for exp in self._exporters:
+            try:
+                exp.stop()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        self._exporters = []
         for h in self.hosts:
             try:
                 h.stop()
@@ -771,7 +818,8 @@ class FleetPlacer:
                     pks, kernel, hosts, deadlines=deadlines,
                     on_round=self.on_round,
                     segment_deadline_s=self.config.fleet_deadline_s,
-                    stats=self.stats, trail=trail)
+                    stats=self.stats, trail=trail,
+                    straggler=self.straggler)
             finally:
                 remeshed = self.stats["remeshes"] - before
         if remeshed:
@@ -889,6 +937,25 @@ class CheckDaemon:
             self.breaker.on_trip = self._breaker_tripped
             if self.placer is not None:
                 self.placer.on_all_lost = self._all_hosts_lost
+        # JTPU_FEDERATE kill switch: None means no host frame
+        # exporters, no tsdb federator, no straggler gauge, no
+        # /trace/find route, and no straggler/fleet-age keys in
+        # progress or healthz — the PR-19 surface byte-identically
+        # (tests/test_federation.py asserts it)
+        self.federator = None
+        self.straggler = None
+        if self.config.federate_on and self.tsdb is not None \
+                and self.placer is not None:
+            from jepsen_tpu.obs import federation as obs_federation
+            from jepsen_tpu.obs import straggler as obs_straggler
+            self.straggler = obs_straggler.StragglerDetector()
+            self.federator = obs_federation.Federator(
+                self.config.root, self.tsdb,
+                straggler=self.straggler)
+            # federated points land BEFORE the SLO engine's evaluation
+            # on the same sampler tick
+            self.tsdb.on_tick.insert(0, self.federator.collect)
+            self.placer.straggler = self.straggler
 
     # -- flight-recorder triggers -------------------------------------------
 
@@ -2021,6 +2088,17 @@ class CheckDaemon:
                                 hosts=len(self.placer.hosts),
                                 live=self.placer.live(),
                                 backend=self.config.fleet_backend)
+            # federation bits only when the federated-telemetry plane
+            # is on: a JTPU_FEDERATE=0 daemon's healthz stays
+            # byte-identical
+            if self.federator is not None:
+                ages = self.federator.ages()
+                doc["fleet"]["last_seen_age_s"] = {
+                    h: round(a, 3) for h, a in sorted(ages.items())}
+            if self.straggler is not None:
+                flagged = self.straggler.flagged()
+                if flagged:
+                    doc["fleet"]["stragglers"] = sorted(flagged)
         if has_streams:
             doc["streams"] = self._stream_summary()
         # slo section only when the telemetry stack is on: a
@@ -2097,6 +2175,13 @@ class CheckDaemon:
                 if top is not None:
                     doc["serve"]["usage-top"] = [top[0],
                                                  round(top[1], 3)]
+            # straggler bits only when the federated-telemetry plane
+            # is on (and something is actually flagged): the PR-19
+            # progress.json stays byte-identical under JTPU_FEDERATE=0
+            if self.straggler is not None:
+                flagged = self.straggler.flagged()
+                if flagged:
+                    doc["serve"]["straggler-hosts"] = sorted(flagged)
         path = os.path.join(self.config.root, PROGRESS_NAME)
         tmp = os.path.join(self.config.root,
                            f".{PROGRESS_NAME}.tmp.{os.getpid()}")
@@ -2263,7 +2348,41 @@ def make_handler(daemon: CheckDaemon, root: str = "store"):
             # which would misparse the request id as a run directory
             token = path[len("/trace/request/"):].strip("/")
             return _trace_request(self, token)
+        # federated trace search; with JTPU_FEDERATE=0 this falls
+        # through to web.Handler's /trace/<run> 404 — route-for-route
+        # identical to the pre-federation daemon
+        if path == "/trace/find" and self.daemon.federator is not None:
+            return _trace_find(self, parse_qs(parsed.query))
         return web.Handler.do_GET(self)
+
+    def _trace_find(self, q: Dict[str, list]):
+        from jepsen_tpu.obs import federation as obs_federation
+
+        def _one(key: str) -> Optional[str]:
+            v = (q.get(key) or [None])[0]
+            return v if v else None
+
+        min_dev = _one("min-device-s") or _one("min_device_s")
+        try:
+            min_device_s = float(min_dev) if min_dev else None
+        except ValueError:
+            return _json(self, 400, {"error": "bad-request",
+                                     "detail": "min-device-s"})
+        try:
+            limit = int(_one("limit") or 50)
+        except ValueError:
+            return _json(self, 400, {"error": "bad-request",
+                                     "detail": "limit"})
+        rows = obs_federation.trace_find(
+            self.daemon.config.root,
+            tenant=_one("tenant"),
+            min_device_s=min_device_s,
+            error_class=_one("error-class") or _one("error_class"),
+            host=_one("host"),
+            limit=limit)
+        if "json" in (q.get("format") or []):
+            return _json(self, 200, {"requests": rows})
+        return self._page("trace search", web.trace_find_html(rows))
 
     def _trace_request(self, token: str):
         from jepsen_tpu.obs import fleet as obs_fleet
@@ -2282,6 +2401,7 @@ def make_handler(daemon: CheckDaemon, root: str = "store"):
     ServeHandler._authorized = _authorized
     ServeHandler.do_GET = do_GET
     ServeHandler._trace_request = _trace_request
+    ServeHandler._trace_find = _trace_find
     return ServeHandler
 
 

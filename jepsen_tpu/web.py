@@ -691,6 +691,43 @@ def flightrec_html(dumps: list) -> str:
     return "".join(rows)
 
 
+def trace_find_html(rows: list) -> str:
+    """Federated trace-search results (:func:`jepsen_tpu.obs.
+    federation.trace_find`) -> the serve daemon's ``/trace/find`` page:
+    one row per matching request, newest first, linking the stitched
+    per-request waterfall."""
+    if not rows:
+        return ("<p>No matching requests. Filters: "
+                "<code>?tenant=</code> <code>&amp;min-device-s=</code> "
+                "<code>&amp;error-class=</code> <code>&amp;host=</code> "
+                "<code>&amp;limit=</code>; add "
+                "<code>&amp;format=json</code> for the raw rows.</p>")
+    out = ["<table><tr><th>request</th><th>tenant</th><th>when</th>"
+           "<th>valid</th><th>seconds</th><th>device-s</th>"
+           "<th>hosts</th><th>error-class</th></tr>"]
+    for r in rows:
+        rid = html.escape(str(r.get("id", "")))
+        ts = r.get("ts")
+        when = time.strftime("%Y-%m-%d %H:%M:%S",
+                             time.localtime(ts)) if ts else "?"
+        dev = r.get("device-s")
+        out.append(
+            f"<tr><td><a href='/trace/request/{rid}'><code>{rid}"
+            f"</code></a></td>"
+            f"<td>{html.escape(str(r.get('tenant', '')))}</td>"
+            f"<td>{when}</td>"
+            f"<td>{html.escape(str(r.get('valid', '')))}</td>"
+            f"<td>{r.get('seconds', '')}</td>"
+            f"<td>{dev if dev is not None else ''}</td>"
+            f"<td>{html.escape(' '.join(r.get('hosts') or []))}</td>"
+            f"<td>{html.escape(str(r.get('error-class') or ''))}</td>"
+            f"</tr>")
+    out.append("</table>")
+    out.append("<p>CLI: <code>jtpu trace find --tenant T "
+               "--min-device-s S --error-class C --host H</code></p>")
+    return "".join(out)
+
+
 def serve(host: str = "127.0.0.1", port: int = 8080,
           root: str = "store",
           handler_cls: Optional[type] = None) -> ThreadingHTTPServer:

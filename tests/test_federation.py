@@ -1,0 +1,727 @@
+"""Federated fleet telemetry tests (doc/observability.md "Fleet
+federation").
+
+Covers the frame exporter's delta encoding and torn-tail discipline,
+the Federator's exactly-once durable cursors (including SIGKILL+restart
+resume and host-kill/rejoin with a fresh boot id), arrival-order
+determinism of the federated tsdb, the straggler detector's
+median-of-others scoring, federated trace search, the fleet
+metrics-merge exemplar fix, and the JTPU_FEDERATE kill-switch identity
+contract (``JTPU_FEDERATE=0`` leaves the PR-19 daemon surface — routes,
+healthz keys, progress keys, metric families, artifacts — byte
+identical).
+"""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from jepsen_tpu import journal
+from jepsen_tpu import serve as serve_ns
+from jepsen_tpu.obs import federation as fed_ns
+from jepsen_tpu.obs import fleet as obs_fleet
+from jepsen_tpu.obs import metrics as obs_metrics
+from jepsen_tpu.obs import straggler as strag_ns
+from jepsen_tpu.obs import tsdb as tsdb_ns
+
+from tests.test_serve import _daemon, _ops, _wait_done
+
+pytestmark = pytest.mark.obs
+
+
+def _clock(start=1000.0):
+    now = [float(start)]
+
+    def fn():
+        return now[0]
+
+    fn.set = lambda t: now.__setitem__(0, float(t))
+    fn.advance = lambda d: now.__setitem__(0, now[0] + d)
+    return fn
+
+
+def _db(path, clock, persist=False, registry=None):
+    db = tsdb_ns.TSDB(str(path), cadence=999.0, now_fn=clock,
+                      registry=registry or obs_metrics.Registry(),
+                      resolutions=(("1s", 1.0, 256),), persist=persist)
+    if persist:
+        db.start()
+    return db
+
+
+def _exporter(root, host, clock, registry=None, **kw):
+    d = os.path.join(str(root), host)
+    return fed_ns.FrameExporter(d, registry=registry, cadence=999.0,
+                                now_fn=clock, **kw)
+
+
+# ---------------------------------------------------------------------------
+# The frame exporter
+# ---------------------------------------------------------------------------
+
+
+class TestFrameExporter:
+    def test_counter_deltas_and_one_shot_bounds(self, tmp_path):
+        reg = obs_metrics.Registry()
+        c = reg.counter("jobs_total")
+        g = reg.gauge("depth")
+        h = reg.histogram("lat_s", buckets=(0.1, 1.0))
+        clock = _clock(100.0)
+        ex = _exporter(tmp_path, "fleet-host-0", clock, registry=reg)
+        c.inc(3)
+        g.set(7)
+        h.observe(0.05)
+        f1 = ex.export_once()
+        assert f1["host"] == "fleet-host-0" and f1["seq"] == 1
+        assert f1["c"]["jobs_total"][""] == 3.0
+        assert f1["g"]["depth"][""] == 7.0
+        assert f1["h"]["lat_s"][""][0] == 1      # count delta
+        assert f1["hb"]["lat_s"] == [0.1, 1.0]   # bounds, first frame
+        # no movement: the frame is empty but still written (liveness)
+        clock.advance(1.0)
+        f2 = ex.export_once()
+        assert f2["seq"] == 2 and f2["b"] == f1["b"]
+        assert "c" not in f2 and "h" not in f2 and "hb" not in f2
+        # movement again: delta only, bounds never re-ship this boot
+        c.inc(2)
+        h.observe(0.5)
+        f3 = ex.export_once()
+        assert f3["c"]["jobs_total"][""] == 2.0
+        assert f3["h"]["lat_s"][""][0] == 1
+        assert "hb" not in f3
+        ex.stop()
+        frames = fed_ns.read_frames(ex.host_dir)
+        assert [f["seq"] for f in frames] == [1, 2, 3, 4]
+
+    def test_torn_tail_is_skipped(self, tmp_path):
+        reg = obs_metrics.Registry()
+        clock = _clock()
+        ex = _exporter(tmp_path, "fleet-host-0", clock, registry=reg)
+        for _ in range(3):
+            ex.export_once()
+        ex.stop()   # writes a 4th flush frame
+        with open(ex.path, "ab") as f:
+            f.write(b"\x01\x02torn-mid-append")
+        frames = fed_ns.read_frames(ex.host_dir)
+        assert [f["seq"] for f in frames] == [1, 2, 3, 4]
+
+    def test_missing_file_reads_empty(self, tmp_path):
+        assert fed_ns.read_frames(str(tmp_path / "nowhere")) == []
+
+    def test_compaction_keeps_newest_frames(self, tmp_path,
+                                            monkeypatch):
+        monkeypatch.setattr(fed_ns, "FRAMES_COMPACT", 5)
+        monkeypatch.setattr(fed_ns, "FRAMES_KEEP", 3)
+        reg = obs_metrics.Registry()
+        clock = _clock()
+        ex = _exporter(tmp_path, "fleet-host-0", clock, registry=reg)
+        for _ in range(8):
+            ex.export_once()
+        ex.stop()
+        frames = fed_ns.read_frames(ex.host_dir)
+        assert len(frames) <= 5
+        assert frames[-1]["seq"] == 9    # stop()'s flush frame
+        assert frames == sorted(frames, key=lambda f: f["seq"])
+
+
+# ---------------------------------------------------------------------------
+# The federator: cursors, determinism, staleness, rejoin
+# ---------------------------------------------------------------------------
+
+
+def _two_hosts(tmp_path, clock):
+    """Two host dirs with distinct counter movement, 2 frames each."""
+    exs = []
+    for i, n in ((0, 3), (1, 5)):
+        reg = obs_metrics.Registry()
+        c = reg.counter("jobs_total")
+        ex = _exporter(tmp_path, f"fleet-host-{i}", clock, registry=reg)
+        c.inc(n)
+        ex.export_once()
+        clock.advance(1.0)
+        c.inc(1)
+        ex.export_once()
+        ex.stop()
+        exs.append(ex)
+    return exs
+
+
+class TestFederator:
+    def test_host_labeled_series_land_in_one_tsdb(self, tmp_path):
+        clock = _clock(100.0)
+        _two_hosts(tmp_path, clock)
+        db = _db(tmp_path / "db", clock)
+        fed = fed_ns.Federator(str(tmp_path), db)
+        n = fed.collect(clock())
+        assert n == 6    # 2 data + 1 stop-flush frame per host
+        assert db.window_delta("jobs_total", 3600.0, now=clock(),
+                               host="fleet-host-0") == 4.0
+        assert db.window_delta("jobs_total", 3600.0, now=clock(),
+                               host="fleet-host-1") == 6.0
+        assert db.window_delta("jobs_total", 3600.0,
+                               now=clock()) == 10.0  # fleet-wide sum
+        assert db.kind("jobs_total") == "counter"
+        assert fed.hosts() == ["fleet-host-0", "fleet-host-1"]
+
+    def test_cursor_is_exactly_once(self, tmp_path):
+        clock = _clock(100.0)
+        _two_hosts(tmp_path, clock)
+        db = _db(tmp_path / "db", clock)
+        fed = fed_ns.Federator(str(tmp_path), db)
+        assert fed.collect(clock()) == 6
+        assert fed.collect(clock()) == 0     # nothing new
+        assert db.window_delta("jobs_total", 3600.0,
+                               now=clock()) == 10.0  # not doubled
+        # new movement on one host ingests only the new frame
+        reg = obs_metrics.Registry()
+        reg.counter("jobs_total").inc(2)
+        ex = fed_ns.FrameExporter(
+            str(tmp_path / "fleet-host-0"), registry=reg,
+            cadence=999.0, now_fn=clock)
+        ex.export_once()
+        ex.stop()
+        assert fed.collect(clock()) == 2     # data + flush frame
+        assert db.window_delta("jobs_total", 3600.0,
+                               now=clock()) == 12.0
+
+    def test_sigkill_restart_resumes_exact_prefix(self, tmp_path):
+        """The acceptance criterion: reopen the tsdb from disk (as a
+        restarted daemon does), and the federated history AND the
+        ingest cursors are the pre-kill prefix — a fresh Federator
+        re-ingests nothing."""
+        clock = _clock(100.0)
+        _two_hosts(tmp_path, clock)
+        db1 = _db(tmp_path / "db", clock, persist=True)
+        fed1 = fed_ns.Federator(str(tmp_path), db1)
+        assert fed1.collect(clock()) == 6
+        cursors = db1.meta_view("fed")
+        rings = db1._rings
+        # no clean stop: the writer's file is already durable per
+        # append (the SIGKILL story)
+        db2 = _db(tmp_path / "db", clock, persist=True)
+        assert db2.meta_view("fed") == cursors
+        assert db2._rings == rings
+        fed2 = fed_ns.Federator(str(tmp_path), db2)
+        assert fed2.collect(clock()) == 0
+        assert db2.window_delta("jobs_total", 3600.0,
+                                now=clock()) == 10.0
+
+    def test_arrival_order_determinism(self, tmp_path):
+        """Ingesting the same frames in any cross-host arrival order
+        produces an identical store (per-host order is fixed by seq;
+        hosts are independent series)."""
+        clock = _clock(100.0)
+        _two_hosts(tmp_path, clock)
+        now = clock()
+        frames = {d: fed_ns.read_frames(os.path.join(str(tmp_path), d))
+                  for d in ("fleet-host-0", "fleet-host-1")}
+
+        def ingest(host_order):
+            db = _db(tmp_path / f"db-{host_order[0]}", clock)
+            fed = fed_ns.Federator(str(tmp_path), db)
+            for d in host_order:
+                for rec in frames[d]:
+                    fed._ingest(rec["host"], rec, rec["b"],
+                                rec["seq"], now)
+            return db
+
+        db_a = ingest(("fleet-host-0", "fleet-host-1"))
+        db_b = ingest(("fleet-host-1", "fleet-host-0"))
+        assert db_a._rings == db_b._rings
+        assert db_a.meta_view("fed") == db_b.meta_view("fed")
+
+    def test_torn_and_vanished_hosts_never_raise(self, tmp_path):
+        clock = _clock()
+        d = tmp_path / "fleet-host-0"
+        d.mkdir()
+        (d / fed_ns.FRAMES_NAME).write_bytes(b"\x00garbage only")
+        db = _db(tmp_path / "db", clock)
+        fed = fed_ns.Federator(str(tmp_path), db)
+        assert fed.collect(clock()) == 0
+        # the host dir vanishing between passes is also fine
+        (d / fed_ns.FRAMES_NAME).unlink()
+        d.rmdir()
+        assert fed.collect(clock()) == 0
+
+    def test_host_kill_goes_stale_then_rejoin_resumes(self, tmp_path):
+        """A dead host's series go stale (age grows, nothing breaks);
+        a rejoin with a fresh boot id resumes ingestion even though
+        its seq restarts at 1."""
+        clock = _clock(100.0)
+        reg = obs_metrics.Registry()
+        reg.counter("jobs_total").inc(3)
+        ex = _exporter(tmp_path, "fleet-host-0", clock, registry=reg)
+        ex.export_once()
+        ex.stop()
+        db = _db(tmp_path / "db", clock)
+        fed = fed_ns.Federator(str(tmp_path), db)
+        assert fed.collect(clock()) == 2
+        # host dies: nothing new, its age just grows
+        clock.advance(30.0)
+        assert fed.collect(clock()) == 0
+        assert fed.ages(clock())["fleet-host-0"] >= 29.0
+        # rejoin: clock moved forward -> strictly larger boot id, seq
+        # restarts at 1 -- the cursor orders by (boot, seq)
+        os.unlink(ex.path)
+        reg2 = obs_metrics.Registry()
+        reg2.counter("jobs_total").inc(4)
+        ex2 = _exporter(tmp_path, "fleet-host-0", clock, registry=reg2)
+        assert ex2.boot > ex.boot
+        ex2.export_once()
+        ex2.stop()
+        assert fed.collect(clock()) == 2
+        assert db.window_delta("jobs_total", 3600.0, now=clock(),
+                               host="fleet-host-0") == 7.0
+        assert fed.ages(clock())["fleet-host-0"] == 0.0
+
+    def test_compile_phase_spans_skip_the_straggler_feed(self, tmp_path):
+        """Every host pays XLA compilation whenever a new shape appears
+        mid-run, at wildly varying scale — a compile-phase segment span
+        must never be scored as skew (only the detector's own
+        first-sample discard covers phase-less producers)."""
+        clock = _clock(100.0)
+        db = _db(tmp_path / "db", clock)
+        det = strag_ns.StragglerDetector(sigma=2.0)
+        fed = fed_ns.Federator(str(tmp_path), db, straggler=det)
+        now = clock()
+
+        def frame(host, seq, spans):
+            return {"k": "frame", "host": host, "b": 1, "seq": seq,
+                    "t": now, "spans": spans}
+
+        def seg(host, dur_s, phase=None):
+            sp = {"name": "checker.segment", "ts": 1,
+                  "dur": int(dur_s * 1e9), "host": host}
+            if phase is not None:
+                sp["phase"] = phase
+            return sp
+
+        # warm both hosts past the first-sample discard
+        for host in ("h0", "h1"):
+            fed._ingest(host, frame(host, 1, [seg(host, 0.02,
+                                                  "execute")]), 1, 1, now)
+        for i in range(2, 5):
+            fed._ingest("h0", frame("h0", i, [seg("h0", 0.02,
+                                                  "execute")]), 1, i, now)
+            fed._ingest("h1", frame("h1", i, [seg("h1", 0.02,
+                                                  "execute")]), 1, i, now)
+        assert det.flagged() == set()
+        # a 2 s mid-run recompile on h1 alone: phase="compile" is
+        # excluded, so h1 stays unflagged...
+        fed._ingest("h1", frame("h1", 5, [seg("h1", 2.0, "compile")]),
+                    1, 5, now)
+        assert det.flagged() == set()
+        # ...whereas the same span at execute phase IS real skew
+        fed._ingest("h1", frame("h1", 6, [seg("h1", 2.0, "execute")]),
+                    1, 6, now)
+        fed._ingest("h1", frame("h1", 7, [seg("h1", 2.0, "execute")]),
+                    1, 7, now)
+        assert det.flagged() == {"h1"}
+
+    def test_fleet_ages_stateless_reader(self, tmp_path):
+        clock = _clock(100.0)
+        _two_hosts(tmp_path, clock)
+        ages = fed_ns.fleet_ages(str(tmp_path), now=clock() + 5.0)
+        assert set(ages) == {"fleet-host-0", "fleet-host-1"}
+        assert all(a >= 5.0 for a in ages.values())
+
+
+# ---------------------------------------------------------------------------
+# The straggler detector
+# ---------------------------------------------------------------------------
+
+
+class TestStraggler:
+    def test_median_of_others_flags_the_slow_host(self):
+        det = strag_ns.StragglerDetector(sigma=2.0)
+        for _ in range(3):
+            det.observe_segment("h0", 1.0)
+            det.observe_segment("h1", 1.0)
+            det.observe_segment("h2", 5.0)
+        scores = det.scores()
+        assert scores["h2"] >= 4.0      # vs median(1.0, 1.0), not
+        assert scores["h0"] <= 1.1      # the h2-diluted fleet median
+        assert det.flagged() == {"h2"}
+        assert det.poll_new() == {"h2"}
+        assert det.poll_new() == set()  # announced exactly once
+
+    def test_two_host_fleet_stays_sharp(self):
+        """With two hosts the fleet median would dilute a 5x straggler
+        to ~1.7x; the median of the OTHER host keeps the ratio."""
+        det = strag_ns.StragglerDetector(sigma=2.0)
+        for _ in range(3):
+            det.observe_segment("h0", 1.0)
+            det.observe_segment("h1", 5.0)
+        assert det.scores()["h1"] >= 4.0
+        assert det.flagged() == {"h1"}
+
+    def test_min_samples_gate(self):
+        det = strag_ns.StragglerDetector(sigma=2.0)
+        det.observe_segment("h0", 1.0)   # cold-compile: discarded
+        det.observe_segment("h1", 50.0)
+        det.observe_segment("h0", 1.0)
+        det.observe_segment("h1", 50.0)
+        assert det.flagged() == set()   # one counted segment is not
+        det.observe_segment("h0", 1.0)  # worth a re-deal
+        det.observe_segment("h1", 50.0)
+        assert det.flagged() == {"h1"}
+
+    def test_first_segment_sample_is_discarded_as_cold_compile(self):
+        """Seeding the EWMA with the cold-jit first segment would bury
+        real runtime skew under compile time for rounds."""
+        det = strag_ns.StragglerDetector(sigma=2.0)
+        det.observe_segment("h0", 60.0)  # both hosts pay a cold jit
+        det.observe_segment("h1", 62.0)
+        for _ in range(2):
+            det.observe_segment("h0", 0.02)
+            det.observe_segment("h1", 2.0)
+        assert det.scores()["h1"] >= 2.0
+        assert det.flagged() == {"h1"}
+
+    def test_prefer_is_stable_unflagged_first(self):
+        class H:
+            def __init__(self, name):
+                self.name = name
+                self.dir = None
+
+        det = strag_ns.StragglerDetector(sigma=2.0)
+        for _ in range(3):
+            det.observe_segment("a", 9.0)
+            det.observe_segment("b", 1.0)
+            det.observe_segment("c", 1.0)
+        a, b, c = H("a"), H("b"), H("c")
+        assert det.prefer([a, b, c]) == [b, c, a]
+        assert det.prefer([c, a, b]) == [c, b, a]
+
+    def test_forget_clears_and_rearms_announcement(self):
+        det = strag_ns.StragglerDetector(sigma=2.0)
+        for _ in range(3):
+            det.observe_segment("h0", 1.0)
+            det.observe_segment("h1", 9.0)
+        assert det.poll_new() == {"h1"}
+        det.forget("h1")
+        assert det.flagged() == set()
+        for _ in range(3):
+            det.observe_segment("h1", 9.0)
+        assert det.poll_new() == {"h1"}  # relapse announces again
+
+    def test_heartbeat_age_is_a_signal_too(self):
+        det = strag_ns.StragglerDetector(sigma=2.0)
+        for _ in range(3):
+            det.observe_segment("h0", 1.0)
+            det.observe_segment("h1", 1.0)
+            det.observe_heartbeat("h0", 0.5)
+            det.observe_heartbeat("h1", 30.0)
+        assert det.scores()["h1"] >= 2.0
+        assert det.flagged() == {"h1"}
+
+    def test_sigma_env(self, monkeypatch):
+        monkeypatch.setenv("JTPU_STRAGGLER_SIGMA", "4.5")
+        assert strag_ns.sigma_from_env() == 4.5
+        monkeypatch.setenv("JTPU_STRAGGLER_SIGMA", "bogus")
+        assert strag_ns.sigma_from_env() == strag_ns.DEFAULT_SIGMA
+
+    def test_host_key_prefers_dir_basename(self, tmp_path):
+        class H:
+            name = "host-0"
+            dir = str(tmp_path / "fleet-host-0")
+
+        class L:
+            name = "host-1"
+            dir = None
+
+        assert strag_ns.host_key(H()) == "fleet-host-0"
+        assert strag_ns.host_key(L()) == "host-1"
+
+    def test_score_gauge_is_published(self):
+        det = strag_ns.StragglerDetector(sigma=2.0)
+        for _ in range(2):
+            det.observe_segment("h0", 1.0)
+            det.observe_segment("h1", 4.0)
+        snap = obs_metrics.REGISTRY.snapshot()
+        series = snap["jtpu_fleet_straggler_score"]["series"]
+        assert any("h1" in k for k in series)
+
+
+# ---------------------------------------------------------------------------
+# Trace search
+# ---------------------------------------------------------------------------
+
+
+def _serve_fixture(tmp_path):
+    """A synthetic dead serve dir: WAL + one result file + one host's
+    span frames."""
+    root = tmp_path / "serve"
+    root.mkdir()
+    t1, t2, t3 = "aa" * 16, "bb" * 16, "cc" * 16
+    w = journal.JsonRecordWriter(str(root / "serve.wal"))
+    rows = [("r1", "ten-a", t1, 10.0, 2.5, "True"),
+            ("r2", "ten-b", t2, 11.0, 0.1, "True"),
+            ("r3", "ten-a", t3, 12.0, 0.4, "unknown")]
+    for rid, tenant, tid, ts, dev, valid in rows:
+        w.append({"event": "accepted", "id": rid, "tenant": tenant,
+                  "ts": ts, "trace": tid})
+        w.append({"event": "done", "id": rid, "valid": valid,
+                  "seconds": 0.2, "tenant": tenant,
+                  "usage": {"ops": 4, "device-s": dev}})
+    w.close()
+    (root / "r3.json").write_text(json.dumps(
+        {"valid": "unknown", "error-class": "oom"}))
+    hd = root / "fleet-host-0"
+    hd.mkdir()
+    hw = journal.JsonRecordWriter(str(hd / fed_ns.FRAMES_NAME))
+    hw.append({"k": "frame", "host": "fleet-host-0", "b": 1, "seq": 1,
+               "t": 10.5, "spans": [
+                   {"name": "checker.segment", "ts": 1, "dur": 5,
+                    "trace": t1, "host": "fleet-host-0"}]})
+    hw.close()
+    return str(root), (t1, t2, t3)
+
+
+class TestTraceFind:
+    def test_filters_compose_over_wal_and_frames(self, tmp_path):
+        root, (t1, _t2, _t3) = _serve_fixture(tmp_path)
+        rows = fed_ns.trace_find(root)
+        assert [r["id"] for r in rows] == ["r3", "r2", "r1"]  # newest
+        assert rows[0]["error-class"] == "oom"  # backfilled lazily
+        rows = fed_ns.trace_find(root, tenant="ten-a")
+        assert [r["id"] for r in rows] == ["r3", "r1"]
+        rows = fed_ns.trace_find(root, min_device_s=1.0)
+        assert [r["id"] for r in rows] == ["r1"]
+        assert rows[0]["device-s"] == 2.5
+        rows = fed_ns.trace_find(root, host="fleet-host-0")
+        assert [r["id"] for r in rows] == ["r1"]
+        assert rows[0]["hosts"] == ["fleet-host-0"]
+        assert rows[0]["trace"] == t1
+        rows = fed_ns.trace_find(root, error_class="oom")
+        assert [r["id"] for r in rows] == ["r3"]
+        rows = fed_ns.trace_find(root, tenant="ten-a", limit=1)
+        assert [r["id"] for r in rows] == ["r3"]
+        assert fed_ns.trace_find(root, tenant="nobody") == []
+
+    def test_missing_wal_is_empty_not_an_error(self, tmp_path):
+        assert fed_ns.trace_find(str(tmp_path)) == []
+
+
+# ---------------------------------------------------------------------------
+# Satellite: fleet metrics merge keeps histogram exemplars
+# ---------------------------------------------------------------------------
+
+
+class TestMergeExemplars:
+    def test_fleet_aggregate_keeps_exemplars(self):
+        def host(name, count, trace, idx="1"):
+            return {"host": name, "metrics": {"lat_s": {
+                "kind": "histogram", "help": "",
+                "series": {"": {
+                    "buckets": [count, 1], "bounds": [0.1, 1.0],
+                    "count": count + 1, "sum": 0.5 * count,
+                    "exemplars": {idx: {"trace": trace, "v": 0.4}},
+                }}}}}
+
+        merged = obs_fleet.merge_metrics(
+            [host("h0", 4, "aa" * 16), host("h1", 6, "bb" * 16)])
+        agg = merged["lat_s"]["fleet"][""]
+        assert agg["buckets"] == [10, 2]
+        assert agg["count"] == 12
+        assert agg["sum"] == pytest.approx(5.0)
+        # the fix: exemplars survive the merge (LWW per bucket index)
+        assert agg["exemplars"]["1"]["trace"] == "bb" * 16
+        # int keys (in-process snapshots) fold onto the str key too
+        merged2 = obs_fleet.merge_metrics(
+            [host("h0", 4, "aa" * 16), host("h1", 6, "bb" * 16, idx=1)])
+        assert merged2["lat_s"]["fleet"][""]["exemplars"]["1"][
+            "trace"] == "bb" * 16
+
+
+# ---------------------------------------------------------------------------
+# The daemon wiring + the JTPU_FEDERATE kill-switch identity
+# ---------------------------------------------------------------------------
+
+
+def _fleet_cfg(tmp_path, **cfg):
+    cfg.setdefault("root", str(tmp_path / "serve"))
+    cfg.setdefault("backend", "tpu")
+    cfg.setdefault("fleet_hosts", 2)
+    cfg.setdefault("fleet_backend", "local")
+    cfg.setdefault("batch_wait_ms", 150.0)
+    cfg.setdefault("workers", 1)
+    cfg.setdefault("tsdb_cadence_s", 0.05)
+    cfg.setdefault("federate_cadence_s", 0.05)
+    return serve_ns.ServeConfig(**cfg)
+
+
+class TestServeFederation:
+    def test_live_federation_over_local_fleet(self, tmp_path):
+        """The daemon constructs the plane, the placer's exporters
+        produce frames, the federator sees both hosts live, healthz
+        grows per-host ages, and /trace/find resolves a request by
+        tenant."""
+        cfg = _fleet_cfg(tmp_path)
+        assert cfg.federate_on
+        daemon, server = serve_ns.run_daemon(cfg, host="127.0.0.1",
+                                             port=0)
+        port = server.server_port
+        try:
+            assert daemon.federator is not None
+            assert daemon.straggler is not None
+            assert daemon.placer.straggler is daemon.straggler
+            assert len(daemon.placer._exporters) == 2
+            code, body, _ = daemon.submit({"tenant": "ten-x",
+                                           "model": "cas-register",
+                                           "history": _ops()})
+            assert code == 202
+            _wait_done(daemon, body["id"])
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                if len(daemon.federator.hosts()) == 2:
+                    break
+                time.sleep(0.05)
+            # local-backend frames carry the host NAME (matching the
+            # span host= attribute); the dirs are fleet-host-N
+            assert daemon.federator.hosts() == ["host-0", "host-1"]
+            hz = daemon.healthz()
+            ages = hz["fleet"]["last_seen_age_s"]
+            assert set(ages) == {"host-0", "host-1"}
+            assert all(a < 60.0 for a in ages.values())
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/trace/find"
+                    f"?tenant=ten-x&format=json", timeout=10) as r:
+                doc = json.loads(r.read())
+            assert [row["id"] for row in doc["requests"]] == [body["id"]]
+            # the html page renders too
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/trace/find?tenant=ten-x",
+                    timeout=10) as r:
+                page = r.read().decode()
+            assert body["id"] in page
+        finally:
+            server.shutdown()
+            daemon.stop()
+        # frames exist under both host dirs
+        for i in (0, 1):
+            assert os.path.exists(os.path.join(
+                cfg.root, f"fleet-host-{i}", fed_ns.FRAMES_NAME))
+
+    def test_kill_switch_leaves_pr19_surface_identical(self, tmp_path,
+                                                       monkeypatch):
+        """JTPU_FEDERATE=0: no federator/straggler/exporters, no new
+        healthz or progress keys, no frame artifacts, no new metric
+        families, and /trace/find 404s."""
+        monkeypatch.setenv("JTPU_FEDERATE", "0")
+        cfg = _fleet_cfg(tmp_path)
+        assert cfg.federate_on is False   # env wins over the field
+        families_before = {
+            ln for ln in obs_metrics.REGISTRY.to_prometheus()
+            .splitlines() if ln.startswith("# TYPE ")}
+        daemon, server = serve_ns.run_daemon(cfg, host="127.0.0.1",
+                                             port=0)
+        port = server.server_port
+        try:
+            assert daemon.federator is None
+            assert daemon.straggler is None
+            assert daemon.placer is not None
+            assert daemon.placer.straggler is None
+            assert daemon.placer._exporters == []
+            code, body, _ = daemon.submit({"model": "cas-register",
+                                           "history": _ops()})
+            assert code == 202
+            _wait_done(daemon, body["id"])
+            hz = daemon.healthz()
+            assert "last_seen_age_s" not in hz["fleet"]
+            assert "stragglers" not in hz["fleet"]
+            daemon._publish(force=True)
+            with open(os.path.join(cfg.root,
+                                   serve_ns.PROGRESS_NAME)) as f:
+                prog = json.load(f)
+            assert "straggler-hosts" not in prog["serve"]
+            families_after = {
+                ln for ln in obs_metrics.REGISTRY.to_prometheus()
+                .splitlines() if ln.startswith("# TYPE ")}
+            assert families_after == families_before
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/trace/find?format=json",
+                    timeout=10)
+            assert ei.value.code == 404
+        finally:
+            server.shutdown()
+            daemon.stop()
+        # no frame artifacts anywhere under the serve root
+        for i in (0, 1):
+            assert not os.path.exists(os.path.join(
+                cfg.root, f"fleet-host-{i}", fed_ns.FRAMES_NAME))
+
+    def test_federate_needs_tsdb_and_fleet(self, tmp_path):
+        """No fleet, or no tsdb -> no federation plane (it rides the
+        tsdb sampler and the host-dir seam; without either it has no
+        transport)."""
+        d = _daemon(tmp_path)      # tsdb on, no fleet
+        assert d.config.federate_on is False
+        assert d.federator is None and d.straggler is None
+        d.stop()
+        cfg = _fleet_cfg(tmp_path, root=str(tmp_path / "serve2"),
+                         tsdb_enabled=False)
+        assert cfg.federate_on is False
+        d2 = serve_ns.CheckDaemon(cfg)
+        assert d2.federator is None and d2.straggler is None
+        d2.stop()
+
+
+class TestTopAndWatchSurface:
+    def test_watch_line_grows_straggler_bit(self):
+        from jepsen_tpu.obs import observatory
+        p = {"state": "serving",
+             "serve": {"queue-depth": 1, "inflight": 0, "completed": 2,
+                       "rejected": 0,
+                       "straggler-hosts": ["fleet-host-1"]}}
+        line = observatory.format_status(p)
+        assert "straggler fleet-host-1" in line
+
+    def test_top_cmd_renders_one_screen(self, tmp_path, capsys):
+        from jepsen_tpu import cli
+        root = tmp_path / "serve"
+        root.mkdir()
+        (root / "progress.json").write_text(json.dumps({
+            "state": "serving", "ts": 1.0,
+            "serve": {"queue-depth": 2, "inflight": 1, "completed": 5,
+                      "rejected": 0, "fleet-hosts": 2, "fleet-live": 2,
+                      "slo": {"breached": 0, "max-burn": 0.2},
+                      "usage-top": ["ten-a", 3.25],
+                      "straggler-hosts": ["fleet-host-1"]}}))
+        hd = root / "fleet-host-0"
+        hd.mkdir()
+        w = journal.JsonRecordWriter(str(hd / fed_ns.FRAMES_NAME))
+        w.append({"k": "frame", "host": "fleet-host-0", "b": 1,
+                  "seq": 1, "t": time.time()})
+        w.close()
+        rc = cli.run(cli.default_commands(),
+                     ["top", "--store", str(root), "--once"])
+        out = capsys.readouterr().out
+        assert rc == cli.OK
+        assert "queue 2" in out
+        assert "slo OK (0.2)" in out
+        assert "top tenant ten-a: 3.25 device-s" in out
+        assert "fleet 2/2 host(s)" in out
+        assert "fleet-host-0" in out
+        assert "STRAGGLER" in out and "fleet-host-1" in out
+
+    def test_trace_find_cli(self, tmp_path, capsys):
+        from jepsen_tpu import cli
+        root, _tids = _serve_fixture(tmp_path)
+        rc = cli.run(cli.default_commands(),
+                     ["trace", "find", "--store", root,
+                      "--tenant", "ten-a", "--format", "json"])
+        out = capsys.readouterr().out
+        assert rc == cli.OK
+        doc = json.loads(out)
+        assert [r["id"] for r in doc["requests"]] == ["r3", "r1"]
+        rc = cli.run(cli.default_commands(),
+                     ["trace", "find", "--store", root,
+                      "--min-device-s", "1.0"])
+        out = capsys.readouterr().out
+        assert rc == cli.OK and "r1" in out and "r2" not in out

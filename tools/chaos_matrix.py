@@ -54,6 +54,16 @@ qualify a new accelerator image before trusting it with long runs):
                    (remesh-to-1-hosts trail event), finishes the
                    search, and the verdict matches the single-host
                    baseline AND the CPU oracle
+  straggler-host   deliberately slow ONE worker of a 2-process
+                   elastic-fleet search (JTPU_CHAOS_SLOW_HOST stalls
+                   it before every shard segment): the straggler
+                   observatory flags exactly that host within 3 merge
+                   rounds in which it ran a segment
+                   (straggler-flagged trail event), the flag
+                   forces a steal-rebalance re-deal, the verdict
+                   matches the single-host baseline and the CPU
+                   oracle, and `jtpu trace find --host` attributes a
+                   served burst's requests to the slowed worker
   serve-kill       SIGKILL the check daemon (`jtpu serve`) with one
                    request in-flight and one queued: a restarted
                    daemon replays its request journal (serve.wal),
@@ -979,6 +989,164 @@ def scenario_fleet_host_kill(seed):
         ok = False
         details.append(f"hosts-lost={lost}, want 1")
     return ok, "; ".join(details)
+
+
+def scenario_straggler_host(seed):
+    """Deliberately slow ONE worker of a 2-process elastic-fleet search
+    (``JTPU_CHAOS_SLOW_HOST`` stalls it before every shard segment —
+    verdict-neutral added latency). The straggler observatory must flag
+    exactly that host — and only it — within 3 merge rounds in which
+    it actually ran a segment (an empty contiguous shard is not
+    dispatched, and an idle host cannot be observed;
+    ``straggler-flagged`` trail event), the flag must force a
+    ``steal-rebalance`` re-deal without waiting out the row-imbalance
+    streak, and the verdict must match the single-host baseline and the
+    CPU oracle. A second serve-side leg drives a burst through a
+    fleet-backed daemon with the same slowed worker and proves trace
+    search (``jtpu trace find --host``) resolves the requests that ran
+    on it, with every verdict offline-identical
+    (doc/observability.md, "Fleet federation")."""
+    import tempfile
+    import urllib.request
+
+    from jepsen_tpu import fleet
+    from jepsen_tpu import serve as serve_ns
+    from jepsen_tpu.checker import check_safe
+    from jepsen_tpu.checker.wgl import linearizable
+    from jepsen_tpu.history import History
+    from jepsen_tpu.obs import federation as obs_federation
+
+    p, kernel = _packed(seed)
+    base = supervised_check_packed(p, kernel, segment_iters=1)
+    oracle = check_packed(p, kernel)
+    if base["valid"] != oracle["valid"]:
+        return False, "single-host baseline disagrees with the oracle"
+    details = []
+
+    # leg 1: the elastic-fleet search — flag within 3 rounds, steal
+    d = tempfile.mkdtemp(prefix="jtpu-straggler-")
+    hosts = [fleet.ProcHost("w0", os.path.join(d, "w0")),
+             fleet.ProcHost("w1", os.path.join(d, "w1"))]
+    os.environ["JTPU_CHAOS_SLOW_HOST"] = "w1:2.0"
+    try:
+        out = fleet.check_packed_fleet(p, kernel, hosts=hosts,
+                                       segment_iters=1)
+    finally:
+        os.environ.pop("JTPU_CHAOS_SLOW_HOST", None)
+    if out.get("valid") != base["valid"]:
+        return False, (f"verdict {out.get('valid')!r} != baseline "
+                       f"{base['valid']!r}")
+    details.append(f"verdict {out['valid']} == single-host baseline "
+                   f"== oracle")
+    evs = out.get("attempts", [])
+    flags = [e for e in evs if e.get("event") == "straggler-flagged"]
+    flagged_hosts = {e.get("host") for e in flags}
+    if "w1" not in flagged_hosts:
+        return False, (f"slowed host w1 never flagged (events "
+                       f"{[e.get('event') for e in evs]})")
+    if flagged_hosts != {"w1"}:
+        return False, (f"flagged {sorted(flagged_hosts)}, want the "
+                       f"slowed host only")
+    # the 3-round flag budget counts rounds the straggler actually
+    # RAN: a shard whose contiguous slice holds no live rows is not
+    # dispatched at all, and an idle host cannot be observed — its
+    # dispatched rounds are in its own segment spans
+    w1_rounds = []
+    try:
+        with open(os.path.join(d, "w1", "trace.jsonl"),
+                  errors="replace") as f:
+            for line in f:
+                try:
+                    sp = json.loads(line)
+                except ValueError:
+                    continue
+                if sp.get("name") == "checker.segment" \
+                        and sp.get("round") is not None:
+                    w1_rounds.append(int(sp["round"]))
+    except OSError:
+        pass
+    w1_rounds = sorted(set(w1_rounds))
+    if len(w1_rounds) < 3:
+        return False, (f"w1 ran only {len(w1_rounds)} segment "
+                       f"round(s) — too few to flag")
+    first = min(e.get("round", 10 ** 9) for e in flags)
+    if first > w1_rounds[2]:
+        return False, (f"w1 flagged at round {first}, want by its 3rd "
+                       f"dispatched segment (rounds {w1_rounds[:4]})")
+    nth = w1_rounds.index(first) + 1 if first in w1_rounds else "?"
+    details.append(f"w1 (and only w1) flagged at round {first} — "
+                   f"dispatched segment #{nth} of its "
+                   f"{len(w1_rounds)}")
+    if not any(e.get("outcome") == "steal-rebalance" for e in evs):
+        return False, (f"no steal-rebalance after the flag "
+                       f"(events {[e.get('event') for e in evs]})")
+    details.append("flag forced a steal-rebalance re-deal")
+
+    # leg 2: the serve plane — trace search attributes the burst's
+    # requests to the slowed worker, verdicts stay offline-identical
+    root = tempfile.mkdtemp(prefix="jepsen-chaos-straggler-")
+    all_ops = [[o.to_dict() for o in
+                simulate_register_history(40, n_procs=3, n_vals=3,
+                                          seed=seed + i)]
+               for i in range(3)]
+    offline = [check_safe(linearizable(CASRegister(), backend="tpu"),
+                          {"name": "chaos-straggler-offline"},
+                          History.of(o)) for o in all_ops]
+    os.environ["JTPU_SEGMENT_ITERS"] = "2"
+    os.environ["JTPU_CHAOS_SLOW_HOST"] = "fleet-host-1:0.15"
+    cfg = serve_ns.ServeConfig(root=os.path.join(root, "serve"),
+                               backend="tpu", workers=1,
+                               batch_max=8, batch_wait_ms=1000.0,
+                               fleet_hosts=2, fleet_backend="proc")
+    daemon, server = serve_ns.run_daemon(
+        cfg, host="127.0.0.1", port=0, store_root=root)
+    port = server.server_port
+    try:
+        rids = []
+        for i, o in enumerate(all_ops):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/check",
+                data=json.dumps({"tenant": "abc"[i],
+                                 "model": "cas-register",
+                                 "history": o}).encode(),
+                method="POST")
+            with urllib.request.urlopen(req, timeout=10) as r:
+                rids.append(json.load(r)["id"])
+        deadline = time.time() + 120
+        docs = {}
+        while time.time() < deadline and len(docs) < len(rids):
+            for rid in rids:
+                if rid in docs:
+                    continue
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/check/{rid}",
+                        timeout=10) as r:
+                    doc = json.load(r)
+                if doc.get("state") == "done":
+                    docs[rid] = doc
+            time.sleep(0.05)
+        if len(docs) != len(rids):
+            return False, (f"only {len(docs)}/{len(rids)} serve "
+                           f"requests finished")
+        for i, rid in enumerate(rids):
+            got = docs[rid]["result"].get("valid")
+            if got != offline[i].get("valid"):
+                return False, (f"served verdict {got!r} != offline "
+                               f"{offline[i].get('valid')!r}")
+    finally:
+        os.environ.pop("JTPU_SEGMENT_ITERS", None)
+        os.environ.pop("JTPU_CHAOS_SLOW_HOST", None)
+        server.shutdown()
+        daemon.stop()
+    rows = obs_federation.trace_find(cfg.root, host="fleet-host-1")
+    found = {r["id"] for r in rows}
+    if not found & set(rids):
+        return False, (f"trace find --host fleet-host-1 resolved "
+                       f"{sorted(found)}, none of the burst")
+    details.append(f"trace find attributed {len(found & set(rids))} "
+                   f"burst request(s) to the slowed serve worker; "
+                   f"all serve verdicts == offline")
+    return True, "; ".join(details)
 
 
 def scenario_serve_kill(seed):
@@ -2065,6 +2233,7 @@ SCENARIOS = (
     ("prof-kill", scenario_prof_kill),
     ("plan-rejects", scenario_plan_rejects),
     ("fleet-host-kill", scenario_fleet_host_kill),
+    ("straggler-host", scenario_straggler_host),
     ("serve-kill", scenario_serve_kill),
     ("trace-request-kill", scenario_trace_request_kill),
     ("serve-batch-poison", scenario_serve_batch_poison),

@@ -43,7 +43,12 @@ served request. This gate IS that request:
   ``GET /usage`` totals must equal a fold over the WAL's ``done``
   records digit for digit, and ``GET /slo`` must answer every declared
   objective with a finite burn rate for every window
-  (doc/observability.md, "Usage metering" / "SLOs").
+  (doc/observability.md, "Usage metering" / "SLOs");
+* the federated telemetry plane must span the fleet: BOTH ProcHost
+  workers' telemetry frames must be folded into the daemon's ONE
+  tsdb under their ``host=`` labels, and ``GET /trace/find`` must
+  resolve a burst request by tenant across the mesh
+  (doc/observability.md, "Fleet federation").
 
 Usage: python tools/serve_gate.py [--budget SECONDS] [--time-limit S]
 Exit code 0 iff the served verdict matches offline within the budget.
@@ -338,13 +343,26 @@ def main() -> int:
     from jepsen_tpu.checker.wgl import linearizable
     from jepsen_tpu.history import History
     from jepsen_tpu.models import CASRegister
+    from jepsen_tpu.testing import simulate_register_history
+    # a SEEDED history for the burst: the localkv draw above is
+    # timing-random, and an unlucky draw escalates the gang planner to
+    # a cap-512 rung whose XLA compile alone (~35 s) blows the gate
+    # budget — the fleet leg gates dispatch/federation plumbing, not
+    # plan escalation, so its shape must be deterministic
+    fleet_hist = [op.to_dict() for op in
+                  simulate_register_history(300, n_procs=4, n_vals=3,
+                                            seed=7)]
     offline_valid = check_safe(
         linearizable(CASRegister(), backend="tpu"),
         {"name": "serve-gate-fleet-offline"},
-        History.of(history)).get("valid")
+        History.of(fleet_hist)).get("valid")
+    # short telemetry cadences so the federation leg below sees both
+    # workers' frames folded well inside the gate budget
+    os.environ.setdefault("JTPU_FED_CADENCE", "0.25")
     fcfg = serve_ns.ServeConfig(root=os.path.join(root, "serve-fleet"),
                                 backend="tpu", batch_wait_ms=250.0,
-                                fleet_hosts=2, fleet_backend="proc")
+                                fleet_hosts=2, fleet_backend="proc",
+                                tsdb_cadence_s=0.5)
     fdaemon, fserver = serve_ns.run_daemon(
         fcfg, host="127.0.0.1", port=0, store_root=root)
     fport = fserver.server_port
@@ -356,7 +374,7 @@ def main() -> int:
             code, body, _ = _post(fport, "/check",
                                   {"tenant": f"fleet-{i % 3}",
                                    "model": "cas-register",
-                                   "history": history})
+                                   "history": fleet_hist})
             if code == 202:
                 fburst.append(body["id"])
             else:
@@ -425,6 +443,53 @@ def main() -> int:
                         problems.append(
                             f"objective {name} window {win} burn "
                             f"{burn!r} is not finite")
+        # 4c. the federation leg: both ProcHost workers export
+        # telemetry frames; the daemon's federator must fold them into
+        # the ONE tsdb under per-host labels, and trace search must
+        # resolve a burst request by tenant across the mesh
+        # (doc/observability.md, "Fleet federation")
+        if fdaemon.federator is None:
+            problems.append("fleet daemon built no federator")
+        else:
+            want_hosts = {"fleet-host-0", "fleet-host-1"}
+            deadline = time.time() + args.budget
+            labeled = set()
+            while time.time() < deadline:
+                labeled = set()
+                series = fdaemon.tsdb.recent(600.0).get("series", {})
+                for doc in series.values():
+                    for sk in doc:
+                        for h in want_hosts:
+                            if f'host="{h}"' in sk:
+                                labeled.add(h)
+                if want_hosts <= labeled:
+                    break
+                time.sleep(0.1)
+            fed_hosts = set(fdaemon.federator.hosts())
+            if not want_hosts <= fed_hosts:
+                problems.append(
+                    f"federator ingested frames from "
+                    f"{sorted(fed_hosts)}, want both of "
+                    f"{sorted(want_hosts)}")
+            if not want_hosts <= labeled:
+                problems.append(
+                    f"federated tsdb holds host-labeled series for "
+                    f"{sorted(labeled)}, want both of "
+                    f"{sorted(want_hosts)}")
+            code, tf = _get(fport,
+                            "/trace/find?tenant=fleet-0&format=json")
+            if code != 200:
+                problems.append(f"GET /trace/find answered {code}")
+            else:
+                rows = tf.get("requests", [])
+                ids = {r.get("id") for r in rows}
+                if not ids & set(fburst):
+                    problems.append(
+                        f"trace find by tenant resolved "
+                        f"{sorted(ids)}, none of the burst ids")
+                if any(r.get("tenant") != "fleet-0" for r in rows):
+                    problems.append(
+                        f"trace find leaked a foreign tenant: {rows}")
         code, drained, _ = _post(fport, "/drain", None)
         if code != 200 or not drained.get("drained"):
             problems.append(f"fleet drain answered {code}: {drained}")
