@@ -54,7 +54,7 @@ import os
 import threading
 import time
 from collections import deque
-from typing import Any, Callable, Dict, List, Optional, Set
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from jepsen_tpu import journal
 from jepsen_tpu.obs import fleet as obs_fleet
@@ -76,15 +76,28 @@ SPAN_TAIL_CAP = 200
 FRAMES_COMPACT = 1200
 FRAMES_KEEP = 300
 
-#: Span attributes worth shipping across the host boundary.
+#: Head-fingerprint length for the collector's incremental reader:
+#: enough of the first record (its CRC prefix + boot id land well
+#: inside) to tell a replaced file from an appended-to one.
+_FP_LEN = 64
+
+#: Span attributes worth shipping across the host boundary. ``phase``
+#: must ride along: the Federator's straggler feed excludes
+#: ``phase="compile"`` segments, and stripping the attribute here
+#: would turn every mid-run XLA recompile into false skew.
 _SPAN_KEYS = ("name", "ts", "dur", "trace", "host", "tenant", "round",
-              "rung", "gang", "id", "valid")
+              "rung", "gang", "id", "valid", "phase")
+
+_OFF_VALUES = ("0", "false", "no", "off")
 
 
 def enabled() -> bool:
-    """The ``JTPU_FEDERATE`` kill switch — only an explicit ``0``
-    turns federation off."""
-    return os.environ.get("JTPU_FEDERATE", "").strip() != "0"
+    """The ``JTPU_FEDERATE`` kill switch (default on). The ONE parser
+    for the env — ``ServeConfig``, the fleet's exporters, and the
+    detector construction all route through it, so ``0`` / ``false`` /
+    ``no`` / ``off`` each disable the whole plane consistently."""
+    return os.environ.get("JTPU_FEDERATE", "1").strip().lower() \
+        not in _OFF_VALUES
 
 
 def cadence_from_env() -> float:
@@ -288,20 +301,26 @@ class FrameExporter:
             recs = obs_trace.tracer().spans()
         except Exception:  # noqa: BLE001 — telemetry must not raise
             return []
+        fresh = [sp for sp in recs
+                 if isinstance(sp.get("ts"), (int, float))
+                 and sp["ts"] > self._span_ts]
+        fresh.sort(key=lambda sp: sp["ts"])
         out: List[dict] = []
-        last = self._span_ts
-        for sp in recs:
-            ts = sp.get("ts", 0)
-            if not isinstance(ts, (int, float)) or ts <= self._span_ts:
-                continue
-            if ts > last:
-                last = ts
+        for sp in fresh:
             if self.span_host is not None \
                     and sp.get("host") != self.span_host:
+                # another exporter's span: skip it, but move the
+                # cursor past it so it is never rescanned
+                self._span_ts = sp["ts"]
                 continue
+            if len(out) >= SPAN_TAIL_CAP:
+                # overflow: the cursor stays at the last span actually
+                # shipped, so the remainder exports next frame instead
+                # of vanishing
+                break
             out.append({k: sp[k] for k in _SPAN_KEYS if k in sp})
-        self._span_ts = last
-        return out[-SPAN_TAIL_CAP:]
+            self._span_ts = sp["ts"]
+        return out
 
     # -- file ---------------------------------------------------------
 
@@ -365,6 +384,11 @@ class Federator:
         # guarded-by: _lock — wall-clock t of each host's newest frame
         self._seen: Dict[str, float] = {}
         self.frames_ingested = 0                    # guarded-by: _lock
+        # sampler thread only — per-file (inode, byte offset past the
+        # last complete record, head fingerprint), so a ~1s tick
+        # decodes only appended records instead of every host's whole
+        # file
+        self._offsets: Dict[str, Tuple[int, int, bytes]] = {}
 
     def _host_dirs(self) -> List[str]:
         try:
@@ -375,6 +399,49 @@ class Federator:
         except OSError:
             return []
 
+    def _read_new(self, host_dir: str) -> List[dict]:
+        """Frame records appended to a host's file since the last
+        pass. An inode change, a shrink below the cursor, or a changed
+        head fingerprint (filesystems reuse inodes, so a same-size
+        replacement could otherwise pass) means the file was replaced
+        — exporter compaction or a host rejoin: the offset resets to 0
+        and the durable ``(boot, seq)`` cursor dedups the replayed
+        prefix. Bytes past the last newline are a torn or in-flight
+        tail — the offset never advances past them, so a record
+        completed by the next append is decoded then, not lost."""
+        path = os.path.join(host_dir, FRAMES_NAME)
+        try:
+            f = open(path, "rb")
+        except OSError:
+            self._offsets.pop(path, None)
+            return []
+        try:
+            with f:
+                st = os.fstat(f.fileno())
+                ino, off, fp = self._offsets.get(path, (-1, 0, b""))
+                head = f.read(_FP_LEN)
+                if ino != st.st_ino or st.st_size < off \
+                        or not head.startswith(fp):
+                    off = 0
+                if st.st_size <= off:
+                    self._offsets[path] = (st.st_ino, off, head)
+                    return []
+                f.seek(off)
+                data = f.read()
+        except OSError:
+            return []
+        end = data.rfind(b"\n")
+        if end < 0:
+            self._offsets[path] = (st.st_ino, off, head)
+            return []
+        out: List[dict] = []
+        for line in data[:end].split(b"\n"):
+            rec = journal.decode_json_record(line)
+            if rec is not None and rec.get("k") == "frame":
+                out.append(rec)
+        self._offsets[path] = (st.st_ino, off + end + 1, head)
+        return out
+
     # -- the tick -----------------------------------------------------
 
     def collect(self, now: float) -> int:
@@ -383,7 +450,7 @@ class Federator:
             dict(self.db.meta_view("fed") or {})
         n = 0
         for d in self._host_dirs():
-            for rec in read_frames(d):
+            for rec in self._read_new(d):
                 host = str(rec.get("host")
                            or os.path.basename(os.path.normpath(d)))
                 try:

@@ -197,6 +197,14 @@ def _env_float(name: str, default: float) -> float:
         return default
 
 
+def _federate_env_on() -> bool:
+    """JTPU_FEDERATE via the federation module's parser — the one
+    place the kill switch is interpreted, so the daemon, the fleet's
+    exporters, and the detector all agree on what "off" spells."""
+    from jepsen_tpu.obs import federation as obs_federation
+    return obs_federation.enabled()
+
+
 @dataclass
 class ServeConfig:
     """The daemon's knob set (doc/serve.md has the operator table).
@@ -353,10 +361,7 @@ class ServeConfig:
     #: exporters, the tsdb federator, the straggler detector, and the
     #: /trace/find route (JTPU_FEDERATE). Off restores the PR-19
     #: surface byte-identically (see :attr:`federate_on`).
-    federate_enabled: bool = field(
-        default_factory=lambda: os.environ.get(
-            "JTPU_FEDERATE", "1").strip().lower()
-        not in ("0", "false", "no", "off"))
+    federate_enabled: bool = field(default_factory=_federate_env_on)
     #: Host frame-export cadence, seconds (JTPU_FED_CADENCE).
     federate_cadence_s: float = field(
         default_factory=lambda: _env_float("JTPU_FED_CADENCE", 1.0))
@@ -364,9 +369,10 @@ class ServeConfig:
     @property
     def federate_on(self) -> bool:
         """Whether the federation plane is constructed: needs the
-        telemetry stack AND a fleet, and JTPU_FEDERATE=0 wins at call
-        time — the same kill-switch discipline as :attr:`tsdb_on`."""
-        if os.environ.get("JTPU_FEDERATE", "").strip() == "0":
+        telemetry stack AND a fleet, and a JTPU_FEDERATE kill-switch
+        value wins at call time — the same kill-switch discipline as
+        :attr:`tsdb_on`."""
+        if not _federate_env_on():
             return False
         return bool(self.federate_enabled) and self.tsdb_on \
             and self.fleet_enabled

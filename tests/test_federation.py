@@ -26,6 +26,7 @@ from jepsen_tpu.obs import federation as fed_ns
 from jepsen_tpu.obs import fleet as obs_fleet
 from jepsen_tpu.obs import metrics as obs_metrics
 from jepsen_tpu.obs import straggler as strag_ns
+from jepsen_tpu.obs import trace as obs_trace
 from jepsen_tpu.obs import tsdb as tsdb_ns
 
 from tests.test_serve import _daemon, _ops, _wait_done
@@ -111,6 +112,32 @@ class TestFrameExporter:
 
     def test_missing_file_reads_empty(self, tmp_path):
         assert fed_ns.read_frames(str(tmp_path / "nowhere")) == []
+
+    def test_span_overflow_ships_next_frame_not_dropped(
+            self, tmp_path, monkeypatch):
+        """More new spans than SPAN_TAIL_CAP in one cadence: the
+        cursor must stay at the last span actually shipped, so the
+        overflow rides the next frames instead of vanishing (losing
+        trace-to-host attribution for trace_find)."""
+        monkeypatch.setattr(fed_ns, "SPAN_TAIL_CAP", 5)
+        clock = _clock(100.0)
+        reg = obs_metrics.Registry()
+        ex = _exporter(tmp_path, "fleet-host-0", clock, registry=reg,
+                       span_host="ovf-h0")
+        # a neutral name: checker.segment spans ring-wide must carry
+        # phase (test_obs asserts it), and the tail cursor is
+        # name-agnostic anyway
+        for i in range(12):
+            with obs_trace.span("fed.test.span", host="ovf-h0", id=i):
+                pass
+        shipped = []
+        for want in (5, 5, 2):
+            spans = ex.export_once().get("spans") or []
+            assert len(spans) == want
+            shipped.extend(sp["id"] for sp in spans)
+        assert shipped == list(range(12))   # oldest first, none lost
+        assert ex.export_once().get("spans") is None  # all caught up
+        ex.stop()
 
     def test_compaction_keeps_newest_frames(self, tmp_path,
                                             monkeypatch):
@@ -321,6 +348,83 @@ class TestFederator:
         fed._ingest("h1", frame("h1", 7, [seg("h1", 2.0, "execute")]),
                     1, 7, now)
         assert det.flagged() == {"h1"}
+
+    def test_phase_rides_the_real_frame_path_end_to_end(self,
+                                                        tmp_path):
+        """Through the real exporter (not hand-built frames): a
+        compile-phase checker.segment span must reach Federator.collect
+        still carrying ``phase``, so the straggler feed excludes it —
+        if the exporter stripped the attribute, every mid-run XLA
+        recompile would be scored as skew."""
+        clock = _clock(100.0)
+        reg = obs_metrics.Registry()
+        ex = _exporter(tmp_path, "fleet-host-0", clock, registry=reg,
+                       span_host="e2e-h0")
+        with obs_trace.span("checker.segment", host="e2e-h0",
+                            phase="compile"):
+            time.sleep(0.002)
+        with obs_trace.span("checker.segment", host="e2e-h0",
+                            phase="execute"):
+            time.sleep(0.002)
+        ex.export_once()
+        ex.stop()
+        frames = fed_ns.read_frames(ex.host_dir)
+        spans = [sp for f in frames for sp in f.get("spans") or []]
+        assert [sp["phase"] for sp in spans] == ["compile", "execute"]
+
+        segs = []
+
+        class Spy:
+            def observe_segment(self, host, seconds):
+                segs.append((host, seconds))
+
+            def observe_heartbeat(self, host, age_s):
+                pass
+
+            def poll_new(self):
+                return set()
+
+        db = _db(tmp_path / "db", clock)
+        fed = fed_ns.Federator(str(tmp_path), db, straggler=Spy())
+        assert fed.collect(clock()) >= 1
+        # only the execute-phase segment fed the detector
+        assert [h for h, _ in segs] == ["e2e-h0"]
+        assert segs[0][1] >= 0.002
+
+    def test_collect_reads_only_appended_bytes(self, tmp_path,
+                                               monkeypatch):
+        """The per-file read offset: a no-change tick decodes nothing,
+        appends decode from the cursor, and an exporter compaction
+        (tmp + replace, new inode, smaller file) resets the offset —
+        the durable (boot, seq) cursor dedups the replayed prefix so
+        totals stay exact."""
+        monkeypatch.setattr(fed_ns, "FRAMES_COMPACT", 4)
+        monkeypatch.setattr(fed_ns, "FRAMES_KEEP", 2)
+        clock = _clock(100.0)
+        reg = obs_metrics.Registry()
+        c = reg.counter("jobs_total")
+        ex = _exporter(tmp_path, "fleet-host-0", clock, registry=reg)
+        db = _db(tmp_path / "db", clock)
+        fed = fed_ns.Federator(str(tmp_path), db)
+        c.inc(1)
+        ex.export_once()
+        assert fed.collect(clock()) == 1
+        off = fed._offsets[ex.path]
+        assert off[1] == os.path.getsize(ex.path)
+        # nothing new: the offset is stable, nothing is re-decoded
+        assert fed.collect(clock()) == 0
+        assert fed._offsets[ex.path] == off
+        # drive the exporter past FRAMES_COMPACT (the file is
+        # replaced under the collector's feet), ingesting as we go
+        for _ in range(5):
+            c.inc(1)
+            ex.export_once()
+            fed.collect(clock())
+        ex.stop()
+        fed.collect(clock())
+        assert db.window_delta("jobs_total", 3600.0, now=clock(),
+                               host="fleet-host-0") == 6.0
+        assert fed.collect(clock()) == 0    # and the cursor holds
 
     def test_fleet_ages_stateless_reader(self, tmp_path):
         clock = _clock(100.0)
@@ -655,6 +759,22 @@ class TestServeFederation:
         for i in (0, 1):
             assert not os.path.exists(os.path.join(
                 cfg.root, f"fleet-host-{i}", fed_ns.FRAMES_NAME))
+
+    def test_kill_switch_parser_is_shared(self, tmp_path,
+                                          monkeypatch):
+        """ServeConfig and the fleet workers' federation.enabled()
+        must read JTPU_FEDERATE identically: false/no/off disable the
+        daemon plane AND the exporters, not just one of them."""
+        for v in ("0", "false", "no", "off", " OFF "):
+            monkeypatch.setenv("JTPU_FEDERATE", v)
+            assert fed_ns.enabled() is False
+            cfg = _fleet_cfg(tmp_path)
+            assert cfg.federate_enabled is False
+            assert cfg.federate_on is False
+        for v in ("1", "", "yes"):
+            monkeypatch.setenv("JTPU_FEDERATE", v)
+            assert fed_ns.enabled() is True
+            assert _fleet_cfg(tmp_path).federate_on is True
 
     def test_federate_needs_tsdb_and_fleet(self, tmp_path):
         """No fleet, or no tsdb -> no federation plane (it rides the
